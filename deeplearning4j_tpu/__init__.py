@@ -1,0 +1,30 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of the Eclipse Deeplearning4j stack
+(qiuzhanta/deeplearning4j fork) designed for TPU hardware: every numeric
+operation funnels through one op table (``deeplearning4j_tpu.ops.registry``),
+models trace to a single XLA program per training step (instead of the
+reference's per-op JNI dispatch, see SURVEY.md §3.1), and scale-out is
+expressed as shardings over a ``jax.sharding.Mesh`` with compiler-emitted
+collectives over ICI/DCN (replacing the reference's NCCL/Aeron machinery,
+SURVEY.md §2.4).
+
+Subpackage map (reference component in parentheses — path-cites per SURVEY.md;
+the reference mount was empty this round, so line numbers are not available):
+
+- ``ops``       — op table + op families (libnd4j ops + nd4j-api op classes)
+- ``autodiff``  — SameDiff-parity graph API + gradient checking
+  (org/nd4j/autodiff/samediff/SameDiff.java)
+- ``nn``        — layer/config DSL, MultiLayerNetwork, ComputationGraph,
+  updaters (deeplearning4j-nn)
+- ``models``    — model zoo (deeplearning4j-zoo)
+- ``parallel``  — mesh/DP/TP/SP, ParallelWrapper + ParallelInference parity
+  (deeplearning4j-scaleout)
+- ``data``      — dataset iterators + ETL (datavec, deeplearning4j-datasets)
+- ``eval``      — Evaluation/RegressionEvaluation/ROC (org/nd4j/evaluation)
+- ``utils``     — serialization, listeners, profiling (nd4j-common et al.)
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu import dtypes  # noqa: F401

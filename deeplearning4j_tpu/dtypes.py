@@ -1,0 +1,90 @@
+"""Dtype system.
+
+Parity with ND4J's ``DataType`` enum (reference:
+nd4j/nd4j-backends/nd4j-api-parent/nd4j-api/ org/nd4j/linalg/api/buffer/DataType.java,
+path-cite — mount empty this round). The TPU-native twist: ``bfloat16`` is the
+default compute dtype for MXU-bound work, while ``float32`` remains the default
+parameter/accumulation dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical names → jnp dtypes (DataType enum parity).
+DOUBLE = jnp.float64
+FLOAT = jnp.float32
+HALF = jnp.float16
+BFLOAT16 = jnp.bfloat16
+INT64 = jnp.int64
+INT32 = jnp.int32
+INT16 = jnp.int16
+INT8 = jnp.int8
+UINT64 = jnp.uint64
+UINT32 = jnp.uint32
+UINT16 = jnp.uint16
+UINT8 = jnp.uint8
+BOOL = jnp.bool_
+
+_BY_NAME = {
+    "double": DOUBLE, "float64": DOUBLE,
+    "float": FLOAT, "float32": FLOAT,
+    "half": HALF, "float16": HALF,
+    "bfloat16": BFLOAT16, "bf16": BFLOAT16,
+    "long": INT64, "int64": INT64,
+    "int": INT32, "int32": INT32,
+    "short": INT16, "int16": INT16,
+    "byte": INT8, "int8": INT8,
+    "ulong": UINT64, "uint64": UINT64,
+    "uint": UINT32, "uint32": UINT32,
+    "ushort": UINT16, "uint16": UINT16,
+    "ubyte": UINT8, "uint8": UINT8,
+    "bool": BOOL,
+}
+
+FLOATING_DTYPES = (DOUBLE, FLOAT, HALF, BFLOAT16)
+INTEGER_DTYPES = (INT64, INT32, INT16, INT8, UINT64, UINT32, UINT16, UINT8)
+
+# Global defaults (Nd4j.setDefaultDataTypes parity).
+_default_floating = FLOAT
+_compute_dtype = BFLOAT16  # MXU-preferred dtype for matmul/conv compute.
+
+
+def by_name(name: str):
+    """Resolve a DataType by its ND4J-style name (case-insensitive)."""
+    key = name.lower()
+    if key not in _BY_NAME:
+        raise ValueError(f"Unknown dtype name: {name!r}")
+    return _BY_NAME[key]
+
+
+def default_floating_dtype():
+    return _default_floating
+
+
+def set_default_floating_dtype(dtype) -> None:
+    global _default_floating
+    _default_floating = jnp.dtype(dtype)
+
+
+def compute_dtype():
+    """Dtype used for MXU-bound compute (matmul/conv) when mixed precision is on."""
+    return _compute_dtype
+
+
+def set_compute_dtype(dtype) -> None:
+    global _compute_dtype
+    _compute_dtype = jnp.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_bool(dtype) -> bool:
+    return jnp.dtype(dtype) == np.bool_

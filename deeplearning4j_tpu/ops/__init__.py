@@ -1,0 +1,35 @@
+"""Op table + op families.
+
+Importing this package populates the registry (the reference does the same at
+static-init time: libnd4j's OpRegistrator fills from CustomOperations.h
+inclusion — path-cite, mount empty this round).
+
+Usage:
+    from deeplearning4j_tpu import ops
+    ops.exec_op("conv2d", x, w)      # by-name dispatch (OpExecutioner parity)
+    ops.nn.conv2d(x, w)              # direct call (same function)
+"""
+
+from deeplearning4j_tpu.ops.registry import (  # noqa: F401
+    OpDef,
+    OpNotFoundError,
+    categories,
+    exec_op,
+    get_op,
+    has_op,
+    list_ops,
+    op,
+    op_count,
+    register,
+    shape_of,
+)
+
+# Importing the family modules registers their ops.
+from deeplearning4j_tpu.ops import (  # noqa: F401
+    elementwise,
+    linalg,
+    nn,
+    random,
+    reduce,
+    shape_ops,
+)

@@ -1,0 +1,223 @@
+"""Elementwise op families: transform / pairwise / scalar.
+
+Reference parity: libnd4j "legacy op loops" — the transform{float,same,strict,bool},
+pairwise and scalar kernel families (libnd4j/include/loops/cpu/transform_float.hpp,
+pairwise.hpp, scalar.hpp and their .cu twins — path-cite, mount empty this round)
+plus the one-Java-class-per-op mirrors under org/nd4j/linalg/api/ops/impl/transforms.
+
+TPU-native design: each family member is a single jnp/lax expression. XLA fuses
+chains of these into the surrounding matmul/conv kernels (HBM-bandwidth win);
+there is deliberately no per-op kernel code here — the enum-dispatched kernel
+zoo of the reference collapses into ~one line per op (SURVEY.md §2.1 N2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ---------------------------------------------------------------------------
+# transform_float — float-output unary transforms
+# ---------------------------------------------------------------------------
+
+op("exp", "transform_float")(jnp.exp)
+op("log", "transform_float")(jnp.log)
+op("log2", "transform_float")(jnp.log2)
+op("log10", "transform_float")(jnp.log10)
+op("log1p", "transform_float")(jnp.log1p)
+op("expm1", "transform_float")(jnp.expm1)
+op("sqrt", "transform_float")(jnp.sqrt)
+op("rsqrt", "transform_float")(lax.rsqrt)
+op("sin", "transform_float")(jnp.sin)
+op("cos", "transform_float")(jnp.cos)
+op("tan", "transform_float")(jnp.tan)
+op("asin", "transform_float")(jnp.arcsin)
+op("acos", "transform_float")(jnp.arccos)
+op("atan", "transform_float")(jnp.arctan)
+op("sinh", "transform_float")(jnp.sinh)
+op("cosh", "transform_float")(jnp.cosh)
+op("tanh", "transform_float")(jnp.tanh)
+op("asinh", "transform_float")(jnp.arcsinh)
+op("acosh", "transform_float")(jnp.arccosh)
+op("atanh", "transform_float")(jnp.arctanh)
+op("erf", "transform_float")(jax.scipy.special.erf)
+op("erfc", "transform_float")(jax.scipy.special.erfc)
+op("sigmoid", "transform_float")(jax.nn.sigmoid)
+op("log_sigmoid", "transform_float")(jax.nn.log_sigmoid)
+op("softplus", "transform_float")(jax.nn.softplus)
+op("softsign", "transform_float")(jax.nn.soft_sign)
+op("gelu", "transform_float", aliases=("gelu_erf",))(
+    lambda x: jax.nn.gelu(x, approximate=False)
+)
+op("gelu_tanh", "transform_float", aliases=("precise_gelu",))(
+    lambda x: jax.nn.gelu(x, approximate=True)
+)
+op("elu", "transform_float")(jax.nn.elu)
+op("selu", "transform_float")(jax.nn.selu)
+op("swish", "transform_float", aliases=("silu",))(jax.nn.silu)
+op("mish", "transform_float")(jax.nn.mish)
+op("hard_sigmoid", "transform_float")(jax.nn.hard_sigmoid)
+op("hard_tanh", "transform_float", aliases=("hardtanh",))(
+    lambda x: jnp.clip(x, -1.0, 1.0)
+)
+op("rationaltanh", "transform_float")(
+    lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0)
+)
+op("rectifiedtanh", "transform_float")(lambda x: jnp.maximum(jnp.tanh(x), 0.0))
+
+
+@op("sigmoid_derivative", "transform_float")
+def sigmoid_derivative(x):
+    s = jax.nn.sigmoid(x)
+    return s * (1.0 - s)
+
+
+@op("tanh_derivative", "transform_float")
+def tanh_derivative(x):
+    t = jnp.tanh(x)
+    return 1.0 - t * t
+
+
+# ---------------------------------------------------------------------------
+# transform_same — same-dtype unary transforms
+# ---------------------------------------------------------------------------
+
+op("abs", "transform_same")(jnp.abs)
+op("neg", "transform_same", aliases=("negative",))(jnp.negative)
+op("sign", "transform_same")(jnp.sign)
+op("square", "transform_same")(jnp.square)
+op("cube", "transform_same")(lambda x: x * x * x)
+op("reciprocal", "transform_same")(lambda x: 1.0 / x)
+op("floor", "transform_same")(jnp.floor)
+op("ceil", "transform_same")(jnp.ceil)
+op("round", "transform_same")(jnp.round)
+op("rint", "transform_same")(jnp.rint)
+op("trunc", "transform_same")(jnp.trunc)
+op("relu", "transform_same")(jax.nn.relu)
+op("relu6", "transform_same")(jax.nn.relu6)
+op("identity", "transform_same", aliases=("linear", "old_identity"))(lambda x: x)
+op("stop_gradient", "transform_same")(lax.stop_gradient)
+op("oneslike", "transform_same", aliases=("ones_as",))(jnp.ones_like)
+op("zeroslike", "transform_same", aliases=("zeros_as",))(jnp.zeros_like)
+
+
+@op("leakyrelu", "transform_same", aliases=("leaky_relu",))
+def leaky_relu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@op("prelu", "transform_same")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@op("thresholdrelu", "transform_same")
+def threshold_relu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+@op("clipbyvalue", "transform_same", aliases=("clip_by_value",))
+def clip_by_value(x, clip_min, clip_max):
+    return jnp.clip(x, clip_min, clip_max)
+
+
+@op("clipbynorm", "transform_same", aliases=("clip_by_norm",))
+def clip_by_norm(x, clip_norm, axes=None):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=axes is not None))
+    scale = jnp.where(norm > clip_norm, clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return x * scale
+
+
+# ---------------------------------------------------------------------------
+# transform_bool — predicate transforms
+# ---------------------------------------------------------------------------
+
+op("isnan", "transform_bool", differentiable=False)(jnp.isnan)
+op("isinf", "transform_bool", differentiable=False)(jnp.isinf)
+op("isfinite", "transform_bool", differentiable=False)(jnp.isfinite)
+op("not", "transform_bool", aliases=("boolean_not",), differentiable=False)(
+    jnp.logical_not
+)
+
+
+# ---------------------------------------------------------------------------
+# pairwise — binary elementwise with numpy broadcasting
+# (the reference splits pairwise vs broadcast kernels by shape; XLA's
+#  implicit broadcasting makes them one family here)
+# ---------------------------------------------------------------------------
+
+op("add", "pairwise")(jnp.add)
+op("subtract", "pairwise", aliases=("sub",))(jnp.subtract)
+op("multiply", "pairwise", aliases=("mul", "old_mul"))(jnp.multiply)
+op("divide", "pairwise", aliases=("div",))(jnp.divide)
+op("rsub", "pairwise", aliases=("reversesubtract",))(lambda x, y: y - x)
+op("rdiv", "pairwise", aliases=("reversedivide",))(lambda x, y: y / x)
+op("pow", "pairwise", aliases=("power",))(jnp.power)
+op("floordiv", "pairwise", aliases=("floor_div",))(jnp.floor_divide)
+op("mod", "pairwise")(jnp.mod)
+op("fmod", "pairwise")(jnp.fmod)  # C semantics: sign follows the dividend
+op("truncatediv", "pairwise")(lambda x, y: jnp.trunc(x / y))
+op("maximum", "pairwise", aliases=("max_pairwise",))(jnp.maximum)
+op("minimum", "pairwise", aliases=("min_pairwise",))(jnp.minimum)
+op("atan2", "pairwise")(jnp.arctan2)
+op("squareddifference", "pairwise", aliases=("squared_difference",))(
+    lambda x, y: jnp.square(x - y)
+)
+op("hypot", "pairwise")(jnp.hypot)
+op("copysign", "pairwise")(jnp.copysign)
+
+op("equals", "pairwise_bool", aliases=("eq",), differentiable=False)(jnp.equal)
+op("notequals", "pairwise_bool", aliases=("neq",), differentiable=False)(
+    jnp.not_equal
+)
+op("greater", "pairwise_bool", aliases=("gt",), differentiable=False)(jnp.greater)
+op("greaterequal", "pairwise_bool", aliases=("gte",), differentiable=False)(
+    jnp.greater_equal
+)
+op("less", "pairwise_bool", aliases=("lt",), differentiable=False)(jnp.less)
+op("lessequal", "pairwise_bool", aliases=("lte",), differentiable=False)(
+    jnp.less_equal
+)
+op("and", "pairwise_bool", aliases=("boolean_and",), differentiable=False)(
+    jnp.logical_and
+)
+op("or", "pairwise_bool", aliases=("boolean_or",), differentiable=False)(
+    jnp.logical_or
+)
+op("xor", "pairwise_bool", aliases=("boolean_xor",), differentiable=False)(
+    jnp.logical_xor
+)
+
+
+@op("where", "pairwise", aliases=("select",))
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@op("axpy", "pairwise")
+def axpy(x, y, alpha=1.0):
+    """y + alpha*x — the reference's BLAS-1 step function (params -= lr·update)."""
+    return alpha * x + y
+
+
+# ---------------------------------------------------------------------------
+# scalar — tensor ⊕ scalar (the reference's scalar kernel family; in XLA a
+# scalar is just a rank-0 broadcast, but the named ops are kept for the table)
+# ---------------------------------------------------------------------------
+
+op("scalar_add", "scalar")(lambda x, s: x + s)
+op("scalar_sub", "scalar")(lambda x, s: x - s)
+op("scalar_mul", "scalar")(lambda x, s: x * s)
+op("scalar_div", "scalar")(lambda x, s: x / s)
+op("scalar_rsub", "scalar")(lambda x, s: s - x)
+op("scalar_rdiv", "scalar")(lambda x, s: s / x)
+op("scalar_max", "scalar")(lambda x, s: jnp.maximum(x, s))
+op("scalar_min", "scalar")(lambda x, s: jnp.minimum(x, s))
+op("scalar_pow", "scalar")(lambda x, s: jnp.power(x, s))
+op("scalar_set", "scalar", differentiable=False)(lambda x, s: jnp.full_like(x, s))
+op("step", "scalar", differentiable=False)(
+    lambda x, s=0.0: (x > s).astype(x.dtype)
+)

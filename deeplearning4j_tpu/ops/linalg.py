@@ -1,0 +1,169 @@
+"""Linear-algebra ops — the MXU path.
+
+Reference parity: BLAS bindings (libnd4j/include/helpers/BlasHelper.h →
+OpenBLAS/cuBLAS — path-cite, mount empty this round) and matmul-family
+declarable ops (libnd4j/include/ops/declarable/generic/blas/ e.g. matmul.cpp,
+tensormmul.cpp, batched_gemm.cpp).
+
+TPU-native: everything lowers to ``dot_general`` HLO, which XLA tiles onto the
+128×128 MXU systolic array. Matmuls accept a ``preferred_element_type`` so
+bf16 inputs accumulate in fp32 — the TPU equivalent of the reference's
+mixed-precision GEMM paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+@op("matmul", "linalg", aliases=("mmul", "gemm"))
+def matmul(a, b, transpose_a=False, transpose_b=False, preferred_element_type=None):
+    """General (batched) matrix multiply. Rank ≥ 2; leading dims broadcast."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    if preferred_element_type is None and a.dtype == jnp.bfloat16:
+        preferred_element_type = jnp.float32
+    out = jnp.matmul(a, b, preferred_element_type=preferred_element_type)
+    if preferred_element_type is not None and a.dtype == jnp.bfloat16:
+        out = out.astype(a.dtype)
+    return out
+
+
+@op("tensormmul", "linalg", aliases=("tensordot",))
+def tensormmul(a, b, axes_a, axes_b):
+    """Tensor contraction over arbitrary axes (ND4J tensorMmul)."""
+    return jnp.tensordot(a, b, axes=(tuple(axes_a), tuple(axes_b)))
+
+
+@op("einsum", "linalg")
+def einsum(subscripts, *operands):
+    return jnp.einsum(subscripts, *operands)
+
+
+@op("mmul_vector", "linalg", aliases=("gemv",))
+def gemv(a, x):
+    return jnp.matmul(a, x)
+
+
+@op("vdot", "linalg")
+def vdot(x, y):
+    return jnp.vdot(x, y)
+
+
+@op("outer", "linalg")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op("batched_gemm", "linalg")
+def batched_gemm(a, b, transpose_a=False, transpose_b=False):
+    return matmul(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+@op("matrix_diag", "linalg")
+def matrix_diag(x):
+    return jnp.apply_along_axis(jnp.diag, -1, x) if x.ndim > 1 else jnp.diag(x)
+
+
+@op("matrix_diag_part", "linalg", aliases=("diag_part",))
+def matrix_diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+@op("diag", "linalg")
+def diag(x):
+    return jnp.diag(x)
+
+
+@op("trace", "linalg")
+def trace(x):
+    return jnp.trace(x, axis1=-2, axis2=-1)
+
+
+@op("matrix_inverse", "linalg")
+def matrix_inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op("matrix_determinant", "linalg")
+def matrix_determinant(x):
+    return jnp.linalg.det(x)
+
+
+@op("log_matrix_determinant", "linalg")
+def log_matrix_determinant(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return logdet
+
+
+@op("cholesky", "linalg")
+def cholesky(x):
+    return jnp.linalg.cholesky(x)
+
+
+@op("qr", "linalg")
+def qr(x, full_matrices=False):
+    return jnp.linalg.qr(x, mode="complete" if full_matrices else "reduced")
+
+
+@op("svd", "linalg")
+def svd(x, full_matrices=False, compute_uv=True):
+    return jnp.linalg.svd(x, full_matrices=full_matrices, compute_uv=compute_uv)
+
+
+@op("lstsq", "linalg")
+def lstsq(a, b):
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("solve", "linalg", aliases=("linear_solve",))
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@op("triangular_solve", "linalg")
+def triangular_solve(a, b, lower=True):
+    return lax.linalg.triangular_solve(a, b, lower=lower, left_side=True)
+
+
+@op("lu", "linalg")
+def lu(x):
+    return lax.linalg.lu(x)
+
+
+@op("eigh", "linalg", aliases=("self_adjoint_eig", "syev"))
+def eigh(x):
+    """Symmetric/Hermitian eigendecomposition (ND4J's Eigen.symmetric* path)."""
+    return jnp.linalg.eigh(x)
+
+
+@op("eig", "linalg")
+def eig(x):
+    """General (non-symmetric) eigendecomposition. JAX lowers this on CPU only;
+    on TPU prefer ``eigh`` for symmetric inputs."""
+    return jnp.linalg.eig(x)
+
+
+@op("cross", "linalg")
+def cross(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@op("tri", "linalg", differentiable=False)
+def tri(n, m=None, k=0, dtype=jnp.float32):
+    return jnp.tri(n, m, k, dtype=dtype)
+
+
+@op("triu", "linalg")
+def triu(x, k=0):
+    return jnp.triu(x, k)
+
+
+@op("tril", "linalg")
+def tril(x, k=0):
+    return jnp.tril(x, k)

@@ -1,0 +1,573 @@
+"""Neural-net ops: convolution, pooling, normalization, softmax, losses, attention.
+
+Reference parity: libnd4j declarable ops under ops/declarable/generic/nn/**
+(convo/conv2d.cpp, pooling/maxpool2d.cpp, batchnorm.cpp, softmax.cpp,
+loss/*.cpp, attention ops) and their cuDNN/oneDNN platform helpers
+(ops/declarable/platform/cudnn/conv2d.cu, batchnorm.cu …) — path-cite, mount
+empty this round.
+
+TPU-native: XLA *is* the vendor library (SURVEY.md §2.1 N5). Convolutions lower
+to the ``convolution`` HLO which XLA tiles onto the MXU; pooling is
+``reduce-window``; batchnorm is a fused multiply-add chain XLA folds into the
+adjacent conv. Default data format is **NHWC** (TPU-preferred; C maps to the
+128-lane dimension) — the reference's NCHW default is a cuDNN-era artifact.
+Matmul/conv accept bf16 inputs with fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ---------------------------------------------------------------------------
+# Convolutions
+# ---------------------------------------------------------------------------
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_padding(padding, kernel, strides, dilation):
+    """ND4J uses explicit pad + a 'same mode' flag; accept both styles."""
+    if isinstance(padding, str):
+        return padding  # 'SAME' | 'VALID'
+    pads = _pair(padding)
+    return [(p, p) for p in pads]
+
+
+@op("conv2d", "conv")
+def conv2d(
+    x,
+    w,
+    b=None,
+    strides=(1, 1),
+    padding="SAME",
+    dilation=(1, 1),
+    data_format="NHWC",
+    feature_group_count=1,
+    preferred_element_type=jnp.float32,
+):
+    """2-D convolution.
+
+    x: [N,H,W,C] (NHWC) or [N,C,H,W] (NCHW); w: [kH,kW,Cin/groups,Cout] (HWIO).
+    Reference: libnd4j generic/nn/convo/conv2d.cpp (+ cudnn/conv2d.cu fast path);
+    here a single ``convolution`` HLO on the MXU.
+    """
+    dn = lax.conv_dimension_numbers(
+        x.shape,
+        w.shape,
+        (data_format, "HWIO", data_format),
+    )
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=_pair(strides),
+        padding=_conv_padding(padding, w.shape[:2], strides, dilation),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=dn,
+        feature_group_count=feature_group_count,
+        preferred_element_type=preferred_element_type,
+    ).astype(x.dtype)
+    if b is not None:
+        bshape = (1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1)
+        out = out + b.reshape(bshape).astype(out.dtype)
+    return out
+
+
+@op("conv1d", "conv")
+def conv1d(x, w, b=None, stride=1, padding="SAME", dilation=1, data_format="NWC"):
+    """1-D convolution. x: [N,W,C]; w: [kW,Cin,Cout]."""
+    x4 = jnp.expand_dims(x, 1 if data_format == "NWC" else 2)
+    w4 = jnp.expand_dims(w, 0)
+    df = "NHWC" if data_format == "NWC" else "NCHW"
+    pad = padding if isinstance(padding, str) else (0, padding)
+    out = conv2d(x4, w4, b, strides=(1, stride), padding=pad, dilation=(1, dilation), data_format=df)
+    return jnp.squeeze(out, 1 if data_format == "NWC" else 2)
+
+
+@op("conv3d", "conv")
+def conv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME", dilation=(1, 1, 1), data_format="NDHWC"):
+    """3-D convolution. x: [N,D,H,W,C]; w: [kD,kH,kW,Cin,Cout]."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "DHWIO", data_format))
+    if not isinstance(padding, str):
+        padding = [(p, p) for p in (padding if len(padding) == 3 else (padding,) * 3)]
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(strides) if not isinstance(strides, int) else (strides,) * 3,
+        padding=padding,
+        rhs_dilation=tuple(dilation) if not isinstance(dilation, int) else (dilation,) * 3,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if b is not None:
+        bshape = (1, 1, 1, 1, -1) if data_format.endswith("C") else (1, -1, 1, 1, 1)
+        out = out + b.reshape(bshape).astype(out.dtype)
+    return out
+
+
+@op("depthwise_conv2d", "conv", aliases=("sconv2d_depthwise",))
+def depthwise_conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1), data_format="NHWC"):
+    """Depthwise conv; w: [kH,kW,C,multiplier]."""
+    c = x.shape[-1] if data_format == "NHWC" else x.shape[1]
+    kh, kw, cin, mult = w.shape
+    w = w.reshape(kh, kw, 1, cin * mult)
+    return conv2d(
+        x, w, b, strides=strides, padding=padding, dilation=dilation,
+        data_format=data_format, feature_group_count=c,
+    )
+
+
+@op("separable_conv2d", "conv", aliases=("sconv2d",))
+def separable_conv2d(x, depth_w, point_w, b=None, strides=(1, 1), padding="SAME", data_format="NHWC"):
+    y = depthwise_conv2d(x, depth_w, None, strides=strides, padding=padding, data_format=data_format)
+    return conv2d(y, point_w, b, strides=(1, 1), padding="VALID", data_format=data_format)
+
+
+@op("deconv2d", "conv", aliases=("conv2d_transpose",))
+def deconv2d(x, w, b=None, strides=(1, 1), padding="SAME", data_format="NHWC"):
+    """Transposed convolution; w: [kH,kW,Cout,Cin] per HWIO with I=Cout of fwd."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "HWIO", data_format))
+    out = lax.conv_transpose(
+        x, w, strides=_pair(strides),
+        padding=padding if isinstance(padding, str) else [(p, p) for p in _pair(padding)],
+        dimension_numbers=dn,
+    ).astype(x.dtype)
+    if b is not None:
+        bshape = (1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1)
+        out = out + b.reshape(bshape).astype(out.dtype)
+    return out
+
+
+@op("upsampling2d", "conv")
+def upsampling2d(x, scale=2, data_format="NHWC"):
+    sh, sw = _pair(scale)
+    if data_format == "NHWC":
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+    return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+
+
+@op("im2col", "conv")
+def im2col(x, kernel, strides=(1, 1), padding=(0, 0), dilation=(1, 1)):
+    """Patch extraction (reference: helpers/im2col). On TPU conv does NOT go
+    through im2col+GEMM — XLA convs hit the MXU directly — but the op exists
+    for parity and for unfold-style models."""
+    kh, kw = _pair(kernel)
+    n, h, w, c = x.shape
+    ph, pw = _pair(padding)
+    x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    patches = lax.conv_general_dilated_patches(
+        x.transpose(0, 3, 1, 2),
+        filter_shape=(kh, kw),
+        window_strides=_pair(strides),
+        padding="VALID",
+        rhs_dilation=_pair(dilation),
+    )
+    return patches
+
+
+# ---------------------------------------------------------------------------
+# Pooling — reduce-window HLOs
+# ---------------------------------------------------------------------------
+
+
+def _pool_dims(kernel, strides, data_format):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(strides)
+    if data_format == "NHWC":
+        return (1, kh, kw, 1), (1, sh, sw, 1)
+    return (1, 1, kh, kw), (1, 1, sh, sw)
+
+
+def _pool_padding(padding, data_format="NHWC"):
+    if isinstance(padding, str):
+        return padding
+    ph, pw = _pair(padding)
+    if data_format == "NHWC":
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+    return [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+
+
+@op("maxpool2d", "pooling", aliases=("max_pool2d", "maxpool"))
+def max_pool2d(x, kernel=(2, 2), strides=None, padding="VALID", data_format="NHWC"):
+    strides = strides or kernel
+    window, strd = _pool_dims(kernel, strides, data_format)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, window, strd, _pool_padding(padding, data_format),
+    )
+
+
+@op("avgpool2d", "pooling", aliases=("avg_pool2d", "avgpool"))
+def avg_pool2d(x, kernel=(2, 2), strides=None, padding="VALID", data_format="NHWC"):
+    strides = strides or kernel
+    window, strd = _pool_dims(kernel, strides, data_format)
+    pad = _pool_padding(padding, data_format)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strd, pad)
+    if padding == "VALID":
+        kh, kw = _pair(kernel)
+        return summed / (kh * kw)
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strd, pad)
+    return summed / counts
+
+
+@op("pnormpool2d", "pooling")
+def pnorm_pool2d(x, kernel=(2, 2), strides=None, padding="VALID", p=2, data_format="NHWC"):
+    strides = strides or kernel
+    window, strd = _pool_dims(kernel, strides, data_format)
+    pad = _pool_padding(padding, data_format)
+    s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strd, pad)
+    return s ** (1.0 / p)
+
+
+@op("global_avg_pool", "pooling", aliases=("globalavgpool",))
+def global_avg_pool(x, data_format="NHWC", keepdims=False):
+    axes = (1, 2) if data_format == "NHWC" else (2, 3)
+    return jnp.mean(x, axis=axes, keepdims=keepdims)
+
+
+@op("global_max_pool", "pooling", aliases=("globalmaxpool",))
+def global_max_pool(x, data_format="NHWC", keepdims=False):
+    axes = (1, 2) if data_format == "NHWC" else (2, 3)
+    return jnp.max(x, axis=axes, keepdims=keepdims)
+
+
+@op("maxpool3d", "pooling")
+def max_pool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    strides = strides or kernel
+    k = (1,) + tuple(kernel) + (1,)
+    s = (1,) + tuple(strides) + (1,)
+    return lax.reduce_window(x, -jnp.inf, lax.max, k, s, padding)
+
+
+@op("avgpool3d", "pooling")
+def avg_pool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    strides = strides or kernel
+    k = (1,) + tuple(kernel) + (1,)
+    s = (1,) + tuple(strides) + (1,)
+    summed = lax.reduce_window(x, 0.0, lax.add, k, s, padding)
+    if padding == "VALID":
+        import math
+
+        return summed / math.prod(kernel)
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, k, s, padding)
+    return summed / counts
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@op("batchnorm", "norm", aliases=("batch_norm", "batchnorm_new"))
+def batchnorm(x, mean, variance, gamma=None, beta=None, eps=1e-5, axis=-1):
+    """Normalize with given statistics (inference form / post-stats train form).
+
+    Reference: generic/nn/batchnorm.cpp + cudnn/batchnorm.cu; on TPU this is a
+    scale-shift chain XLA fuses into the adjacent conv."""
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = lax.rsqrt(variance.astype(jnp.float32) + eps).reshape(shape)
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) * inv
+    if gamma is not None:
+        out = out * gamma.reshape(shape)
+    if beta is not None:
+        out = out + beta.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@op("batchnorm_train", "norm")
+def batchnorm_train(x, gamma, beta, running_mean, running_var, momentum=0.9, eps=1e-5, axis=-1):
+    """Training-mode batchnorm: batch statistics + EMA update.
+
+    Returns (out, new_running_mean, new_running_var)."""
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=reduce_axes)
+    var = jnp.var(xf, axis=reduce_axes)
+    out = batchnorm(x, mean, var, gamma, beta, eps=eps, axis=axis)
+    n = x.size / x.shape[axis % x.ndim]
+    unbiased = var * n / jnp.maximum(n - 1, 1.0)
+    new_mean = momentum * running_mean + (1.0 - momentum) * mean
+    new_var = momentum * running_var + (1.0 - momentum) * unbiased
+    return out, new_mean, new_var
+
+
+@op("layernorm", "norm", aliases=("layer_norm",))
+def layernorm(x, gamma=None, beta=None, eps=1e-5, axis=-1):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out.astype(x.dtype)
+
+
+@op("rmsnorm", "norm")
+def rmsnorm(x, gamma=None, eps=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = xf * lax.rsqrt(ms + eps)
+    if gamma is not None:
+        out = out * gamma
+    return out.astype(x.dtype)
+
+
+@op("standardize", "norm")
+def standardize(x, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    std = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mean) / (std + eps)
+
+
+@op("lrn", "norm", aliases=("local_response_normalization",))
+def lrn(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    """Local response normalization over channels (NHWC last axis)."""
+    sq = jnp.square(x)
+    c = x.shape[-1]
+    pads = [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)]
+    sq = jnp.pad(sq, pads)
+    window = [1] * (x.ndim - 1) + [2 * depth_radius + 1]
+    strides = [1] * x.ndim
+    sums = lax.reduce_window(sq, 0.0, lax.add, window, strides, "VALID")
+    return x / jnp.power(bias + alpha * sums, beta)
+
+
+@op("l2_normalize", "norm")
+def l2_normalize(x, axis=-1, eps=1e-12):
+    return x * lax.rsqrt(jnp.maximum(jnp.sum(jnp.square(x), axis=axis, keepdims=True), eps))
+
+
+@op("moments", "norm")
+def moments(x, axes, keepdims=False):
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axes)
+        var = jnp.squeeze(var, axes)
+    return mean, var
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+op("softmax", "softmax")(lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+op("log_softmax", "softmax")(lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+
+
+@op("softmax_derivative", "softmax")
+def softmax_derivative(x, grad, axis=-1):
+    s = jax.nn.softmax(x, axis=axis)
+    return s * (grad - jnp.sum(grad * s, axis=axis, keepdims=True))
+
+
+# ---------------------------------------------------------------------------
+# Loss ops — reference: ops/declarable/generic/loss/*.cpp and
+# org/nd4j/linalg/lossfunctions/impl/*.java. All support per-example weights
+# and return mean-over-batch by default (ND4J's default reduction).
+# ---------------------------------------------------------------------------
+
+
+def _weighted_mean(per_example, weights):
+    if weights is not None:
+        per_example = per_example * weights
+        return jnp.sum(per_example) / jnp.maximum(jnp.sum(weights), 1e-12)
+    return jnp.mean(per_example)
+
+
+@op("softmax_cross_entropy", "loss", aliases=("softmax_cross_entropy_loss", "mcxent"))
+def softmax_cross_entropy(logits, labels, weights=None, label_smoothing=0.0):
+    """Softmax cross-entropy with one-hot labels [batch, classes]."""
+    if label_smoothing > 0.0:
+        k = labels.shape[-1]
+        labels = labels * (1.0 - label_smoothing) + label_smoothing / k
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per = -jnp.sum(labels * logp, axis=-1)
+    return _weighted_mean(per, weights)
+
+
+@op("sparse_softmax_cross_entropy", "loss")
+def sparse_softmax_cross_entropy(logits, label_indices, weights=None):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per = -jnp.take_along_axis(logp, label_indices[..., None], axis=-1)[..., 0]
+    return _weighted_mean(per, weights)
+
+
+@op("sigmoid_cross_entropy", "loss", aliases=("xent",))
+def sigmoid_cross_entropy(logits, labels, weights=None):
+    z = logits.astype(jnp.float32)
+    per = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    per = jnp.sum(per, axis=tuple(range(1, per.ndim))) if per.ndim > 1 else per
+    return _weighted_mean(per, weights)
+
+
+@op("mse_loss", "loss", aliases=("mean_sqerr_loss", "l2_loss_per_example"))
+def mse_loss(predictions, labels, weights=None):
+    per = jnp.mean(jnp.square(predictions - labels), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("mae_loss", "loss", aliases=("absolute_difference_loss", "l1"))
+def mae_loss(predictions, labels, weights=None):
+    per = jnp.mean(jnp.abs(predictions - labels), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("huber_loss", "loss")
+def huber_loss(predictions, labels, delta=1.0, weights=None):
+    err = predictions - labels
+    abs_err = jnp.abs(err)
+    quad = jnp.minimum(abs_err, delta)
+    per = 0.5 * quad**2 + delta * (abs_err - quad)
+    per = jnp.mean(per, axis=tuple(range(1, per.ndim))) if per.ndim > 1 else per
+    return _weighted_mean(per, weights)
+
+
+@op("hinge_loss", "loss")
+def hinge_loss(predictions, labels, weights=None):
+    """labels in {0,1} mapped to ±1 (ND4J convention)."""
+    signed = 2.0 * labels - 1.0
+    per = jnp.mean(jnp.maximum(0.0, 1.0 - signed * predictions), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("squared_hinge_loss", "loss")
+def squared_hinge_loss(predictions, labels, weights=None):
+    signed = 2.0 * labels - 1.0
+    per = jnp.mean(jnp.square(jnp.maximum(0.0, 1.0 - signed * predictions)), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("log_loss", "loss")
+def log_loss(predictions, labels, eps=1e-7, weights=None):
+    p = jnp.clip(predictions, eps, 1.0 - eps)
+    per = -jnp.mean(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("poisson_loss", "loss")
+def poisson_loss(predictions, labels, weights=None):
+    per = jnp.mean(predictions - labels * jnp.log(jnp.maximum(predictions, 1e-12)), axis=tuple(range(1, predictions.ndim)))
+    return _weighted_mean(per, weights)
+
+
+@op("kl_divergence", "loss", aliases=("kld",))
+def kl_divergence(predictions, labels, eps=1e-12, weights=None):
+    per = jnp.sum(
+        labels * (jnp.log(jnp.maximum(labels, eps)) - jnp.log(jnp.maximum(predictions, eps))),
+        axis=-1,
+    )
+    return _weighted_mean(per, weights)
+
+
+@op("cosine_distance_loss", "loss")
+def cosine_distance_loss(predictions, labels, axis=-1, weights=None):
+    num = jnp.sum(predictions * labels, axis=axis)
+    np_ = jnp.sqrt(jnp.sum(jnp.square(predictions), axis=axis))
+    nl = jnp.sqrt(jnp.sum(jnp.square(labels), axis=axis))
+    per = 1.0 - num / jnp.maximum(np_ * nl, 1e-12)
+    return _weighted_mean(per, weights)
+
+
+@op("l2_loss", "loss")
+def l2_loss(x):
+    return 0.5 * jnp.sum(jnp.square(x))
+
+
+@op("ctc_loss", "loss")
+def ctc_loss(log_probs, labels, logit_lengths, label_lengths, blank_id=0):
+    """CTC loss (reference: cudnn ctcloss helper). Uses optax's TPU-friendly
+    implementation (dynamic-programming over lax.scan)."""
+    import optax
+
+    logit_paddings = (
+        jnp.arange(log_probs.shape[1])[None, :] >= logit_lengths[:, None]
+    ).astype(jnp.float32)
+    label_paddings = (
+        jnp.arange(labels.shape[1])[None, :] >= label_lengths[:, None]
+    ).astype(jnp.float32)
+    return jnp.mean(
+        optax.ctc_loss(log_probs, logit_paddings, labels, label_paddings, blank_id=blank_id)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Attention — reference: generic/nn/multi_head_dot_product_attention.cpp and
+# dot_product_attention.cpp (the only attention in the reference, single
+# device). The TPU-native blockwise/ring variants live in
+# deeplearning4j_tpu/parallel/ring_attention.py.
+# ---------------------------------------------------------------------------
+
+
+@op("dot_product_attention", "attention")
+def dot_product_attention(q, k, v, mask=None, scale=None, is_causal=False):
+    """Scaled dot-product attention.
+
+    q,k,v: [..., T, d]. Computes softmax(q kᵀ · scale + mask) v with fp32
+    softmax accumulation (bf16-safe)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if is_causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((tq, tk), dtype=bool), k=tk - tq)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+@op("multi_head_dot_product_attention", "attention", aliases=("multihead_attention",))
+def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None, is_causal=False):
+    """Full MHA: project, split heads, attend, merge, project.
+
+    x_q: [B,Tq,D], x_kv: [B,Tk,D]; wq/wk/wv: [D, H*dh]; wo: [H*dh, D]."""
+    b, tq, _ = x_q.shape
+    tk = x_kv.shape[1]
+
+    def split(x, w):
+        y = jnp.einsum("btd,dh->bth", x, w)
+        return y.reshape(b, -1, num_heads, y.shape[-1] // num_heads).transpose(0, 2, 1, 3)
+
+    q, k, v = split(x_q, wq), split(x_kv, wk), split(x_kv, wv)
+    ctx = dot_product_attention(q, k, v, mask=mask, is_causal=is_causal)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, tq, -1)
+    return jnp.einsum("bth,hd->btd", ctx, wo)
+
+
+# ---------------------------------------------------------------------------
+# Embedding / misc nn
+# ---------------------------------------------------------------------------
+
+
+@op("embedding_lookup", "nn_misc")
+def embedding_lookup(table, ids):
+    return jnp.take(table, ids, axis=0)
+
+
+@op("bias_add", "nn_misc")
+def bias_add(x, b, data_format="NHWC"):
+    if data_format == "NCHW" and x.ndim == 4:
+        return x + b.reshape(1, -1, 1, 1)
+    return x + b
+
+
+@op("xw_plus_b", "nn_misc", aliases=("linear_layer",))
+def xw_plus_b(x, w, b):
+    out = jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    return out + b.astype(out.dtype)
+
+
+@op("batch_dot", "nn_misc")
+def batch_dot(a, b):
+    return jnp.einsum("b...i,b...i->b", a, b)

@@ -1,0 +1,118 @@
+"""Random ops — splittable counter-based RNG.
+
+Reference parity: libnd4j's Philox-style native RNG
+(libnd4j/include/helpers/RandomLauncher.h, graph/RandomGenerator.h,
+loops/cpu/random.hpp — path-cite, mount empty this round) and the nd4j-api
+random op classes (org/nd4j/linalg/api/ops/random/impl/**).
+
+TPU-native: JAX's threefry/rbg keys lower to the ``rng-bit-generator`` HLO.
+Keys are explicit arguments — functionally pure, reproducible under jit and
+across shardings (the reference reproduces this property via synchronized
+seeds/states on each device).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+op("random_split_key", "random", differentiable=False)(
+    lambda key, num=2: jax.random.split(key, num)
+)
+
+
+@op("random_uniform", "random", aliases=("uniform", "randomuniform"), differentiable=False)
+def random_uniform(key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=minval, maxval=maxval)
+
+
+@op("random_normal", "random", aliases=("normal", "randomnormal", "gaussian"), differentiable=False)
+def random_normal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(key, shape, dtype=dtype)
+
+
+@op("random_truncated_normal", "random", aliases=("truncatednormal",), differentiable=False)
+def truncated_normal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=dtype)
+
+
+@op("random_lognormal", "random", aliases=("lognormal",), differentiable=False)
+def lognormal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return jnp.exp(mean + stddev * jax.random.normal(key, shape, dtype=dtype))
+
+
+@op("random_bernoulli", "random", aliases=("bernoulli",), differentiable=False)
+def bernoulli(key, shape, p=0.5, dtype=jnp.float32):
+    return jax.random.bernoulli(key, p, shape).astype(dtype)
+
+
+@op("random_binomial", "random", aliases=("binomial",), differentiable=False)
+def binomial(key, shape, n, p, dtype=jnp.float32):
+    return jax.random.binomial(key, n, p, shape=shape).astype(dtype)
+
+
+@op("random_exponential", "random", aliases=("exponential",), differentiable=False)
+def exponential(key, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(key, shape, dtype=dtype) / lam
+
+
+@op("random_gamma", "random", differentiable=False)
+def gamma(key, shape, alpha, dtype=jnp.float32):
+    return jax.random.gamma(key, alpha, shape, dtype=dtype)
+
+
+@op("random_poisson", "random", differentiable=False)
+def poisson(key, shape, lam, dtype=jnp.int32):
+    return jax.random.poisson(key, lam, shape, dtype=dtype)
+
+
+@op("random_categorical", "random", aliases=("multinomial",), differentiable=False)
+def categorical(key, logits, num_samples=1):
+    return jax.random.categorical(
+        key, logits[..., None, :].repeat(num_samples, axis=-2), axis=-1
+    )
+
+
+@op("random_shuffle", "random", differentiable=False)
+def shuffle(key, x, axis=0):
+    return jax.random.permutation(key, x, axis=axis)
+
+
+@op("random_choice", "random", differentiable=False)
+def choice(key, x, shape, replace=True, p=None):
+    return jax.random.choice(key, x, shape=shape, replace=replace, p=p)
+
+
+@op("dropout", "random")
+def dropout(x, key, rate, training=True):
+    """Inverted dropout (keeps expectation); identity when not training.
+
+    Reference: libnd4j generic/nn/dropout.cpp + the cuDNN dropout helper —
+    on TPU this is a fused bernoulli-mask multiply XLA folds into neighbors.
+    """
+    if not training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@op("dropout_inverted", "random")
+def dropout_inverted(x, key, p, training=True):
+    """ND4J's legacy API passes p = keep probability."""
+    return dropout(x, key, 1.0 - p, training=training)
+
+
+@op("alpha_dropout", "random")
+def alpha_dropout(x, key, rate, training=True):
+    """SELU-compatible dropout (AlphaDropout layer parity)."""
+    if not training or rate == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)

@@ -1,0 +1,151 @@
+"""The op table — the single dispatch waist of the framework.
+
+Reference parity: libnd4j's ``OpRegistrator`` + ``DeclarableOp`` registry
+(libnd4j/include/ops/declarable/OpRegistrator.*, DeclarableOp.h — path-cite,
+mount empty this round) and the JVM-side ``OpExecutioner`` interface
+(org/nd4j/linalg/api/ops/executioner/OpExecutioner.java). In the reference,
+every numeric operation in the stack funnels through ``OpExecutioner.exec``
+into a name/enum-keyed native registry (SURVEY.md §1 "single-waist design").
+
+TPU-native design: ops here are *traceable JAX functions*, not eager kernels.
+Executing an op under ``jax.jit`` stages it into one XLA program — the whole
+graph compiles to a single device launch instead of the reference's
+per-op JNI crossing (SURVEY.md §3.1 note). ``exec_op`` gives the eager /
+by-name path (used by the SameDiff-parity session, TF import, and tests);
+Python callers on the hot path simply call the registered function, which is
+identical by construction.
+
+Each ``OpDef`` carries:
+- ``fn``       — the lowering: a pure JAX function (jnp/lax/pallas).
+- ``category`` — the reference's op family (transform_float, reduce_same,
+  pairwise, broadcast, scalar, indexreduce, summarystats, random, custom…)
+  so the inventory can be diffed against libnd4j's enum families (SURVEY §2.1 N2/N3).
+- ``differentiable`` — whether reverse-mode AD is supported. Gradients come
+  from JAX's reverse-mode transform over the same function — the equivalent of
+  each reference op class's hand-written ``doDiff``
+  (org/nd4j/autodiff/functions/DifferentialFunction.java) with none of the
+  per-op gradient code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class OpDef:
+    """A registered op: name → lowering + metadata."""
+
+    name: str
+    fn: Callable[..., Any]
+    category: str
+    aliases: tuple[str, ...] = ()
+    differentiable: bool = True
+    doc: str = ""
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_ALIASES: Dict[str, str] = {}
+
+
+class OpNotFoundError(KeyError):
+    pass
+
+
+def register(
+    name: str,
+    fn: Callable[..., Any],
+    *,
+    category: str,
+    aliases: Iterable[str] = (),
+    differentiable: bool = True,
+    doc: str = "",
+) -> OpDef:
+    """Register an op. Last registration wins (platform-helper override parity:
+    the reference lets cuDNN/oneDNN platform helpers shadow generic impls at
+    exec time — here a Pallas lowering can shadow a jnp one the same way)."""
+    opdef = OpDef(
+        name=name,
+        fn=fn,
+        category=category,
+        aliases=tuple(aliases),
+        differentiable=differentiable,
+        doc=doc or (fn.__doc__ or ""),
+    )
+    _REGISTRY[name] = opdef
+    for alias in opdef.aliases:
+        _ALIASES[alias] = name
+    return opdef
+
+
+def op(
+    name: str,
+    category: str,
+    *,
+    aliases: Iterable[str] = (),
+    differentiable: bool = True,
+) -> Callable[[Callable], Callable]:
+    """Decorator form of :func:`register`. Returns the function unchanged so op
+    modules read as plain JAX code."""
+
+    def wrap(fn: Callable) -> Callable:
+        register(
+            name, fn, category=category, aliases=aliases, differentiable=differentiable
+        )
+        return fn
+
+    return wrap
+
+
+def get_op(name: str) -> OpDef:
+    key = name if name in _REGISTRY else _ALIASES.get(name, name)
+    try:
+        return _REGISTRY[key]
+    except KeyError:
+        raise OpNotFoundError(
+            f"Op {name!r} is not registered (have {len(_REGISTRY)} ops)"
+        ) from None
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY or name in _ALIASES
+
+
+def exec_op(name: str, *args, **kwargs):
+    """Execute an op by name — ``OpExecutioner.exec`` parity. Traceable: inside
+    ``jax.jit`` this stages into the surrounding XLA computation."""
+    return get_op(name)(*args, **kwargs)
+
+
+def list_ops(category: Optional[str] = None) -> list[str]:
+    if category is None:
+        return sorted(_REGISTRY)
+    return sorted(n for n, o in _REGISTRY.items() if o.category == category)
+
+
+def categories() -> dict[str, int]:
+    out: dict[str, int] = {}
+    for o in _REGISTRY.values():
+        out[o.category] = out.get(o.category, 0) + 1
+    return out
+
+
+def op_count() -> int:
+    return len(_REGISTRY)
+
+
+def shape_of(name: str, *args, **kwargs):
+    """Abstract shape/dtype inference without executing — parity with the
+    reference's per-op shape functions (``DeclarableOp::calculateOutputShape``,
+    invoked from NativeOpExecutioner via NativeOps.calculateOutputShapes2).
+    On TPU this is ``jax.eval_shape`` over the same lowering: one source of
+    truth for shapes and execution. Positional args are abstract arrays
+    (ShapeDtypeStruct or concrete); kwargs are treated as static config."""
+    fn = get_op(name).fn
+    return jax.eval_shape(lambda *arrays: fn(*arrays, **kwargs), *args)

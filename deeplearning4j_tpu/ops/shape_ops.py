@@ -1,0 +1,288 @@
+"""Shape / indexing / gather-scatter ops.
+
+Reference parity: libnd4j shape declarable ops
+(libnd4j/include/ops/declarable/generic/shape/*.cpp — reshape.cpp, permute.cpp,
+concat.cpp, stack.cpp, tile.cpp … — and generic/transforms/gather.cpp,
+scatter_upd.cpp; path-cite, mount empty this round).
+
+TPU-native notes: the reference's NDArray carries strides and supports O(1)
+views; XLA has no user-visible strides — reshape/transpose/slice are logical
+ops the compiler folds into layouts. Gather/scatter lower to the XLA
+gather/scatter HLOs which TPU executes natively. All shapes here are static
+(jit-traceable); dynamic row counts must be handled by masking upstream.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+op("reshape", "shape")(lambda x, shape: jnp.reshape(x, shape))
+op("ravel", "shape", aliases=("flatten",))(jnp.ravel)
+op("transpose", "shape")(lambda x, axes=None: jnp.transpose(x, axes))
+op("permute", "shape")(lambda x, axes: jnp.transpose(x, axes))
+op("swapaxes", "shape")(jnp.swapaxes)
+op("moveaxis", "shape")(jnp.moveaxis)
+op("expand_dims", "shape")(jnp.expand_dims)
+op("squeeze", "shape")(jnp.squeeze)
+op("broadcast_to", "shape")(jnp.broadcast_to)
+op("tile", "shape")(jnp.tile)
+op("repeat", "shape")(jnp.repeat)
+op("concat", "shape", aliases=("concatenate",))(
+    lambda arrays, axis=0: jnp.concatenate(arrays, axis=axis)
+)
+op("stack", "shape", aliases=("parallel_stack",))(
+    lambda arrays, axis=0: jnp.stack(arrays, axis=axis)
+)
+op("unstack", "shape", aliases=("unbind",))(
+    lambda x, axis=0: [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+)
+op("split", "shape")(lambda x, num_or_sections, axis=0: jnp.split(x, num_or_sections, axis=axis))
+op("split_v", "shape")(
+    lambda x, sizes, axis=0: jnp.split(x, list(jnp.cumsum(jnp.array(sizes))[:-1]), axis=axis)
+)
+op("flip", "shape", aliases=("reverse",))(jnp.flip)
+op("roll", "shape")(jnp.roll)
+op("rot90", "shape")(jnp.rot90)
+op("slice", "shape")(lambda x, begin, sizes: lax.slice(x, begin, [b + s for b, s in zip(begin, sizes)]))
+op("strided_slice", "shape")(
+    lambda x, begin, end, strides=None: lax.slice(x, begin, end, strides)
+)
+op("cast", "shape", differentiable=False)(lambda x, dtype: x.astype(dtype))
+op("size", "shape", differentiable=False)(lambda x: x.size)
+op("rank", "shape", differentiable=False)(lambda x: x.ndim)
+op("shape_of", "shape", differentiable=False)(lambda x: jnp.array(x.shape, dtype=jnp.int64))
+
+
+@op("pad", "shape")
+def pad(x, paddings, mode="constant", constant_value=0.0):
+    """Pad; paddings is [(lo, hi), ...] per dim (TF-style)."""
+    return jnp.pad(x, paddings, mode=mode, constant_values=constant_value) if mode == "constant" else jnp.pad(x, paddings, mode=mode)
+
+
+@op("gather", "gather_scatter")
+def gather(x, indices, axis=0):
+    return jnp.take(x, indices, axis=axis)
+
+
+@op("gather_nd", "gather_scatter")
+def gather_nd(x, indices):
+    """TF-style gather_nd: indices [..., k] index the first k dims of x."""
+    indices = jnp.asarray(indices)
+    return x[tuple(jnp.moveaxis(indices, -1, 0))]
+
+
+@op("take", "gather_scatter")
+def take(x, indices, axis=None):
+    return jnp.take(x, indices, axis=axis)
+
+
+@op("take_along_axis", "gather_scatter")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@op("scatter_update", "gather_scatter")
+def scatter_update(ref, indices, updates):
+    return ref.at[indices].set(updates)
+
+
+@op("scatter_add", "gather_scatter")
+def scatter_add(ref, indices, updates):
+    return ref.at[indices].add(updates)
+
+
+@op("scatter_sub", "gather_scatter")
+def scatter_sub(ref, indices, updates):
+    return ref.at[indices].add(-updates)
+
+
+@op("scatter_mul", "gather_scatter")
+def scatter_mul(ref, indices, updates):
+    return ref.at[indices].multiply(updates)
+
+
+@op("scatter_div", "gather_scatter")
+def scatter_div(ref, indices, updates):
+    return ref.at[indices].divide(updates)
+
+
+@op("scatter_max", "gather_scatter")
+def scatter_max(ref, indices, updates):
+    return ref.at[indices].max(updates)
+
+
+@op("scatter_min", "gather_scatter")
+def scatter_min(ref, indices, updates):
+    return ref.at[indices].min(updates)
+
+
+@op("scatter_nd", "gather_scatter")
+def scatter_nd(indices, updates, shape):
+    """TF-style scatter_nd (duplicate indices accumulate)."""
+    zeros = jnp.zeros(shape, dtype=updates.dtype)
+    indices = jnp.asarray(indices)
+    return zeros.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("onehot", "gather_scatter", aliases=("one_hot",), differentiable=False)
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, axis=-1, dtype=jnp.float32):
+    oh = jnp.arange(depth) == jnp.expand_dims(indices, -1)
+    oh = jnp.where(oh, on_value, off_value).astype(dtype)
+    if axis != -1:
+        oh = jnp.moveaxis(oh, -1, axis)
+    return oh
+
+
+@op("dynamic_partition", "gather_scatter", differentiable=False)
+def dynamic_partition(x, partitions, num_partitions):
+    """Static-shape-friendly variant: returns masked copies (one per partition)
+    rather than ragged outputs (XLA needs static shapes; the reference op is
+    inherently dynamic — callers inside jit should use the masks)."""
+    return [jnp.where((partitions == i)[(...,) + (None,) * (x.ndim - partitions.ndim)], x, 0) for i in range(num_partitions)]
+
+
+@op("dynamic_stitch", "gather_scatter", differentiable=False)
+def dynamic_stitch(indices_list, data_list):
+    """TF semantics: output rows = max(index)+1; later lists win on overlap.
+    Requires concrete indices (the output shape depends on their values, which
+    XLA cannot defer) — call outside jit or with static index arrays."""
+    import numpy as np
+
+    n = int(max(int(np.asarray(i).max()) for i in indices_list)) + 1
+    first = data_list[0]
+    out = jnp.zeros((n,) + first.shape[1:], dtype=first.dtype)
+    for idx, dat in zip(indices_list, data_list):
+        out = out.at[idx.reshape(-1)].set(dat.reshape((-1,) + first.shape[1:]))
+    return out
+
+
+@op("sort", "sorting", differentiable=False)
+def sort(x, axis=-1, descending=False):
+    y = jnp.sort(x, axis=axis)
+    return jnp.flip(y, axis=axis) if descending else y
+
+
+@op("argsort", "sorting", differentiable=False)
+def argsort(x, axis=-1, descending=False):
+    y = jnp.argsort(x, axis=axis)
+    return jnp.flip(y, axis=axis) if descending else y
+
+
+@op("top_k", "sorting", differentiable=False)
+def top_k(x, k, sorted=True):
+    return lax.top_k(x, k)
+
+
+@op("in_top_k", "sorting", differentiable=False)
+def in_top_k(predictions, targets, k):
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@op("unique", "sorting", differentiable=False)
+def unique(x, size=None):
+    return jnp.unique(x, size=size)
+
+
+@op("searchsorted", "sorting", differentiable=False)
+def searchsorted(sorted_seq, values, side="left"):
+    return jnp.searchsorted(sorted_seq, values, side=side)
+
+
+@op("linspace", "creation", differentiable=False)
+def linspace(start, stop, num, dtype=jnp.float32):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+@op("arange", "creation", aliases=("range",), differentiable=False)
+def arange(start, stop=None, step=1, dtype=None):
+    return jnp.arange(start, stop, step, dtype=dtype)
+
+
+@op("eye", "creation", differentiable=False)
+def eye(n, m=None, dtype=jnp.float32):
+    return jnp.eye(n, m, dtype=dtype)
+
+
+@op("zeros", "creation", differentiable=False)
+def zeros(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype=dtype)
+
+
+@op("ones", "creation", differentiable=False)
+def ones(shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype=dtype)
+
+
+@op("full", "creation", aliases=("fill",), differentiable=False)
+def full(shape, value, dtype=None):
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@op("meshgrid", "creation", differentiable=False)
+def meshgrid(*arrays, indexing="xy"):
+    return jnp.meshgrid(*arrays, indexing=indexing)
+
+
+@op("space_to_depth", "shape")
+def space_to_depth(x, block_size, data_format="NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(n, h // b, w // b, c * b * b)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("depth_to_space", "shape")
+def depth_to_space(x, block_size, data_format="NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h, w, b, b, c // (b * b))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(n, h * b, w * b, c // (b * b))
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("batch_to_space", "shape")
+def batch_to_space(x, block_shape, crops):
+    raise NotImplementedError("batch_to_space: pending TF-import milestone")
+
+
+@op("segment_sum", "segment", differentiable=False)
+def segment_sum(data, segment_ids, num_segments):
+    import jax.ops
+
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@op("segment_max", "segment", differentiable=False)
+def segment_max(data, segment_ids, num_segments):
+    import jax.ops
+
+    return jax.ops.segment_max(data, segment_ids, num_segments)
+
+
+@op("segment_min", "segment", differentiable=False)
+def segment_min(data, segment_ids, num_segments):
+    import jax.ops
+
+    return jax.ops.segment_min(data, segment_ids, num_segments)
+
+
+@op("segment_mean", "segment", differentiable=False)
+def segment_mean(data, segment_ids, num_segments):
+    import jax.ops
+
+    sums = jax.ops.segment_sum(data, segment_ids, num_segments)
+    counts = jax.ops.segment_sum(jnp.ones_like(segment_ids, dtype=data.dtype), segment_ids, num_segments)
+    return sums / jnp.maximum(counts, 1).reshape((-1,) + (1,) * (data.ndim - 1))

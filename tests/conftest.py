@@ -1,0 +1,46 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding paths are
+exercised without TPU hardware — the same trick the reference uses for
+"distributed without a cluster" (embedded Aeron MediaDriver + local[N] Spark;
+SURVEY.md §4). Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the driver environment presets JAX_PLATFORMS=axon (the one real
+# TPU chip); tests need determinism, fp32 precision, and 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# fp64 available for gradient checks (GradientCheckUtil parity: exact central
+# differences in double precision).
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The env var alone does not win over the preset axon platform in this image;
+# the config update does (must run before any device/computation is touched).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,230 @@
+"""NN-op tests: conv/pool/norm/softmax/loss/attention vs independent references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ops
+
+
+def _np_conv2d_valid(x, w, strides=(1, 1)):
+    """Naive NHWC/HWIO conv, VALID padding — independent reference."""
+    n, h, wdt, cin = x.shape
+    kh, kw, _, cout = w.shape
+    sh, sw = strides
+    oh = (h - kh) // sh + 1
+    ow = (wdt - kw) // sw + 1
+    out = np.zeros((n, oh, ow, cout), dtype=np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * sh : i * sh + kh, j * sw : j * sw + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def test_conv2d_valid_matches_naive(rng):
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 5)).astype(np.float32)
+    out = ops.exec_op("conv2d", jnp.asarray(x), jnp.asarray(w), padding="VALID")
+    np.testing.assert_allclose(out, _np_conv2d_valid(x, w), rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_stride_and_bias(rng):
+    x = rng.standard_normal((1, 9, 9, 2)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 2, 4)).astype(np.float32)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    out = ops.exec_op("conv2d", jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                      strides=(2, 2), padding="VALID")
+    np.testing.assert_allclose(
+        out, _np_conv2d_valid(x, w, (2, 2)) + b, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_conv2d_same_shape():
+    x = jnp.zeros((2, 14, 14, 8))
+    w = jnp.zeros((3, 3, 8, 16))
+    out = ops.exec_op("conv2d", x, w, padding="SAME")
+    assert out.shape == (2, 14, 14, 16)
+
+
+def test_depthwise_conv_shape():
+    x = jnp.zeros((2, 8, 8, 6))
+    w = jnp.zeros((3, 3, 6, 2))
+    out = ops.exec_op("depthwise_conv2d", x, w, padding="SAME")
+    assert out.shape == (2, 8, 8, 12)
+
+
+def test_conv1d(rng):
+    x = rng.standard_normal((2, 10, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 4)).astype(np.float32)
+    out = ops.exec_op("conv1d", jnp.asarray(x), jnp.asarray(w), padding="VALID")
+    assert out.shape == (2, 8, 4)
+    # spot check one element via naive conv
+    expect0 = np.tensordot(x[0, 0:3, :], w, axes=([0, 1], [0, 1]))
+    np.testing.assert_allclose(out[0, 0], expect0, rtol=1e-4)
+
+
+def test_maxpool_avgpool(rng):
+    x = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    mx = ops.exec_op("maxpool2d", jnp.asarray(x), kernel=(2, 2))
+    av = ops.exec_op("avgpool2d", jnp.asarray(x), kernel=(2, 2))
+    assert mx.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(mx[0, 0, 0], x[0, :2, :2].max(axis=(0, 1)))
+    np.testing.assert_allclose(av[0, 0, 0], x[0, :2, :2].mean(axis=(0, 1)), rtol=1e-6)
+
+
+def test_global_pooling(rng):
+    x = rng.standard_normal((2, 5, 5, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        ops.exec_op("global_avg_pool", jnp.asarray(x)), x.mean(axis=(1, 2)), rtol=1e-5
+    )
+
+
+def test_batchnorm_inference(rng):
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    mean = x.mean(axis=0)
+    var = x.var(axis=0)
+    gamma = rng.standard_normal((6,)).astype(np.float32)
+    beta = rng.standard_normal((6,)).astype(np.float32)
+    out = ops.exec_op("batchnorm", jnp.asarray(x), jnp.asarray(mean), jnp.asarray(var),
+                      jnp.asarray(gamma), jnp.asarray(beta), eps=1e-5)
+    expect = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_updates_running_stats(rng):
+    x = rng.standard_normal((16, 4)).astype(np.float32) * 3 + 1
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    out, new_mean, new_var = ops.exec_op(
+        "batchnorm_train", jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta),
+        jnp.zeros(4), jnp.ones(4), momentum=0.0,
+    )
+    np.testing.assert_allclose(np.asarray(out).mean(axis=0), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(new_mean, x.mean(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(new_var, x.var(axis=0, ddof=1), rtol=1e-3)
+
+
+def test_layernorm(rng):
+    x = rng.standard_normal((3, 8)).astype(np.float32)
+    out = np.asarray(ops.exec_op("layernorm", jnp.asarray(x)))
+    np.testing.assert_allclose(out.mean(axis=-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=-1), 1.0, atol=1e-3)
+
+
+def test_softmax_and_logsoftmax(rng):
+    x = rng.standard_normal((5, 9)).astype(np.float32)
+    s = np.asarray(ops.exec_op("softmax", jnp.asarray(x)))
+    np.testing.assert_allclose(s.sum(axis=-1), 1.0, rtol=1e-5)
+    ref = np.exp(x) / np.exp(x).sum(axis=-1, keepdims=True)
+    np.testing.assert_allclose(s, ref, rtol=1e-4)
+    np.testing.assert_allclose(
+        ops.exec_op("log_softmax", jnp.asarray(x)), np.log(ref), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_softmax_cross_entropy_matches_manual(rng):
+    logits = rng.standard_normal((4, 6)).astype(np.float32)
+    labels = np.eye(6, dtype=np.float32)[[0, 3, 2, 5]]
+    loss = ops.exec_op("softmax_cross_entropy", jnp.asarray(logits), jnp.asarray(labels))
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    expect = -(labels * logp).sum(-1).mean()
+    np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+
+def test_sparse_vs_dense_xent(rng):
+    logits = rng.standard_normal((4, 6)).astype(np.float32)
+    idx = np.array([1, 0, 5, 2])
+    dense = ops.exec_op("softmax_cross_entropy", jnp.asarray(logits),
+                        jnp.asarray(np.eye(6, dtype=np.float32)[idx]))
+    sparse = ops.exec_op("sparse_softmax_cross_entropy", jnp.asarray(logits), jnp.asarray(idx))
+    np.testing.assert_allclose(dense, sparse, rtol=1e-6)
+
+
+def test_sigmoid_xent_stable_large_logits():
+    logits = jnp.array([[100.0, -100.0]])
+    labels = jnp.array([[1.0, 0.0]])
+    loss = ops.exec_op("sigmoid_cross_entropy", logits, labels)
+    assert np.isfinite(float(loss)) and float(loss) < 1e-4
+
+
+def test_mse_huber(rng):
+    p = rng.standard_normal((8, 3)).astype(np.float32)
+    t = rng.standard_normal((8, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        ops.exec_op("mse_loss", jnp.asarray(p), jnp.asarray(t)),
+        np.mean((p - t) ** 2), rtol=1e-5,
+    )
+    h = float(ops.exec_op("huber_loss", jnp.asarray(p), jnp.asarray(t), delta=1e9))
+    np.testing.assert_allclose(h, 0.5 * np.mean((p - t) ** 2), rtol=1e-4)
+
+
+def test_attention_uniform_when_keys_identical(rng):
+    # identical keys → softmax uniform → output = mean of values
+    q = jnp.asarray(rng.standard_normal((1, 1, 4, 8)).astype(np.float32))
+    k = jnp.ones((1, 1, 6, 8), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 1, 6, 8)).astype(np.float32))
+    out = ops.exec_op("dot_product_attention", q, k, v)
+    np.testing.assert_allclose(
+        out[0, 0, 0], np.asarray(v)[0, 0].mean(axis=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_attention_causal_mask(rng):
+    q = jnp.asarray(rng.standard_normal((1, 1, 4, 8)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((1, 1, 4, 8)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((1, 1, 4, 8)).astype(np.float32))
+    causal = ops.exec_op("dot_product_attention", q, k, v, is_causal=True)
+    # position 0 attends only to key 0 → equals v[0]
+    np.testing.assert_allclose(causal[0, 0, 0], v[0, 0, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_mha_shapes(rng):
+    b, t, d, h = 2, 5, 16, 4
+    x = jnp.asarray(rng.standard_normal((b, t, d)).astype(np.float32))
+    wq = jnp.asarray(rng.standard_normal((d, d)).astype(np.float32)) * 0.1
+    wo = jnp.asarray(rng.standard_normal((d, d)).astype(np.float32)) * 0.1
+    out = ops.exec_op("multi_head_dot_product_attention", x, x, wq, wq, wq, wo, h)
+    assert out.shape == (b, t, d)
+
+
+def test_conv_grad_flows(rng):
+    x = jnp.asarray(rng.standard_normal((1, 6, 6, 2)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 2, 3)).astype(np.float32))
+
+    def loss(w):
+        return jnp.sum(ops.exec_op("conv2d", x, w, padding="VALID") ** 2)
+
+    g = jax.grad(loss)(w)
+    assert g.shape == w.shape
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_pool_explicit_padding_nchw(rng):
+    # regression: explicit (ph, pw) padding must land on H/W for NCHW too
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    out = ops.exec_op("maxpool2d", jnp.asarray(x), kernel=(3, 3), strides=(1, 1),
+                      padding=(1, 1), data_format="NCHW")
+    assert out.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(out[0, 0, 1, 1], x[0, 0, :3, :3].max(), rtol=1e-6)
+
+
+def test_avgpool3d_same_count_normalized():
+    x = jnp.ones((1, 3, 3, 3, 1))
+    out = ops.exec_op("avgpool3d", x, kernel=(2, 2, 2), strides=(1, 1, 1), padding="SAME")
+    # all-ones input: correct count normalization gives exactly 1 everywhere
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)
+
+
+def test_conv3d_bias_ncdhw(rng):
+    x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)  # NCDHW, C=2
+    w = rng.standard_normal((1, 1, 1, 2, 2)).astype(np.float32)
+    b = np.array([10.0, 20.0], dtype=np.float32)
+    out = ops.exec_op("conv3d", jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                      data_format="NCDHW")
+    out0 = ops.exec_op("conv3d", jnp.asarray(x), jnp.asarray(w), None,
+                       data_format="NCDHW")
+    np.testing.assert_allclose(np.asarray(out) - np.asarray(out0),
+                               np.array([10.0, 20.0]).reshape(1, 2, 1, 1, 1)
+                               * np.ones_like(out0), rtol=1e-5)

@@ -1,0 +1,206 @@
+"""Op-table tests — registry semantics + array-op correctness vs numpy.
+
+Models the reference's Nd4jTestsC / CustomOpsTests suites (SURVEY.md §4): op
+semantics validated against an independent reference implementation (numpy),
+plus registry/dispatch behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ops
+
+
+def test_registry_size_and_categories():
+    assert ops.op_count() > 200, f"op table too small: {ops.op_count()}"
+    cats = ops.categories()
+    for family in [
+        "transform_float", "transform_same", "pairwise", "scalar", "reduce",
+        "indexreduce", "summarystats", "reduce3", "linalg", "conv", "pooling",
+        "norm", "loss", "random", "shape", "gather_scatter", "attention",
+    ]:
+        assert family in cats, f"missing op family {family}"
+
+
+def test_alias_resolution():
+    assert ops.get_op("mmul") is ops.get_op("matmul")
+    assert ops.get_op("silu") is ops.get_op("swish")
+    assert ops.has_op("old_mul")
+    with pytest.raises(ops.OpNotFoundError):
+        ops.get_op("no_such_op_xyz")
+
+
+def test_exec_by_name_matches_direct_call(rng):
+    x = jnp.asarray(rng.standard_normal((4, 5)), dtype=jnp.float32)
+    np.testing.assert_allclose(ops.exec_op("exp", x), np.exp(np.asarray(x)), rtol=1e-6)
+    np.testing.assert_allclose(
+        ops.exec_op("sum", x, axis=1), np.asarray(x).sum(axis=1), rtol=1e-6
+    )
+
+
+def test_exec_op_traceable_under_jit(rng):
+    x = jnp.asarray(rng.standard_normal((8, 8)), dtype=jnp.float32)
+
+    @jax.jit
+    def f(x):
+        y = ops.exec_op("multiply", x, x)
+        return ops.exec_op("sum", y)
+
+    np.testing.assert_allclose(f(x), (np.asarray(x) ** 2).sum(), rtol=1e-5)
+
+
+def test_shape_inference_without_execution():
+    x = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((128, 64), jnp.float32)
+    out = ops.shape_of("matmul", x, w)
+    assert out.shape == (32, 64)
+    assert out.dtype == jnp.float32
+
+
+UNARY_CASES = [
+    ("exp", np.exp), ("log1p", np.log1p), ("sqrt", np.sqrt), ("tanh", np.tanh),
+    ("abs", np.abs), ("floor", np.floor), ("square", np.square),
+    ("sign", np.sign), ("neg", np.negative),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES)
+def test_unary_transforms(name, ref, rng):
+    x = np.abs(rng.standard_normal((3, 7)).astype(np.float32)) + 0.1
+    np.testing.assert_allclose(ops.exec_op(name, jnp.asarray(x)), ref(x), rtol=1e-5)
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES)
+def test_pairwise_with_broadcasting(name, ref, rng):
+    x = np.abs(rng.standard_normal((4, 1, 5)).astype(np.float32)) + 0.5
+    y = np.abs(rng.standard_normal((3, 1)).astype(np.float32)) + 0.5
+    np.testing.assert_allclose(
+        ops.exec_op(name, jnp.asarray(x), jnp.asarray(y)), ref(x, y), rtol=1e-5
+    )
+
+
+def test_reductions(rng):
+    x = rng.standard_normal((6, 4, 5)).astype(np.float32)
+    jx = jnp.asarray(x)
+    np.testing.assert_allclose(ops.exec_op("mean", jx, axis=(0, 2)), x.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(ops.exec_op("norm2", jx, axis=1), np.linalg.norm(x, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(ops.exec_op("argmax", jx, axis=-1), x.argmax(axis=-1))
+    # ND4J variance defaults to bias-corrected (ddof=1).
+    np.testing.assert_allclose(ops.exec_op("var", jx, axis=0), x.var(axis=0, ddof=1), rtol=1e-4)
+
+
+def test_reduce3_distances(rng):
+    x = rng.standard_normal((10,)).astype(np.float32)
+    y = rng.standard_normal((10,)).astype(np.float32)
+    np.testing.assert_allclose(
+        ops.exec_op("euclidean", jnp.asarray(x), jnp.asarray(y)),
+        np.linalg.norm(x - y), rtol=1e-5,
+    )
+    cos = np.dot(x, y) / (np.linalg.norm(x) * np.linalg.norm(y))
+    np.testing.assert_allclose(
+        ops.exec_op("cosinesimilarity", jnp.asarray(x), jnp.asarray(y)), cos, rtol=1e-5
+    )
+
+
+def test_matmul_bf16_accumulates_fp32():
+    # bf16 inputs with fp32 accumulation should beat naive bf16 accumulation.
+    k = 4096
+    a = jnp.full((1, k), 0.01, dtype=jnp.bfloat16)
+    b = jnp.ones((k, 1), dtype=jnp.bfloat16)
+    out = ops.exec_op("matmul", a, b)
+    assert out.dtype == jnp.bfloat16
+    assert abs(float(out[0, 0]) - k * 0.01) / (k * 0.01) < 0.01
+
+
+def test_gather_scatter(rng):
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    np.testing.assert_allclose(ops.exec_op("gather", jnp.asarray(x), jnp.asarray(idx)), x[idx])
+    upd = np.ones((3, 3), dtype=np.float32)
+    out = ops.exec_op("scatter_add", jnp.asarray(x), jnp.asarray(idx), jnp.asarray(upd))
+    expect = x.copy()
+    expect[idx] += 1.0
+    np.testing.assert_allclose(out, expect)
+
+
+def test_one_hot():
+    out = ops.exec_op("onehot", jnp.array([0, 2, 1]), 4)
+    expect = np.eye(4, dtype=np.float32)[[0, 2, 1]]
+    np.testing.assert_allclose(out, expect)
+
+
+def test_concat_stack_split(rng):
+    x = rng.standard_normal((2, 3)).astype(np.float32)
+    y = rng.standard_normal((2, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        ops.exec_op("concat", [jnp.asarray(x), jnp.asarray(y)], axis=0),
+        np.concatenate([x, y], axis=0),
+    )
+    np.testing.assert_allclose(
+        ops.exec_op("stack", [jnp.asarray(x), jnp.asarray(y)], axis=1),
+        np.stack([x, y], axis=1),
+    )
+    parts = ops.exec_op("split", jnp.asarray(x), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+
+
+def test_random_ops_reproducible(key):
+    a = ops.exec_op("random_normal", key, (16, 16))
+    b = ops.exec_op("random_normal", key, (16, 16))
+    np.testing.assert_array_equal(a, b)
+    k1, k2 = ops.exec_op("random_split_key", key)
+    c = ops.exec_op("random_normal", k1, (16, 16))
+    assert not np.allclose(a, c)
+
+
+def test_dropout_train_vs_inference(key):
+    x = jnp.ones((1000,))
+    out_inf = ops.exec_op("dropout", x, key, 0.5, training=False)
+    np.testing.assert_array_equal(out_inf, x)
+    out_tr = ops.exec_op("dropout", x, key, 0.5, training=True)
+    # Inverted dropout preserves the mean.
+    assert abs(float(out_tr.mean()) - 1.0) < 0.15
+    kept = float((out_tr != 0).mean())
+    assert 0.4 < kept < 0.6
+
+
+def test_topk(rng):
+    x = rng.standard_normal((4, 10)).astype(np.float32)
+    vals, idx = ops.exec_op("top_k", jnp.asarray(x), 3)
+    np.testing.assert_allclose(vals, np.sort(x, axis=-1)[:, ::-1][:, :3], rtol=1e-6)
+
+
+def test_fmod_vs_mod_negative_operands():
+    # C fmod: sign follows dividend; python mod: sign follows divisor
+    np.testing.assert_allclose(ops.exec_op("fmod", jnp.array(-7.0), jnp.array(3.0)), -1.0)
+    np.testing.assert_allclose(ops.exec_op("mod", jnp.array(-7.0), jnp.array(3.0)), 2.0)
+
+
+def test_one_hot_integer_dtype():
+    out = ops.exec_op("onehot", jnp.array([0, 2]), 4, dtype=jnp.int32)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(out, np.eye(4, dtype=np.int32)[[0, 2]])
+
+
+def test_dynamic_stitch_tf_semantics():
+    out = ops.exec_op(
+        "dynamic_stitch",
+        [jnp.array([0, 1]), jnp.array([1])],
+        [jnp.array([[1.0], [2.0]]), jnp.array([[9.0]])],
+    )
+    assert out.shape == (2, 1)
+    np.testing.assert_allclose(out, [[1.0], [9.0]])
+
+
+def test_logsumexp_handles_neg_inf():
+    x = jnp.array([-jnp.inf, 0.0])
+    np.testing.assert_allclose(ops.exec_op("logsumexp", x), 0.0, atol=1e-6)
