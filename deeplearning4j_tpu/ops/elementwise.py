@@ -49,17 +49,16 @@ op("sigmoid", "transform_float")(jax.nn.sigmoid)
 op("log_sigmoid", "transform_float")(jax.nn.log_sigmoid)
 op("softplus", "transform_float")(jax.nn.softplus)
 op("softsign", "transform_float")(jax.nn.soft_sign)
-op("gelu", "transform_float", aliases=("gelu_erf",))(
+op("gelu", "transform_float", aliases=("gelu_erf", "precise_gelu"))(
     lambda x: jax.nn.gelu(x, approximate=False)
 )
-op("gelu_tanh", "transform_float", aliases=("precise_gelu",))(
-    lambda x: jax.nn.gelu(x, approximate=True)
-)
+op("gelu_tanh", "transform_float")(lambda x: jax.nn.gelu(x, approximate=True))
 op("elu", "transform_float")(jax.nn.elu)
 op("selu", "transform_float")(jax.nn.selu)
 op("swish", "transform_float", aliases=("silu",))(jax.nn.silu)
 op("mish", "transform_float")(jax.nn.mish)
-op("hard_sigmoid", "transform_float")(jax.nn.hard_sigmoid)
+# ND4J HardSigmoid: clip(0.2x + 0.5, 0, 1) — NOT jax.nn.hard_sigmoid (slope 1/6)
+op("hard_sigmoid", "transform_float")(lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
 op("hard_tanh", "transform_float", aliases=("hardtanh",))(
     lambda x: jnp.clip(x, -1.0, 1.0)
 )

@@ -26,10 +26,13 @@ def matmul(a, b, transpose_a=False, transpose_b=False, preferred_element_type=No
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    if preferred_element_type is None and a.dtype == jnp.bfloat16:
+    # Default policy for bf16 inputs: accumulate fp32 on the MXU, return bf16.
+    # An explicit preferred_element_type is honored as the output dtype.
+    defaulted = preferred_element_type is None
+    if defaulted and a.dtype == jnp.bfloat16:
         preferred_element_type = jnp.float32
     out = jnp.matmul(a, b, preferred_element_type=preferred_element_type)
-    if preferred_element_type is not None and a.dtype == jnp.bfloat16:
+    if defaulted and a.dtype == jnp.bfloat16:
         out = out.astype(a.dtype)
     return out
 

@@ -33,12 +33,17 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _conv_padding(padding, kernel, strides, dilation):
-    """ND4J uses explicit pad + a 'same mode' flag; accept both styles."""
+def _accf(x):
+    """Accumulation dtype: fp32 unless the input is already fp64 (gradcheck)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
+
+def _conv_padding(padding):
+    """'SAME'/'VALID', or explicit symmetric (ph, pw) pixels (ND4J style)."""
     if isinstance(padding, str):
-        return padding  # 'SAME' | 'VALID'
-    pads = _pair(padding)
-    return [(p, p) for p in pads]
+        return padding
+    return [(p, p) for p in _pair(padding)]
 
 
 @op("conv2d", "conv")
@@ -68,7 +73,7 @@ def conv2d(
         x,
         w,
         window_strides=_pair(strides),
-        padding=_conv_padding(padding, w.shape[:2], strides, dilation),
+        padding=_conv_padding(padding),
         rhs_dilation=_pair(dilation),
         dimension_numbers=dn,
         feature_group_count=feature_group_count,
@@ -272,8 +277,8 @@ def batchnorm(x, mean, variance, gamma=None, beta=None, eps=1e-5, axis=-1):
     scale-shift chain XLA fuses into the adjacent conv."""
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    inv = lax.rsqrt(variance.astype(jnp.float32) + eps).reshape(shape)
-    out = (x.astype(jnp.float32) - mean.reshape(shape)) * inv
+    inv = lax.rsqrt(_accf(variance) + eps).reshape(shape)
+    out = (_accf(x) - mean.reshape(shape)) * inv
     if gamma is not None:
         out = out * gamma.reshape(shape)
     if beta is not None:
@@ -287,7 +292,7 @@ def batchnorm_train(x, gamma, beta, running_mean, running_var, momentum=0.9, eps
 
     Returns (out, new_running_mean, new_running_var)."""
     reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
-    xf = x.astype(jnp.float32)
+    xf = _accf(x)
     mean = jnp.mean(xf, axis=reduce_axes)
     var = jnp.var(xf, axis=reduce_axes)
     out = batchnorm(x, mean, var, gamma, beta, eps=eps, axis=axis)
@@ -300,7 +305,7 @@ def batchnorm_train(x, gamma, beta, running_mean, running_var, momentum=0.9, eps
 
 @op("layernorm", "norm", aliases=("layer_norm",))
 def layernorm(x, gamma=None, beta=None, eps=1e-5, axis=-1):
-    xf = x.astype(jnp.float32)
+    xf = _accf(x)
     mean = jnp.mean(xf, axis=axis, keepdims=True)
     var = jnp.var(xf, axis=axis, keepdims=True)
     out = (xf - mean) * lax.rsqrt(var + eps)
@@ -313,7 +318,7 @@ def layernorm(x, gamma=None, beta=None, eps=1e-5, axis=-1):
 
 @op("rmsnorm", "norm")
 def rmsnorm(x, gamma=None, eps=1e-6, axis=-1):
-    xf = x.astype(jnp.float32)
+    xf = _accf(x)
     ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
     out = xf * lax.rsqrt(ms + eps)
     if gamma is not None:
@@ -390,21 +395,21 @@ def softmax_cross_entropy(logits, labels, weights=None, label_smoothing=0.0):
     if label_smoothing > 0.0:
         k = labels.shape[-1]
         labels = labels * (1.0 - label_smoothing) + label_smoothing / k
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logp = jax.nn.log_softmax(_accf(logits), axis=-1)
     per = -jnp.sum(labels * logp, axis=-1)
     return _weighted_mean(per, weights)
 
 
 @op("sparse_softmax_cross_entropy", "loss")
 def sparse_softmax_cross_entropy(logits, label_indices, weights=None):
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logp = jax.nn.log_softmax(_accf(logits), axis=-1)
     per = -jnp.take_along_axis(logp, label_indices[..., None], axis=-1)[..., 0]
     return _weighted_mean(per, weights)
 
 
 @op("sigmoid_cross_entropy", "loss", aliases=("xent",))
 def sigmoid_cross_entropy(logits, labels, weights=None):
-    z = logits.astype(jnp.float32)
+    z = _accf(logits)
     per = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
     per = jnp.sum(per, axis=tuple(range(1, per.ndim))) if per.ndim > 1 else per
     return _weighted_mean(per, weights)
@@ -515,8 +520,9 @@ def dot_product_attention(q, k, v, mask=None, scale=None, is_causal=False):
     q,k,v: [..., T, d]. Computes softmax(q kᵀ · scale + mask) v with fp32
     softmax accumulation (bf16-safe)."""
     d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    scale = scale if scale is not None else 1.0 / float(d) ** 0.5
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=acc) * scale
     if is_causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         causal = jnp.tril(jnp.ones((tq, tk), dtype=bool), k=tk - tq)

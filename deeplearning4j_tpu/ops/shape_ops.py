@@ -15,6 +15,7 @@ gather/scatter HLOs which TPU executes natively. All shapes here are static
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.ops.registry import op
@@ -41,7 +42,7 @@ op("unstack", "shape", aliases=("unbind",))(
 )
 op("split", "shape")(lambda x, num_or_sections, axis=0: jnp.split(x, num_or_sections, axis=axis))
 op("split_v", "shape")(
-    lambda x, sizes, axis=0: jnp.split(x, list(jnp.cumsum(jnp.array(sizes))[:-1]), axis=axis)
+    lambda x, sizes, axis=0: jnp.split(x, np.cumsum(sizes)[:-1].tolist(), axis=axis)
 )
 op("flip", "shape", aliases=("reverse",))(jnp.flip)
 op("roll", "shape")(jnp.roll)
@@ -149,8 +150,6 @@ def dynamic_stitch(indices_list, data_list):
     """TF semantics: output rows = max(index)+1; later lists win on overlap.
     Requires concrete indices (the output shape depends on their values, which
     XLA cannot defer) — call outside jit or with static index arrays."""
-    import numpy as np
-
     n = int(max(int(np.asarray(i).max()) for i in indices_list)) + 1
     first = data_list[0]
     out = jnp.zeros((n,) + first.shape[1:], dtype=first.dtype)

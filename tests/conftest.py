@@ -16,9 +16,8 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# fp64 available for gradient checks (GradientCheckUtil parity: exact central
-# differences in double precision).
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# x64 stays globally off (TPU-realistic dtypes); gradient checks get double
+# precision locally via the jax.enable_x64() context manager in gradcheck.py.
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
