@@ -9,7 +9,7 @@ differences in float64, per-parameter comparison of relative error.
 TPU-native twist: analytic gradients come from ``jax.grad`` over the op table
 (no per-op doDiff code to check — but the lowerings themselves can still be
 wrong, e.g. a custom VJP or a non-differentiable reformulation, which is what
-this harness catches). Checks run on CPU in x64 mode.
+this harness catches). Checks run in a local x64 context.
 """
 
 from __future__ import annotations
@@ -44,6 +44,46 @@ class GradCheckResult:
         return msg
 
 
+def _compare_array(
+    result: GradCheckResult,
+    label: str,
+    array: np.ndarray,
+    analytic: np.ndarray,
+    eval_at: Callable[[np.ndarray], float],
+    *,
+    eps: float,
+    max_rel_error: float,
+    min_abs_error: float,
+    max_params_per_array: int,
+    rng: np.random.Generator,
+) -> None:
+    """Shared central-difference loop: perturb entries of ``array``, compare
+    (f(x+eps)-f(x-eps))/2eps against ``analytic``; record failures."""
+    flat = array.reshape(-1)
+    idxs = np.arange(flat.size)
+    if flat.size > max_params_per_array:
+        idxs = rng.choice(flat.size, size=max_params_per_array, replace=False)
+    for j in idxs:
+        plus = flat.copy()
+        plus[j] += eps
+        minus = flat.copy()
+        minus[j] -= eps
+        numeric = (
+            eval_at(plus.reshape(array.shape)) - eval_at(minus.reshape(array.shape))
+        ) / (2 * eps)
+        ana = analytic.reshape(-1)[j]
+        abs_err = abs(numeric - ana)
+        denom = max(abs(numeric), abs(ana))
+        rel_err = abs_err / denom if denom > 0 else 0.0
+        result.n_params += 1
+        result.max_rel_error = max(result.max_rel_error, rel_err)
+        if rel_err > max_rel_error and abs_err > min_abs_error:
+            result.failures.append(
+                f"  {label}[{j}]: analytic={ana:.8e} numeric={numeric:.8e} "
+                f"rel_err={rel_err:.3e}"
+            )
+
+
 def check_gradients(
     fn: Callable,
     args: Sequence,
@@ -57,13 +97,11 @@ def check_gradients(
 ) -> GradCheckResult:
     """Compare jax.grad of scalar ``fn(*args)`` against fp64 central differences.
 
-    Like GradientCheckUtil.checkGradients: perturb each parameter ±eps, compare
-    (f(x+eps)-f(x-eps))/(2 eps) with the analytic gradient; relative error must
-    stay below ``max_rel_error`` unless the absolute error is below
-    ``min_abs_error``. For large arrays a random subset of
+    Like GradientCheckUtil.checkGradients: perturb each parameter ±eps; relative
+    error must stay below ``max_rel_error`` unless the absolute error is below
+    ``min_abs_error``. For large arrays a seeded random subset of
     ``max_params_per_array`` entries is checked (the reference checks all —
-    subset keeps CI fast; seeded for reproducibility).
-    """
+    subset keeps CI fast)."""
     if argnums is None:
         argnums = tuple(
             i for i, a in enumerate(args)
@@ -75,50 +113,30 @@ def check_gradients(
 
     with jax.enable_x64():
         args64 = [
-            jnp.asarray(a, dtype=jnp.float64)
-            if i in argnums
-            else a
+            jnp.asarray(a, dtype=jnp.float64) if i in argnums else a
             for i, a in enumerate(args)
         ]
-
-        value = fn(*args64)
-        if jnp.ndim(value) != 0:
+        if jnp.ndim(fn(*args64)) != 0:
             raise ValueError("gradcheck requires a scalar-valued function")
-
         analytic = jax.grad(fn, argnums=argnums)(*args64)
         result = GradCheckResult()
         rng = np.random.default_rng(seed)
 
         for gi, ai in enumerate(argnums):
             a = np.asarray(args64[ai], dtype=np.float64)
-            g = np.asarray(analytic[gi], dtype=np.float64)
-            flat = a.reshape(-1)
-            idxs = np.arange(flat.size)
-            if flat.size > max_params_per_array:
-                idxs = rng.choice(flat.size, size=max_params_per_array, replace=False)
-            for j in idxs:
-                plus = flat.copy()
-                plus[j] += eps
-                minus = flat.copy()
-                minus[j] -= eps
 
-                def f_at(v):
-                    new_args = list(args64)
-                    new_args[ai] = jnp.asarray(v.reshape(a.shape))
-                    return float(fn(*new_args))
+            def eval_at(v, ai=ai):
+                new_args = list(args64)
+                new_args[ai] = jnp.asarray(v)
+                return float(fn(*new_args))
 
-                numeric = (f_at(plus) - f_at(minus)) / (2 * eps)
-                ana = g.reshape(-1)[j]
-                abs_err = abs(numeric - ana)
-                denom = max(abs(numeric), abs(ana))
-                rel_err = abs_err / denom if denom > 0 else 0.0
-                result.n_params += 1
-                result.max_rel_error = max(result.max_rel_error, rel_err)
-                if rel_err > max_rel_error and abs_err > min_abs_error:
-                    result.failures.append(
-                        f"  arg{ai}[{j}]: analytic={ana:.8e} numeric={numeric:.8e} "
-                        f"rel_err={rel_err:.3e}"
-                    )
+            _compare_array(
+                result, f"arg{ai}", a,
+                np.asarray(analytic[gi], dtype=np.float64), eval_at,
+                eps=eps, max_rel_error=max_rel_error,
+                min_abs_error=min_abs_error,
+                max_params_per_array=max_params_per_array, rng=rng,
+            )
         return result
 
 
@@ -135,8 +153,9 @@ def check_model_gradients(
     """Gradcheck over a parameter pytree: loss_fn(params) -> scalar.
 
     This is the shape DL4J's layer gradchecks take (flattened param vector vs
-    per-param finite difference); here the pytree stays structured.
-    """
+    per-param finite difference); here the pytree stays structured. Defaults
+    are looser than :func:`check_gradients` (deep compositions accumulate more
+    truncation error)."""
     with jax.enable_x64():
         params64 = jax.tree_util.tree_map(
             lambda p: jnp.asarray(p, dtype=jnp.float64), params
@@ -148,31 +167,17 @@ def check_model_gradients(
         rng = np.random.default_rng(seed)
 
         for li, (leaf, gleaf) in enumerate(zip(leaves, grad_leaves)):
-            a = np.asarray(leaf, dtype=np.float64)
-            g = np.asarray(gleaf, dtype=np.float64)
-            flat = a.reshape(-1)
-            idxs = np.arange(flat.size)
-            if flat.size > max_params_per_array:
-                idxs = rng.choice(flat.size, size=max_params_per_array, replace=False)
-            for j in idxs:
-                plus = flat.copy(); plus[j] += eps
-                minus = flat.copy(); minus[j] -= eps
 
-                def loss_at(v):
-                    new_leaves = list(leaves)
-                    new_leaves[li] = jnp.asarray(v.reshape(a.shape))
-                    return float(loss_fn(jax.tree_util.tree_unflatten(treedef, new_leaves)))
+            def eval_at(v, li=li):
+                new_leaves = list(leaves)
+                new_leaves[li] = jnp.asarray(v)
+                return float(loss_fn(jax.tree_util.tree_unflatten(treedef, new_leaves)))
 
-                numeric = (loss_at(plus) - loss_at(minus)) / (2 * eps)
-                ana = g.reshape(-1)[j]
-                abs_err = abs(numeric - ana)
-                denom = max(abs(numeric), abs(ana))
-                rel_err = abs_err / denom if denom > 0 else 0.0
-                result.n_params += 1
-                result.max_rel_error = max(result.max_rel_error, rel_err)
-                if rel_err > max_rel_error and abs_err > min_abs_error:
-                    result.failures.append(
-                        f"  leaf{li}[{j}]: analytic={ana:.8e} numeric={numeric:.8e} "
-                        f"rel_err={rel_err:.3e}"
-                    )
+            _compare_array(
+                result, f"leaf{li}", np.asarray(leaf, dtype=np.float64),
+                np.asarray(gleaf, dtype=np.float64), eval_at,
+                eps=eps, max_rel_error=max_rel_error,
+                min_abs_error=min_abs_error,
+                max_params_per_array=max_params_per_array, rng=rng,
+            )
         return result

@@ -1,0 +1,15 @@
+"""Data pipeline — DataSet + iterators.
+
+Reference parity: org/nd4j/linalg/dataset/DataSet.java and the DL4J iterator
+stack (RecordReaderDataSetIterator, MnistDataSetIterator in
+deeplearning4j-datasets, AsyncDataSetIterator) — path-cite, mount empty this
+round. ETL breadth (DataVec record readers, TransformProcess) arrives in the
+utils/etl milestone.
+"""
+
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: F401
+from deeplearning4j_tpu.data.iterators import (  # noqa: F401
+    ArrayDataSetIterator,
+    DataSetIterator,
+    MnistDataSetIterator,
+)

@@ -1,0 +1,163 @@
+"""DataSet iterators.
+
+Reference parity: DL4J's DataSetIterator interface + MnistDataSetIterator
+(deeplearning4j-datasets .../iterator/impl/MnistDataSetIterator.java, which
+fetches/caches the idx files) and the generic fetcher pattern — path-cite,
+mount empty this round.
+
+MNIST note: this machine has no network egress and no cached MNIST. When idx
+files exist under ``data_dir`` (default ~/.deeplearning4j_tpu/mnist) they are
+used; otherwise a *deterministic synthetic* digit set is generated (per-class
+stroke-pattern prototypes + noise — honest stand-in that a LeNet must still
+learn nontrivially; clearly flagged via ``.synthetic``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol (org/nd4j/linalg/dataset/api/iterator/DataSetIterator
+    .java): iterable over DataSet minibatches with reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatches over in-memory arrays (ExistingDataSetIterator/
+    ListDataSetIterator parity)."""
+
+    def __init__(self, features, labels, batch=32, shuffle=False, seed=123,
+                 drop_last=False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.batch = batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __iter__(self):
+        n = len(self.features)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        stop = n - (n % self.batch) if self.drop_last else n
+        for i in range(0, stop, self.batch):
+            j = idx[i : i + self.batch]
+            yield DataSet(self.features[j], self.labels[j])
+
+    def batch_size(self):
+        return self.batch
+
+    def total_examples(self):
+        return len(self.features)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _synthetic_mnist(n: int, seed: int, image_hw: int = 28):
+    """Deterministic digit-like dataset: each class = a glyph drawn from line
+    segments, rendered with random affine jitter + noise. Harder than
+    prototype+noise (requires real spatial features) but cheaply generated."""
+    rng = np.random.default_rng(seed)
+    # stroke endpoints per class, on a 0..1 canvas (crude 7-segment-ish digits)
+    strokes = {
+        0: [(0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.8, 0.8), (0.8, 0.8, 0.2, 0.8), (0.2, 0.8, 0.2, 0.2)],
+        1: [(0.5, 0.15, 0.5, 0.85)],
+        2: [(0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.8, 0.5), (0.8, 0.5, 0.2, 0.5), (0.2, 0.5, 0.2, 0.8), (0.2, 0.8, 0.8, 0.8)],
+        3: [(0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.8, 0.8), (0.2, 0.5, 0.8, 0.5), (0.2, 0.8, 0.8, 0.8)],
+        4: [(0.2, 0.2, 0.2, 0.5), (0.2, 0.5, 0.8, 0.5), (0.8, 0.2, 0.8, 0.8)],
+        5: [(0.8, 0.2, 0.2, 0.2), (0.2, 0.2, 0.2, 0.5), (0.2, 0.5, 0.8, 0.5), (0.8, 0.5, 0.8, 0.8), (0.8, 0.8, 0.2, 0.8)],
+        6: [(0.7, 0.15, 0.3, 0.4), (0.3, 0.4, 0.2, 0.8), (0.2, 0.8, 0.8, 0.8), (0.8, 0.8, 0.8, 0.5), (0.8, 0.5, 0.2, 0.5)],
+        7: [(0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.4, 0.85)],
+        8: [(0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.8, 0.8), (0.8, 0.8, 0.2, 0.8), (0.2, 0.8, 0.2, 0.2), (0.2, 0.5, 0.8, 0.5)],
+        9: [(0.8, 0.5, 0.2, 0.5), (0.2, 0.5, 0.2, 0.2), (0.2, 0.2, 0.8, 0.2), (0.8, 0.2, 0.8, 0.8)],
+    }
+    xs = np.zeros((n, image_hw, image_hw), dtype=np.float32)
+    ys = rng.integers(0, 10, size=n)
+    t = np.linspace(0, 1, 24)
+    for i in range(n):
+        cls = ys[i]
+        # affine jitter: shift/scale/rotation
+        ang = rng.normal(0, 0.12)
+        scale = 1.0 + rng.normal(0, 0.08)
+        dx, dy = rng.normal(0, 0.04, 2)
+        ca, sa = np.cos(ang), np.sin(ang)
+        img = xs[i]
+        for (x0, y0, x1, y1) in strokes[cls]:
+            px = x0 + (x1 - x0) * t
+            py = y0 + (y1 - y0) * t
+            # center, rotate, scale, shift
+            cx, cy = px - 0.5, py - 0.5
+            rx = (ca * cx - sa * cy) * scale + 0.5 + dx
+            ry = (sa * cx + ca * cy) * scale + 0.5 + dy
+            ix = np.clip((rx * (image_hw - 1)).astype(int), 0, image_hw - 1)
+            iy = np.clip((ry * (image_hw - 1)).astype(int), 0, image_hw - 1)
+            img[iy, ix] = 1.0
+            # thicken stroke
+            img[np.clip(iy + 1, 0, image_hw - 1), ix] = np.maximum(
+                img[np.clip(iy + 1, 0, image_hw - 1), ix], 0.7
+            )
+        xs[i] += rng.normal(0, 0.05, (image_hw, image_hw)).astype(np.float32)
+    xs = np.clip(xs, 0.0, 1.0)
+    labels = np.eye(10, dtype=np.float32)[ys]
+    return xs[..., None], labels  # NHWC
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """MNIST batches, NHWC [b,28,28,1] in [0,1], one-hot labels.
+
+    Loads real idx files from ``data_dir`` when present
+    (train-images-idx3-ubyte[.gz] etc.); otherwise generates the deterministic
+    synthetic set (``.synthetic == True``)."""
+
+    def __init__(self, batch=64, train=True, seed=123, n_examples=None,
+                 data_dir=None, flatten=False):
+        data_dir = data_dir or os.path.expanduser("~/.deeplearning4j_tpu/mnist")
+        prefix = "train" if train else "t10k"
+        img_path = lbl_path = None
+        for ext in ("", ".gz"):
+            ip = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte{ext}")
+            lp = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte{ext}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                img_path, lbl_path = ip, lp
+                break
+        if img_path:
+            images = _read_idx(img_path).astype(np.float32) / 255.0
+            labels = np.eye(10, dtype=np.float32)[_read_idx(lbl_path)]
+            features = images[..., None]
+            self.synthetic = False
+        else:
+            n = n_examples or (4096 if train else 1024)
+            features, labels = _synthetic_mnist(n, seed=seed if train else seed + 1)
+            self.synthetic = True
+        if n_examples:
+            features, labels = features[:n_examples], labels[:n_examples]
+        if flatten:
+            features = features.reshape(len(features), -1)
+        super().__init__(features, labels, batch=batch, shuffle=train, seed=seed)

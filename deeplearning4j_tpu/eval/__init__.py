@@ -1,0 +1,11 @@
+"""Evaluation — classification/regression metrics + ROC.
+
+Reference parity: org/nd4j/evaluation/classification/{Evaluation,ROC,
+EvaluationBinary,EvaluationCalibration}.java and regression/
+RegressionEvaluation.java — path-cite, mount empty this round. Accumulation
+happens on the host in numpy (cheap; the expensive part — the forward pass —
+stays on device).
+"""
+
+from deeplearning4j_tpu.eval.classification import Evaluation, ROC  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401

@@ -1,0 +1,132 @@
+"""Classification evaluation (Evaluation.java + ROC.java parity)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Evaluation:
+    """Accuracy / precision / recall / F1 / confusion matrix.
+
+    Reference: org/nd4j/evaluation/classification/Evaluation.java. Labels and
+    predictions are one-hot/probability arrays [batch, classes] (or index
+    vectors)."""
+
+    def __init__(self, num_classes: int | None = None, labels: list[str] | None = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: np.ndarray | None = None
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = np.zeros((self.num_classes, self.num_classes), dtype=np.int64)
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim > 1:
+            true_idx = labels.argmax(axis=-1)
+            n = labels.shape[-1]
+        else:
+            true_idx = labels.astype(np.int64)
+            n = int(true_idx.max()) + 1 if self.num_classes is None else self.num_classes
+        pred_idx = predictions.argmax(axis=-1) if predictions.ndim > 1 else predictions.astype(np.int64)
+        self._ensure(predictions.shape[-1] if predictions.ndim > 1 else n)
+        np.add.at(self.confusion, (true_idx.reshape(-1), pred_idx.reshape(-1)), 1)
+
+    # ---- metrics (ND4J naming) -------------------------------------------
+    def accuracy(self) -> float:
+        c = self.confusion
+        return float(np.trace(c) / max(c.sum(), 1))
+
+    def precision(self, cls: int | None = None) -> float:
+        c = self.confusion
+        col = c.sum(axis=0)
+        tp = np.diag(c)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            per = np.where(col > 0, tp / col, np.nan)
+        if cls is not None:
+            return float(per[cls])
+        return float(np.nanmean(per))
+
+    def recall(self, cls: int | None = None) -> float:
+        c = self.confusion
+        row = c.sum(axis=1)
+        tp = np.diag(c)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            per = np.where(row > 0, tp / row, np.nan)
+        if cls is not None:
+            return float(per[cls])
+        return float(np.nanmean(per))
+
+    def f1(self, cls: int | None = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 0.0 if p + r == 0 else 2 * p * r / (p + r)
+
+    def false_positive_rate(self, cls: int) -> float:
+        c = self.confusion
+        fp = c[:, cls].sum() - c[cls, cls]
+        tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
+        return float(fp / max(fp + tn, 1))
+
+    def confusion_matrix(self) -> np.ndarray:
+        return self.confusion.copy()
+
+    def stats(self) -> str:
+        """Human-readable summary (Evaluation.stats() parity)."""
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.num_classes}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+            str(self.confusion),
+            "==================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class ROC:
+    """Binary ROC/AUC via thresholded counts (ROC.java parity; exact mode)."""
+
+    def __init__(self):
+        self.scores: list[np.ndarray] = []
+        self.labels: list[np.ndarray] = []
+
+    def eval(self, labels, scores):
+        labels = np.asarray(labels).reshape(-1)
+        scores = np.asarray(scores)
+        if scores.ndim > 1 and scores.shape[-1] == 2:
+            scores = scores[..., 1]
+        self.labels.append(labels)
+        self.scores.append(scores.reshape(-1))
+
+    def calculate_auc(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s, kind="stable")
+        y, s = y[order], s[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        # collapse tied scores into one threshold point (ties form a single
+        # ROC segment, giving AUC 0.5 for constant scores)
+        last_of_group = np.r_[s[1:] != s[:-1], True]
+        tps, fps = tps[last_of_group], fps[last_of_group]
+        P, N = max(tps[-1], 1), max(fps[-1], 1)
+        tpr = np.concatenate([[0.0], tps / P])
+        fpr = np.concatenate([[0.0], fps / N])
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculate_auprc(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tps = np.cumsum(y)
+        precision = tps / np.arange(1, len(y) + 1)
+        recall = tps / max(tps[-1], 1)
+        return float(np.trapezoid(precision, recall))

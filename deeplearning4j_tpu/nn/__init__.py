@@ -1,0 +1,11 @@
+"""NN framework: layer/config DSL, networks, updaters, listeners.
+
+Reference parity: the deeplearning4j-nn module (SURVEY.md §2.2 J7–J9)."""
+
+from deeplearning4j_tpu.nn import activations, layers, listeners, losses, schedules, updaters, weights  # noqa: F401
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

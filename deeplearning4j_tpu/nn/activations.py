@@ -1,0 +1,53 @@
+"""Activation functions — DL4J ``Activation`` enum parity.
+
+Reference: org/nd4j/linalg/activations/Activation.java + impl classes
+(nd4j-api org/nd4j/linalg/activations/impl/ActivationReLU.java …) — path-cite,
+mount empty this round. Each maps to a registered op; the derivative comes
+from JAX AD rather than the reference's hand-written backprop() methods.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+from deeplearning4j_tpu.ops import registry
+
+# name → op-table name (DL4J enum value → our op)
+_ACTIVATIONS = {
+    "identity": "identity",
+    "relu": "relu",
+    "relu6": "relu6",
+    "leakyrelu": "leakyrelu",
+    "tanh": "tanh",
+    "sigmoid": "sigmoid",
+    "softmax": "softmax",
+    "logsoftmax": "log_softmax",
+    "elu": "elu",
+    "selu": "selu",
+    "gelu": "gelu",
+    "swish": "swish",
+    "mish": "mish",
+    "softplus": "softplus",
+    "softsign": "softsign",
+    "hardsigmoid": "hard_sigmoid",
+    "hardtanh": "hard_tanh",
+    "cube": "cube",
+    "rationaltanh": "rationaltanh",
+    "rectifiedtanh": "rectifiedtanh",
+    "thresholdedrelu": "thresholdrelu",
+}
+
+
+def resolve(activation: Union[str, Callable, None]) -> Callable:
+    """Accept a DL4J-style name ('relu'), an op name, or a callable."""
+    if activation is None:
+        return lambda x: x
+    if callable(activation):
+        return activation
+    key = activation.lower()
+    op_name = _ACTIVATIONS.get(key, key)
+    return registry.get_op(op_name).fn
+
+
+def available() -> list[str]:
+    return sorted(_ACTIVATIONS)

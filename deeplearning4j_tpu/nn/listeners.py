@@ -1,0 +1,85 @@
+"""Training listeners.
+
+Reference parity: org/deeplearning4j/optimize/api/TrainingListener.java and
+impls (ScoreIterationListener, PerformanceListener, CheckpointListener in
+org/deeplearning4j/optimize/listeners/) — path-cite, mount empty this round.
+
+Listener cost note: reading ``model.get_score()`` forces a device→host
+transfer of one scalar. ScoreIterationListener only does this every
+``print_iterations`` — keeping the device pipeline free to run ahead
+(the async-dispatch equivalent of the reference's listener cadence).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, print_iterations: int = 10, log_fn=print):
+        self.print_iterations = print_iterations
+        self.log = log_fn
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            self.log(f"Score at iteration {iteration} is {model.get_score():.6f}")
+
+
+class PerformanceListener(TrainingListener):
+    """Samples/sec + iteration timing (PerformanceListener parity)."""
+
+    def __init__(self, frequency: int = 10, log_fn=print):
+        self.frequency = frequency
+        self.log = log_fn
+        self._last_time = None
+        self._last_iter = 0
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            ips = (iteration - self._last_iter) / dt
+            self.log(f"iteration {iteration}: {ips:.1f} iter/sec")
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresListener(TrainingListener):
+    """Accumulates (iteration, score) — CollectScoresIterationListener parity."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = frequency
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.get_score()))
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (EvaluativeListener parity)."""
+
+    def __init__(self, iterator, frequency: int = 100, log_fn=print):
+        self.iterator = iterator
+        self.frequency = frequency
+        self.log = log_fn
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.last_evaluation = model.evaluate(self.iterator)
+            self.log(
+                f"iteration {iteration}: accuracy={self.last_evaluation.accuracy():.4f}"
+            )

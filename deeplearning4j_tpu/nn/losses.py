@@ -1,0 +1,74 @@
+"""Loss functions — DL4J ``LossFunctions.LossFunction`` enum parity.
+
+Reference: org/nd4j/linalg/lossfunctions/{LossFunctions.java,impl/LossMCXENT
+.java, LossMSE.java, …} — path-cite, mount empty this round. Output layers
+combine an activation with one of these; for the softmax+MCXENT and
+sigmoid+XENT pairs we fuse activation into the loss for numerical stability
+(the reference special-cases the same pairs inside LossMCXENT via
+"softmaxClipEps"/logits paths).
+
+Each entry: (loss_from_logits_fn | None, loss_from_activations_fn).
+``from_logits`` is preferred when the output activation matches the fused
+pair; the network decides which to call.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import nn as nnops
+
+
+def mcxent_logits(logits, labels, weights=None):
+    return nnops.softmax_cross_entropy(logits, labels, weights)
+
+
+def mcxent_probs(probs, labels, eps=1e-7, weights=None):
+    p = jnp.clip(probs, eps, 1.0)
+    per = -jnp.sum(labels * jnp.log(p), axis=-1)
+    if weights is not None:
+        return jnp.sum(per * weights) / jnp.maximum(jnp.sum(weights), 1e-12)
+    return jnp.mean(per)
+
+
+def xent_logits(logits, labels, weights=None):
+    return nnops.sigmoid_cross_entropy(logits, labels, weights)
+
+
+def xent_probs(probs, labels, eps=1e-7, weights=None):
+    return nnops.log_loss(probs, labels, eps, weights)
+
+
+_LOSSES = {
+    # name: (logits_fn or None, activations_fn, fused_activation or None)
+    "mcxent": (mcxent_logits, mcxent_probs, "softmax"),
+    "negativeloglikelihood": (mcxent_logits, mcxent_probs, "softmax"),
+    "xent": (xent_logits, xent_probs, "sigmoid"),
+    "mse": (None, nnops.mse_loss, None),
+    "l2": (None, lambda p, y, w=None: nnops.mse_loss(p, y, w), None),
+    "l1": (None, nnops.mae_loss, None),
+    "mean_absolute_error": (None, nnops.mae_loss, None),
+    "kl_divergence": (None, nnops.kl_divergence, None),
+    "cosine_proximity": (None, nnops.cosine_distance_loss, None),
+    "hinge": (None, nnops.hinge_loss, None),
+    "squared_hinge": (None, nnops.squared_hinge_loss, None),
+    "poisson": (None, nnops.poisson_loss, None),
+    "huber": (None, nnops.huber_loss, None),
+    "sparse_mcxent": (
+        lambda lg, y, w=None: nnops.sparse_softmax_cross_entropy(lg, y, w),
+        None,
+        "softmax",
+    ),
+}
+
+
+def resolve(name: str):
+    """-> (logits_fn | None, activations_fn | None, fused_activation | None)."""
+    key = name.lower()
+    if key not in _LOSSES:
+        raise ValueError(f"Unknown loss function: {name!r} (have {sorted(_LOSSES)})")
+    return _LOSSES[key]
+
+
+def available() -> list[str]:
+    return sorted(_LOSSES)

@@ -1,0 +1,253 @@
+"""MultiLayerNetwork — the linear-stack network with fit/output/score/evaluate.
+
+Reference parity: org/deeplearning4j/nn/multilayer/MultiLayerNetwork.java
+(~4k LoC: fitHelper → Solver → StochasticGradientDescent →
+computeGradientAndScore → per-layer activate/backpropGradient → updater →
+step; SURVEY.md §3.1) — path-cite, mount empty this round.
+
+TPU-native collapse: the entire minibatch iteration — forward, loss, reverse
+AD, updater, parameter step — is ONE jitted function, compiled once per input
+shape and executed as a single XLA program on device. The reference crosses
+JNI per op and keeps params/gradients as flattened off-heap views; here
+params/optimizer state live on device as pytrees and are donated
+(buffer-aliased) across steps, the PJRT-era equivalent of workspaces.
+
+Listeners fire on the host with the scalar loss (fetching only the scalar —
+one small transfer per iteration, matching the reference's
+TrainingListener.iterationDone cadence).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: List[dict] = []
+        self.states: List[dict] = []
+        self.opt_states: List[Any] = []
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value: float = float("nan")
+        self._train_step = None
+        self._updaters = [
+            (lyr.updater or conf.updater or upd.Sgd(0.1)) for lyr in conf.layers
+        ]
+        self._rng_key = jax.random.PRNGKey(conf.seed)
+
+    # ------------------------------------------------------------------ init
+    def init(self, input_shape=None) -> "MultiLayerNetwork":
+        """Initialize params/state (MultiLayerNetwork.init parity)."""
+        shape = tuple(input_shape or self.conf.input_shape or ())
+        if not shape:
+            raise ValueError("input_shape required (set_input_type on the builder)")
+        key = jax.random.PRNGKey(self.conf.seed)
+        self.params, self.states = [], []
+        cur = shape
+        for lyr in self.layers:
+            key, sub = jax.random.split(key)
+            p, s = lyr.initialize(sub, cur)
+            self.params.append(p)
+            self.states.append(s)
+            cur = lyr.output_shape(cur)
+        self.opt_states = [
+            u.init_state(p) for u, p in zip(self._updaters, self.params)
+        ]
+        self._output_shape = cur
+        self._train_step = self._build_train_step()
+        self._forward_jit = jax.jit(
+            functools.partial(self._forward, training=False), static_argnames=()
+        )
+        return self
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))
+
+    # --------------------------------------------------------------- forward
+    def _cast(self, x):
+        if self.conf.compute_dtype == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.bfloat16)
+        return x
+
+    def _cast_params(self, params):
+        if self.conf.compute_dtype != "bfloat16":
+            return params
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+
+    def _forward(self, params, states, x, *, training, keys=None):
+        h = self._cast(x)
+        cparams = self._cast_params(params)
+        new_states = []
+        for i, lyr in enumerate(self.layers):
+            k = keys[i] if keys is not None else None
+            h, ns = lyr.apply(cparams[i], states[i], h, training=training, key=k)
+            new_states.append(ns)
+        return h, new_states
+
+    def _loss(self, params, states, x, y, keys):
+        """Forward through all but the output layer, then fused loss."""
+        h = self._cast(x)
+        cparams = self._cast_params(params)
+        new_states = []
+        for i, lyr in enumerate(self.layers[:-1]):
+            h, ns = lyr.apply(cparams[i], states[i], h, training=True, key=keys[i])
+            new_states.append(ns)
+        out = self.layers[-1]
+        if not hasattr(out, "compute_loss"):
+            raise ValueError("last layer must be an OutputLayer/LossLayer")
+        loss = out.compute_loss(
+            cparams[-1], states[-1], h, y, training=True, key=keys[-1]
+        )
+        new_states.append(states[-1])
+        reg = sum(
+            (lyr.regularization(params[i]) for i, lyr in enumerate(self.layers)),
+            start=jnp.asarray(0.0),
+        )
+        return loss.astype(jnp.float32) + reg, new_states
+
+    # ------------------------------------------------------------ train step
+    def _build_train_step(self):
+        updaters = self._updaters
+        n_layers = len(self.layers)
+
+        def step(params, states, opt_states, iteration, x, y, key):
+            keys = list(jax.random.split(key, n_layers))
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss, has_aux=True
+            )(params, states, x, y, keys)
+            new_params, new_opts = [], []
+            for i in range(n_layers):
+                if not grads[i]:
+                    new_params.append(params[i])
+                    new_opts.append(opt_states[i])
+                    continue
+                p, s = upd.apply_updater(
+                    updaters[i], params[i], grads[i], opt_states[i], iteration
+                )
+                new_params.append(p)
+                new_opts.append(s)
+            return new_params, new_states, new_opts, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(x, y) | fit(iterator) | fit(iterator, epochs=N)."""
+        if labels is not None:
+            self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
+            return self
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels))
+            self.epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self)
+        return self
+
+    def _fit_batch(self, x, y):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self.params, self.states, self.opt_states, loss = self._train_step(
+            self.params, self.states, self.opt_states,
+            jnp.asarray(self.iteration), x, y, sub,
+        )
+        self.score_value = loss  # fetched lazily; float() forces transfer
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # ---------------------------------------------------------------- output
+    def output(self, x, train: bool = False):
+        """Inference forward pass (MultiLayerNetwork.output parity). The
+        OutputLayer's apply() gives dense+activation, i.e. probabilities."""
+        out, _ = self._forward_jit(self.params, self.states, jnp.asarray(x))
+        return out
+
+    def feed_forward(self, x):
+        """Per-layer activations (MultiLayerNetwork.feedForward parity)."""
+        h = self._cast(jnp.asarray(x))
+        acts = [h]
+        for i, lyr in enumerate(self.layers):
+            h, _ = lyr.apply(self._cast_params(self.params)[i], self.states[i], h, training=False)
+            acts.append(h)
+        return acts
+
+    def score(self, dataset=None, x=None, y=None) -> float:
+        """Loss on a dataset (MultiLayerNetwork.score parity)."""
+        if dataset is not None:
+            x, y = dataset.features, dataset.labels
+        keys = [None] * len(self.layers)
+        loss, _ = self._loss_eval(self.params, self.states, jnp.asarray(x), jnp.asarray(y))
+        return float(loss)
+
+    @functools.cached_property
+    def _loss_eval(self):
+        def eval_loss(params, states, x, y):
+            h = self._cast(x)
+            cparams = self._cast_params(params)
+            for i, lyr in enumerate(self.layers[:-1]):
+                h, _ = lyr.apply(cparams[i], states[i], h, training=False)
+            loss = self.layers[-1].compute_loss(
+                cparams[-1], states[-1], h, y, training=False
+            )
+            return loss, h
+
+        return jax.jit(eval_loss)
+
+    # -------------------------------------------------------------- evaluate
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator → Evaluation."""
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            preds = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(preds))
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            preds = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(preds))
+        return ev
+
+    # -------------------------------------------------------------- plumbing
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    @property
+    def score_(self):
+        return float(self.score_value)
+
+    def get_score(self) -> float:
+        return float(self.score_value)

@@ -49,10 +49,19 @@ op("sigmoid", "transform_float")(jax.nn.sigmoid)
 op("log_sigmoid", "transform_float")(jax.nn.log_sigmoid)
 op("softplus", "transform_float")(jax.nn.softplus)
 op("softsign", "transform_float")(jax.nn.soft_sign)
-op("gelu", "transform_float", aliases=("gelu_erf", "precise_gelu"))(
+# GELU family. libnd4j convention (pending line-level verification — reference
+# mount empty): 'gelu' = fast sigmoid form x*sigmoid(1.702x), 'precise_gelu' =
+# tanh polynomial form. Our canonical 'gelu' is the exact erf form (TPU-cheap);
+# the reference-named variants are registered separately for import parity.
+op("gelu", "transform_float", aliases=("gelu_erf",))(
     lambda x: jax.nn.gelu(x, approximate=False)
 )
-op("gelu_tanh", "transform_float")(lambda x: jax.nn.gelu(x, approximate=True))
+op("gelu_tanh", "transform_float", aliases=("precise_gelu",))(
+    lambda x: jax.nn.gelu(x, approximate=True)
+)
+op("gelu_sigmoid", "transform_float", aliases=("fast_gelu",))(
+    lambda x: x * jax.nn.sigmoid(1.702 * x)
+)
 op("elu", "transform_float")(jax.nn.elu)
 op("selu", "transform_float")(jax.nn.selu)
 op("swish", "transform_float", aliases=("silu",))(jax.nn.silu)

@@ -56,7 +56,7 @@ def conv2d(
     dilation=(1, 1),
     data_format="NHWC",
     feature_group_count=1,
-    preferred_element_type=jnp.float32,
+    preferred_element_type=None,
 ):
     """2-D convolution.
 
@@ -69,6 +69,9 @@ def conv2d(
         w.shape,
         (data_format, "HWIO", data_format),
     )
+    # preferred_element_type stays None by default: the MXU accumulates bf16
+    # convolutions in fp32 in hardware, and a forced fp32 output dtype breaks
+    # the conv transpose (gradient) rule for bf16 inputs.
     out = lax.conv_general_dilated(
         x,
         w,
@@ -108,7 +111,6 @@ def conv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME", dilation=(1, 1, 1), 
         padding=padding,
         rhs_dilation=tuple(dilation) if not isinstance(dilation, int) else (dilation,) * 3,
         dimension_numbers=dn,
-        preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     if b is not None:
         bshape = (1, 1, 1, 1, -1) if data_format.endswith("C") else (1, -1, 1, 1, 1)
@@ -530,7 +532,9 @@ def dot_product_attention(q, k, v, mask=None, scale=None, is_causal=False):
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("...qk,...kd->...qd", weights, v)
+    return jnp.einsum(
+        "...qk,...kd->...qd", weights, v, preferred_element_type=acc
+    ).astype(q.dtype)
 
 
 @op("multi_head_dot_product_attention", "attention", aliases=("multihead_attention",))
@@ -570,7 +574,8 @@ def bias_add(x, b, data_format="NHWC"):
 
 @op("xw_plus_b", "nn_misc", aliases=("linear_layer",))
 def xw_plus_b(x, w, b):
-    out = jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    out = jnp.matmul(x, w, preferred_element_type=acc).astype(x.dtype)
     return out + b.astype(out.dtype)
 
 

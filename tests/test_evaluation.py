@@ -1,0 +1,68 @@
+"""Evaluation metric tests vs hand-computed values (Evaluation.java test parity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import Evaluation, ROC, RegressionEvaluation
+
+
+def test_evaluation_perfect():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 1, 2, 0]]
+    ev.eval(labels, labels)
+    assert ev.accuracy() == 1.0
+    assert ev.precision() == 1.0
+    assert ev.recall() == 1.0
+    assert ev.f1() == 1.0
+
+
+def test_evaluation_known_confusion():
+    ev = Evaluation()
+    true_idx = [0, 0, 1, 1, 1, 2]
+    pred_idx = [0, 1, 1, 1, 0, 2]
+    ev.eval(np.eye(3)[true_idx], np.eye(3)[pred_idx])
+    cm = ev.confusion_matrix()
+    np.testing.assert_array_equal(cm, [[1, 1, 0], [1, 2, 0], [0, 0, 1]])
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    # class 0: precision 1/2, recall 1/2
+    assert ev.precision(0) == pytest.approx(0.5)
+    assert ev.recall(0) == pytest.approx(0.5)
+    # class 1: precision 2/3, recall 2/3
+    assert ev.precision(1) == pytest.approx(2 / 3)
+
+
+def test_evaluation_incremental_batches():
+    ev = Evaluation()
+    ev.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]])
+    ev.eval(np.eye(2)[[1, 0]], np.eye(2)[[0, 0]])
+    assert ev.confusion_matrix().sum() == 4
+    assert ev.accuracy() == pytest.approx(3 / 4)
+
+
+def test_roc_auc_perfect_and_random():
+    roc = ROC()
+    labels = np.array([0, 0, 1, 1])
+    roc.eval(labels, np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc.calculate_auc() == pytest.approx(1.0)
+
+    roc2 = ROC()
+    roc2.eval(np.array([0, 1, 0, 1]), np.array([0.5, 0.5, 0.5, 0.5]))
+    assert roc2.calculate_auc() == pytest.approx(0.5)
+
+
+def test_roc_known_auc():
+    roc = ROC()
+    roc.eval(np.array([1, 0, 1, 0]), np.array([0.9, 0.8, 0.7, 0.1]))
+    # rank-based AUC: pairs (pos > neg): (0.9>0.8, 0.9>0.1, 0.7>0.1) = 3 of 4
+    assert roc.calculate_auc() == pytest.approx(0.75)
+
+
+def test_regression_eval_known_values():
+    ev = RegressionEvaluation()
+    y = np.array([[1.0], [2.0], [3.0]])
+    p = np.array([[1.5], [2.0], [2.5]])
+    ev.eval(y, p)
+    assert ev.mean_squared_error() == pytest.approx((0.25 + 0 + 0.25) / 3)
+    assert ev.mean_absolute_error() == pytest.approx(1 / 3)
+    assert 0 < ev.r_squared() < 1
+    assert ev.pearson_correlation() == pytest.approx(1.0)

@@ -1,0 +1,124 @@
+"""Per-layer gradient checks — the DL4J gradientcheck suite parity
+(CNNGradientCheckTest, GradientCheckTests; SURVEY.md §4: 'every layer type has
+a gradcheck')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import gradcheck
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    LayerNormalization,
+    OutputLayer,
+    SubsamplingLayer,
+)
+
+
+def _cast_like(p, x):
+    """Match input dtype to the (possibly fp64-upcast) param dtype — the
+    gradcheck harness upcasts params only; ops follow the input dtype."""
+    leaves = jax.tree_util.tree_leaves(p)
+    return x.astype(leaves[0].dtype) if leaves else x
+
+
+def _layer_loss_fn(layer, input_shape, rng, out_reduce=lambda y: jnp.sum(y**2)):
+    key = jax.random.PRNGKey(0)
+    params, state = layer.initialize(key, input_shape)
+    x = jnp.asarray(rng.standard_normal((2,) + tuple(input_shape)))
+
+    def loss(p):
+        state64 = jax.tree_util.tree_map(lambda s: s.astype(jax.tree_util.tree_leaves(p)[0].dtype), state)
+        y, _ = layer.apply(p, state64, _cast_like(p, x), training=True)
+        return out_reduce(y)
+
+    return loss, params
+
+
+@pytest.mark.parametrize(
+    "layer,shape",
+    [
+        (DenseLayer(n_in=5, n_out=4, activation="tanh"), (5,)),
+        (ConvolutionLayer(n_out=3, kernel_size=(3, 3), padding="VALID", activation="sigmoid"), (6, 6, 2)),
+        (BatchNormalization(), (4,)),
+        (LayerNormalization(), (6,)),
+    ],
+)
+def test_layer_param_gradients(layer, shape, rng):
+    loss, params = _layer_loss_fn(layer, shape, rng)
+    res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
+    assert res.passed, f"{type(layer).__name__}: {res}"
+
+
+def test_output_layer_loss_gradients(rng):
+    layer = OutputLayer(n_in=6, n_out=4, loss="mcxent", activation="softmax")
+    key = jax.random.PRNGKey(0)
+    params, state = layer.initialize(key, (6,))
+    x = jnp.asarray(rng.standard_normal((3, 6)))
+    y = jnp.asarray(np.eye(4)[[0, 2, 3]])
+
+    def loss(p):
+        return layer.compute_loss(p, state, _cast_like(p, x), _cast_like(p, y), training=False)
+
+    res = gradcheck.check_model_gradients(loss, params)
+    assert res.passed, res
+
+
+def test_embedding_layer_gradients(rng):
+    layer = EmbeddingLayer(n_in=7, n_out=3)
+    key = jax.random.PRNGKey(1)
+    params, state = layer.initialize(key, ())
+    ids = jnp.array([0, 3, 3, 6])
+
+    def loss(p):
+        y, _ = layer.apply(p, state, ids)
+        return jnp.sum(y.astype(jax.tree_util.tree_leaves(p)[0].dtype)**2)
+
+    res = gradcheck.check_model_gradients(loss, params)
+    assert res.passed, res
+
+
+def test_whole_network_gradients(rng):
+    """End-to-end: conv -> pool -> dense -> output loss, all params checked."""
+    layers = [
+        ConvolutionLayer(n_out=2, kernel_size=(3, 3), padding="VALID", activation="tanh"),
+        SubsamplingLayer(kernel_size=(2, 2)),
+        DenseLayer(n_in=2 * 2 * 2, n_out=5, activation="relu"),
+        OutputLayer(n_in=5, n_out=3, loss="mcxent", activation="softmax"),
+    ]
+    key = jax.random.PRNGKey(0)
+    params, states, cur = [], [], (6, 6, 1)
+    for lyr in layers:
+        key, sub = jax.random.split(key)
+        p, s = lyr.initialize(sub, cur)
+        params.append(p)
+        states.append(s)
+        cur = lyr.output_shape(cur)
+    x = jnp.asarray(rng.standard_normal((2, 6, 6, 1)))
+    y = jnp.asarray(np.eye(3)[[0, 2]])
+
+    def loss(ps):
+        h = _cast_like(ps, x)
+        for lyr, p, s in zip(layers[:-1], ps[:-1], states[:-1]):
+            h, _ = lyr.apply(p, s, h, training=False)
+        return layers[-1].compute_loss(ps[-1], states[-1], h, _cast_like(ps, y), training=False)
+
+    res = gradcheck.check_model_gradients(loss, params, eps=1e-5, max_rel_error=1e-3)
+    assert res.passed, res
+
+
+def test_global_pooling_gradient_flow(rng):
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = jnp.asarray(rng.standard_normal((2, 4, 4, 3)))
+
+    def f(x):
+        y, _ = layer.apply({}, {}, x)
+        return jnp.sum(y**2)
+
+    res = gradcheck.check_gradients(f, [x])
+    assert res.passed, res
