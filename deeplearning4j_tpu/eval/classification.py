@@ -21,6 +21,11 @@ class Evaluation:
         if self.confusion is None:
             self.num_classes = self.num_classes or n
             self.confusion = np.zeros((self.num_classes, self.num_classes), dtype=np.int64)
+        elif n > self.num_classes:
+            grown = np.zeros((n, n), dtype=np.int64)
+            grown[: self.num_classes, : self.num_classes] = self.confusion
+            self.confusion = grown
+            self.num_classes = n
 
     def eval(self, labels, predictions):
         labels = np.asarray(labels)
@@ -32,7 +37,10 @@ class Evaluation:
             true_idx = labels.astype(np.int64)
             n = int(true_idx.max()) + 1 if self.num_classes is None else self.num_classes
         pred_idx = predictions.argmax(axis=-1) if predictions.ndim > 1 else predictions.astype(np.int64)
-        self._ensure(predictions.shape[-1] if predictions.ndim > 1 else n)
+        needed = predictions.shape[-1] if predictions.ndim > 1 else int(
+            max(n, int(pred_idx.max()) + 1, int(true_idx.max()) + 1)
+        )
+        self._ensure(needed)
         np.add.at(self.confusion, (true_idx.reshape(-1), pred_idx.reshape(-1)), 1)
 
     # ---- metrics (ND4J naming) -------------------------------------------
@@ -98,7 +106,10 @@ class ROC:
         self.labels: list[np.ndarray] = []
 
     def eval(self, labels, scores):
-        labels = np.asarray(labels).reshape(-1)
+        labels = np.asarray(labels)
+        if labels.ndim > 1 and labels.shape[-1] == 2:
+            labels = labels.argmax(axis=-1)  # one-hot -> class index
+        labels = labels.reshape(-1)
         scores = np.asarray(scores)
         if scores.ndim > 1 and scores.shape[-1] == 2:
             scores = scores[..., 1]

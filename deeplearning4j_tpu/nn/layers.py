@@ -374,6 +374,8 @@ class LossLayer(Layer):
         logits_fn, act_fn, fused_act = losses_mod.resolve(self.loss)
         if logits_fn is not None and fused_act == self.activation.lower():
             return logits_fn(x, labels, weights)
+        if act_fn is None:
+            raise ValueError(f"loss {self.loss} requires activation {fused_act}")
         preds = act.resolve(self.activation)(x)
         return act_fn(preds, labels, weights)
 

@@ -68,9 +68,8 @@ class MultiLayerNetwork:
         ]
         self._output_shape = cur
         self._train_step = self._build_train_step()
-        self._forward_jit = jax.jit(
-            functools.partial(self._forward, training=False), static_argnames=()
-        )
+        self._forward_jit = jax.jit(functools.partial(self._forward, training=False))
+        self._forward_train_jit = jax.jit(functools.partial(self._forward, training=True))
         return self
 
     def num_params(self) -> int:
@@ -100,8 +99,10 @@ class MultiLayerNetwork:
             new_states.append(ns)
         return h, new_states
 
-    def _loss(self, params, states, x, y, keys):
-        """Forward through all but the output layer, then fused loss."""
+    def _loss(self, params, states, x, y, keys, weights=None):
+        """Forward through all but the output layer, then fused loss.
+        ``weights``: optional per-example loss weights (ParallelWrapper uses
+        zeros to mask padded examples exactly)."""
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states = []
@@ -112,7 +113,8 @@ class MultiLayerNetwork:
         if not hasattr(out, "compute_loss"):
             raise ValueError("last layer must be an OutputLayer/LossLayer")
         loss = out.compute_loss(
-            cparams[-1], states[-1], h, y, training=True, key=keys[-1]
+            cparams[-1], states[-1], h, y, training=True, key=keys[-1],
+            weights=weights,
         )
         new_states.append(states[-1])
         reg = sum(
@@ -122,15 +124,18 @@ class MultiLayerNetwork:
         return loss.astype(jnp.float32) + reg, new_states
 
     # ------------------------------------------------------------ train step
-    def _build_train_step(self):
+    def make_step_fn(self, weighted: bool = False):
+        """The un-jitted train step (forward+AD+updaters). ParallelWrapper
+        reuses this under mesh shardings; ``weighted`` adds a per-example
+        loss-weight argument."""
         updaters = self._updaters
         n_layers = len(self.layers)
 
-        def step(params, states, opt_states, iteration, x, y, key):
+        def step(params, states, opt_states, iteration, x, y, key, weights=None):
             keys = list(jax.random.split(key, n_layers))
             (loss, new_states), grads = jax.value_and_grad(
                 self._loss, has_aux=True
-            )(params, states, x, y, keys)
+            )(params, states, x, y, keys, weights)
             new_params, new_opts = [], []
             for i in range(n_layers):
                 if not grads[i]:
@@ -144,13 +149,21 @@ class MultiLayerNetwork:
                 new_opts.append(s)
             return new_params, new_states, new_opts, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        if weighted:
+            return step
+        return lambda params, states, opt_states, iteration, x, y, key: step(
+            params, states, opt_states, iteration, x, y, key
+        )
+
+    def _build_train_step(self):
+        return jax.jit(self.make_step_fn(), donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(iterator) | fit(iterator, epochs=N)."""
         if labels is not None:
-            self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
+            for _ in range(epochs):
+                self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
             return self
         for _ in range(epochs):
             if hasattr(data, "reset"):
@@ -176,8 +189,13 @@ class MultiLayerNetwork:
 
     # ---------------------------------------------------------------- output
     def output(self, x, train: bool = False):
-        """Inference forward pass (MultiLayerNetwork.output parity). The
-        OutputLayer's apply() gives dense+activation, i.e. probabilities."""
+        """Forward pass (MultiLayerNetwork.output parity). The OutputLayer's
+        apply() gives dense+activation, i.e. probabilities. ``train=True``
+        uses training-mode statistics (e.g. batchnorm batch stats) but no
+        dropout (no RNG is threaded, matching the reference's output(train))."""
+        if train:
+            out, _ = self._forward_train_jit(self.params, self.states, jnp.asarray(x))
+            return out
         out, _ = self._forward_jit(self.params, self.states, jnp.asarray(x))
         return out
 
@@ -194,7 +212,6 @@ class MultiLayerNetwork:
         """Loss on a dataset (MultiLayerNetwork.score parity)."""
         if dataset is not None:
             x, y = dataset.features, dataset.labels
-        keys = [None] * len(self.layers)
         loss, _ = self._loss_eval(self.params, self.states, jnp.asarray(x), jnp.asarray(y))
         return float(loss)
 

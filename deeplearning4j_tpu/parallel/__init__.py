@@ -1,0 +1,10 @@
+"""Scale-out: device meshes, data/tensor/sequence parallelism, serving.
+
+Reference parity: deeplearning4j-scaleout (ParallelWrapper, ParallelInference,
+Spark training masters) + nd4j-parameter-server — SURVEY.md §2.3/§2.4. The
+entire NCCL/Aeron/accumulator machinery collapses into sharding annotations on
+one SPMD program: XLA emits the collectives over ICI/DCN.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper  # noqa: F401

@@ -1,0 +1,84 @@
+"""TrainingMesh — named device mesh + sharding helpers.
+
+The TPU-native replacement for the reference's device-topology plumbing
+(CudaEnvironment/affinity in nd4j-cuda, MeshOrganizer spanning-tree in the
+parameter server — path-cite, mount empty this round): a
+``jax.sharding.Mesh`` with canonical axis names
+
+- ``data``  — batch (DP); gradients all-reduce over ICI
+- ``model`` — tensor parallelism (sharded matmuls)
+- ``seq``   — sequence/context parallelism (ring attention)
+
+Multi-host: the same mesh spans hosts (DCN between slices); construction is
+identical — jax.distributed bootstrap happens in parallel.distributed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainingMesh:
+    AXES = ("data", "model", "seq")
+
+    def __init__(self, data: int = 0, model: int = 1, seq: int = 1,
+                 devices: Optional[Sequence] = None):
+        devices = list(devices) if devices is not None else jax.devices()
+        n = len(devices)
+        if data <= 0:
+            if n % (model * seq) != 0:
+                raise ValueError(f"{n} devices not divisible by model*seq={model * seq}")
+            data = n // (model * seq)
+        total = data * model * seq
+        if total > n:
+            raise ValueError(f"mesh {data}x{model}x{seq} needs {total} devices, have {n}")
+        grid = np.array(devices[:total]).reshape(data, model, seq)
+        self.mesh = Mesh(grid, axis_names=self.AXES)
+        self.data, self.model, self.seq = data, model, seq
+
+    # -- shardings ---------------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, ndim: int = 2) -> NamedSharding:
+        """Shard dim 0 over 'data'."""
+        return NamedSharding(self.mesh, P("data", *([None] * (ndim - 1))))
+
+    def spec(self, *axes) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    def shard_batch(self, *arrays):
+        """Place host arrays with the batch dim sharded over 'data'."""
+        out = tuple(
+            jax.device_put(a, self.batch_sharding(np.ndim(a))) for a in arrays
+        )
+        return out if len(out) > 1 else out[0]
+
+    def replicate(self, tree, keep_existing: bool = True):
+        """Place a pytree fully replicated. Leaves already carrying a
+        NamedSharding on THIS mesh keep their placement (so tensor-parallel
+        shardings set on individual params survive ParallelWrapper setup)."""
+        sharding = self.replicated()
+
+        def place(x):
+            if (
+                keep_existing
+                and hasattr(x, "sharding")
+                and isinstance(x.sharding, NamedSharding)
+                and x.sharding.mesh == self.mesh
+            ):
+                return x
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(place, tree)
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model * self.seq
+
+    def __repr__(self):
+        return f"TrainingMesh(data={self.data}, model={self.model}, seq={self.seq})"

@@ -66,3 +66,17 @@ def test_regression_eval_known_values():
     assert ev.mean_absolute_error() == pytest.approx(1 / 3)
     assert 0 < ev.r_squared() < 1
     assert ev.pearson_correlation() == pytest.approx(1.0)
+
+
+def test_confusion_grows_for_later_higher_classes():
+    ev = Evaluation()
+    ev.eval(np.array([0, 1]), np.array([0, 1]))
+    ev.eval(np.array([2, 2]), np.array([2, 1]))  # class 2 first seen in batch 2
+    assert ev.confusion_matrix().shape == (3, 3)
+    assert ev.accuracy() == pytest.approx(3 / 4)
+
+
+def test_roc_accepts_onehot_labels():
+    roc = ROC()
+    roc.eval(np.eye(2)[[0, 0, 1, 1]], np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc.calculate_auc() == pytest.approx(1.0)

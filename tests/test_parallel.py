@@ -1,0 +1,152 @@
+"""Multi-device tests on the 8-virtual-device CPU mesh — the reference's
+'distributed without a cluster' strategy (SURVEY.md §4: embedded transport /
+local[N]); here: xla_force_host_platform_device_count=8."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import ArrayDataSetIterator
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper, TrainingMesh
+
+
+def _net(seed=42, updater=None):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Sgd(0.1))
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(OutputLayer(n_in=16, n_out=3))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _blobs(rng, n=256, n_classes=3, dim=4):
+    centers = rng.standard_normal((n_classes, dim)) * 3.0
+    ys = rng.integers(0, n_classes, n)
+    xs = (centers[ys] + rng.standard_normal((n, dim))).astype(np.float32)
+    return xs, np.eye(n_classes, dtype=np.float32)[ys]
+
+
+def test_mesh_construction(devices):
+    m = TrainingMesh(data=8)
+    assert m.n_devices == 8
+    m2 = TrainingMesh(data=4, model=2)
+    assert m2.mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    with pytest.raises(ValueError):
+        TrainingMesh(data=16)
+
+
+def test_mesh_sharding_placement(devices):
+    m = TrainingMesh(data=8)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    xs = m.shard_batch(x)
+    assert len(xs.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(xs), x)
+
+
+def test_parallel_wrapper_matches_single_device(rng, devices):
+    """DP over 8 devices must be numerically equivalent to single-device
+    training on the same global batch (sync averaging is exact)."""
+    xs, ys = _blobs(rng, n=64)
+    single = _net()
+    parallel_net = _net()
+    pw = ParallelWrapper(parallel_net, mesh=TrainingMesh(data=8))
+    it = ArrayDataSetIterator(xs, ys, batch=64)
+    single.fit(it, epochs=3)
+    pw.fit(ArrayDataSetIterator(xs, ys, batch=64), epochs=3)
+    np.testing.assert_allclose(
+        np.asarray(single.params[0]["W"]),
+        np.asarray(parallel_net.params[0]["W"]),
+        rtol=2e-4, atol=1e-5,
+    )
+
+
+def test_parallel_wrapper_learns(rng, devices):
+    xs, ys = _blobs(rng)
+    net = _net(updater=Adam(0.01))
+    pw = ParallelWrapper(net, mesh=TrainingMesh(data=8))
+    pw.fit(ArrayDataSetIterator(xs, ys, batch=64, shuffle=True), epochs=20)
+    ev = net.evaluate(ArrayDataSetIterator(xs, ys, batch=64))
+    assert ev.accuracy() > 0.95
+
+
+def test_parallel_wrapper_pads_ragged_batch(rng, devices):
+    xs, ys = _blobs(rng, n=30)  # not divisible by 8
+    net = _net()
+    pw = ParallelWrapper(net, mesh=TrainingMesh(data=8))
+    pw.fit(ArrayDataSetIterator(xs, ys, batch=30), epochs=1)
+    assert np.isfinite(net.get_score())
+
+
+def test_parallel_inference_matches_local(rng, devices):
+    xs, ys = _blobs(rng, n=37)  # ragged on purpose
+    net = _net(updater=Adam(0.01))
+    net.fit(ArrayDataSetIterator(xs, ys, batch=37), epochs=5)
+    local = np.asarray(net.output(xs))
+    pi = ParallelInference(net, mesh=TrainingMesh(data=8))
+    dist = pi.output(xs)
+    np.testing.assert_allclose(local, dist, rtol=1e-5, atol=1e-6)
+
+
+def test_gradients_allreduced_not_per_shard(rng, devices):
+    """The sharded step must produce the GLOBAL-batch gradient: train one step
+    on a batch whose halves are different; result must equal single-device."""
+    xs, ys = _blobs(rng, n=16)
+    a, b = _net(seed=9), _net(seed=9)
+    a.fit(xs, ys)
+    pw = ParallelWrapper(b, mesh=TrainingMesh(data=8))
+    pw.fit(ArrayDataSetIterator(xs, ys, batch=16), epochs=1)
+    np.testing.assert_allclose(
+        np.asarray(a.params[1]["W"]), np.asarray(b.params[1]["W"]),
+        rtol=2e-5, atol=1e-6,
+    )
+
+
+def test_tensor_parallel_dense_sharding(devices):
+    """TP: shard a big dense layer's W over the 'model' axis; forward must be
+    numerically identical to replicated execution (GSPMD all-gathers as
+    needed). This is the mesh-axis TP the reference lacks (SURVEY §2.3)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    m = TrainingMesh(data=4, model=2)
+    key = jax.random.PRNGKey(0)
+    W = jax.random.normal(key, (64, 128))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+
+    def f(x, W):
+        return jnp.tanh(x @ W).sum(axis=-1)
+
+    ref = f(x, W)
+    Ws = jax.device_put(W, NamedSharding(m.mesh, P(None, "model")))
+    xs = jax.device_put(x, NamedSharding(m.mesh, P("data", None)))
+    out = jax.jit(f)(xs, Ws)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5)
+
+
+def test_ragged_batch_gradient_exact(rng, devices):
+    """Padded rows carry zero loss weight: a ragged global batch must produce
+    the same update as single-device training on the same examples."""
+    xs, ys = _blobs(rng, n=13)  # 13 % 8 != 0
+    a, b = _net(seed=5), _net(seed=5)
+    a.fit(xs, ys)
+    pw = ParallelWrapper(b, mesh=TrainingMesh(data=8))
+    pw.fit(ArrayDataSetIterator(xs, ys, batch=13), epochs=1)
+    np.testing.assert_allclose(
+        np.asarray(a.params[0]["W"]), np.asarray(b.params[0]["W"]),
+        rtol=2e-5, atol=1e-6,
+    )
+
+
+def test_fit_array_epochs_honored(rng):
+    xs, ys = _blobs(rng, n=32)
+    net = _net()
+    net.fit(xs, ys, epochs=5)
+    assert net.iteration == 5
