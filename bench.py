@@ -1,0 +1,94 @@
+"""Driver benchmark: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Benches the flagship training path on the available accelerator (one real TPU
+chip under the driver; CPU otherwise). Metric matches BASELINE.md tracked
+metric 1: ResNet-50 train-step throughput, images/sec/chip, vs the north-star
+8,000 img/s/chip (BASELINE.json). Falls back to LeNet-5 MNIST throughput if
+the zoo model is unavailable.
+
+Methodology: synthetic data (no input-pipeline noise), one warmup step to
+trigger XLA compilation, then timed steady-state steps with device sync
+(block_until_ready) — measures the whole jitted train step: forward, reverse
+AD, updater, parameter write, on device.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_IMG_PER_SEC = 8000.0  # BASELINE.json north_star, TPU v5e per chip
+
+
+def _bench_net(net, x, y, steps: int, min_seconds: float = 3.0):
+    import jax
+
+    net._fit_batch(x, y)  # warmup: compile
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    done = 0
+    while done < steps or (time.perf_counter() - t0) < min_seconds:
+        net._fit_batch(x, y)
+        done += 1
+        if done >= steps * 10:
+            break
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return done * x.shape[0] / dt
+
+
+def bench_resnet50(batch: int, image: int, steps: int):
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ResNet50(num_classes=1000, input_shape=(image, image, 3)).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, image, image, 3)).astype(np.float32)
+    labels = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, size=batch)]
+    ips = _bench_net(net, x, y=labels, steps=steps)
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / NORTH_STAR_IMG_PER_SEC, 4),
+    }
+
+
+def bench_lenet(batch: int, steps: int):
+    import __graft_entry__ as ge
+
+    net = ge._flagship()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+    ips = _bench_net(net, x, y=labels, steps=steps)
+    return {
+        "metric": "lenet_mnist_train_images_per_sec",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": 0.0,  # no reference number recorded (BASELINE.md)
+    }
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    # Smaller config on CPU so the bench finishes; real sizes on the chip.
+    batch = 256 if on_tpu else 8
+    image = 224 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+    try:
+        result = bench_resnet50(batch=batch, image=image, steps=steps)
+    except Exception as e:  # zoo not built yet / OOM: fall back
+        print(f"resnet50 bench unavailable ({type(e).__name__}: {e}); "
+              "falling back to LeNet", file=sys.stderr)
+        result = bench_lenet(batch=512 if on_tpu else 64, steps=steps)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

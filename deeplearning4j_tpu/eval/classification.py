@@ -37,8 +37,12 @@ class Evaluation:
             true_idx = labels.astype(np.int64)
             n = int(true_idx.max()) + 1 if self.num_classes is None else self.num_classes
         pred_idx = predictions.argmax(axis=-1) if predictions.ndim > 1 else predictions.astype(np.int64)
-        needed = predictions.shape[-1] if predictions.ndim > 1 else int(
-            max(n, int(pred_idx.max()) + 1, int(true_idx.max()) + 1)
+        needed = int(
+            max(
+                predictions.shape[-1] if predictions.ndim > 1 else n,
+                int(pred_idx.max()) + 1,
+                int(true_idx.max()) + 1,
+            )
         )
         self._ensure(needed)
         np.add.at(self.confusion, (true_idx.reshape(-1), pred_idx.reshape(-1)), 1)

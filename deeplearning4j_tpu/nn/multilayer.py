@@ -164,6 +164,10 @@ class MultiLayerNetwork:
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
+                self.epoch += 1
+                for lst in self.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(self)
             return self
         for _ in range(epochs):
             if hasattr(data, "reset"):

@@ -97,8 +97,8 @@ class ParallelWrapper:
         pad = (d - n % d) % d
         w = np.ones(n + pad, dtype=np.float32)
         if pad:
-            x = np.concatenate([x, x[:pad]], axis=0)
-            y = np.concatenate([y, y[:pad]], axis=0)
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+            y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)], axis=0)
             w[n:] = 0.0
         return self.mesh.shard_batch(np.asarray(x), np.asarray(y), w)
 
@@ -135,6 +135,14 @@ class ParallelInference:
     def output(self, x):
         x = np.asarray(x)
         n = len(x)
+        if n > self.batch_limit:
+            # chunk to bound per-call device memory (the reference's queue
+            # coalescing bounds batches the same way)
+            chunks = [
+                self.output(x[i : i + self.batch_limit])
+                for i in range(0, n, self.batch_limit)
+            ]
+            return np.concatenate(chunks, axis=0)
         d = self.mesh.data
         pad = (d - n % d) % d
         if pad:
