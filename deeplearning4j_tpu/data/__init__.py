@@ -7,7 +7,7 @@ round. ETL breadth (DataVec record readers, TransformProcess) arrives in the
 utils/etl milestone.
 """
 
-from deeplearning4j_tpu.data.dataset import DataSet  # noqa: F401
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     ArrayDataSetIterator,
     DataSetIterator,

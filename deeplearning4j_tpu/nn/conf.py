@@ -139,6 +139,35 @@ class Builder:
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
+    def graph_builder(self):
+        """DAG builder (ComputationGraphConfiguration.GraphBuilder parity)."""
+        from deeplearning4j_tpu.nn.computation_graph import GraphBuilder
+
+        return GraphBuilder(self)
+
+    def _stamp_layer(self, lyr: L.Layer) -> L.Layer:
+        """Stamp builder-global defaults onto a layer that kept its own
+        defaults (NeuralNetConfiguration.Builder#layer inheritance)."""
+        updates = {}
+        if self._l1 and lyr.l1 == 0.0:
+            updates["l1"] = self._l1
+        if self._l2 and lyr.l2 == 0.0:
+            updates["l2"] = self._l2
+        if (
+            self._weight_init
+            and hasattr(lyr, "weight_init")
+            and lyr.weight_init == type(lyr).__dataclass_fields__["weight_init"].default
+        ):
+            updates["weight_init"] = self._weight_init
+        if (
+            self._activation
+            and hasattr(lyr, "activation")
+            and lyr.activation == type(lyr).__dataclass_fields__["activation"].default
+            and not isinstance(lyr, (L.OutputLayer, L.LossLayer))
+        ):
+            updates["activation"] = self._activation
+        return dataclasses.replace(lyr, **updates) if updates else lyr
+
 
 class ListBuilder:
     def __init__(self, parent: Builder):
@@ -155,29 +184,8 @@ class ListBuilder:
         return self
 
     def build(self) -> MultiLayerConfiguration:
-        stamped = []
-        for lyr in self._layers:
-            updates = {}
-            if self._p._l1 and lyr.l1 == 0.0:
-                updates["l1"] = self._p._l1
-            if self._p._l2 and lyr.l2 == 0.0:
-                updates["l2"] = self._p._l2
-            if (
-                self._p._weight_init
-                and hasattr(lyr, "weight_init")
-                and lyr.weight_init == type(lyr).__dataclass_fields__["weight_init"].default
-            ):
-                updates["weight_init"] = self._p._weight_init
-            if (
-                self._p._activation
-                and hasattr(lyr, "activation")
-                and lyr.activation == type(lyr).__dataclass_fields__["activation"].default
-                and not isinstance(lyr, (L.OutputLayer, L.LossLayer))
-            ):
-                updates["activation"] = self._p._activation
-            stamped.append(dataclasses.replace(lyr, **updates) if updates else lyr)
         return MultiLayerConfiguration(
-            layers=stamped,
+            layers=[self._p._stamp_layer(lyr) for lyr in self._layers],
             seed=self._p._seed,
             updater=self._p._updater,
             input_shape=self._input_shape,

@@ -164,21 +164,21 @@ class MultiLayerNetwork:
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
-                self.epoch += 1
-                for lst in self.listeners:
-                    if hasattr(lst, "on_epoch_end"):
-                        lst.on_epoch_end(self)
+                self._end_epoch()
             return self
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
             for ds in data:
                 self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels))
-            self.epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self)
+            self._end_epoch()
         return self
+
+    def _end_epoch(self):
+        self.epoch += 1
+        for lst in self.listeners:
+            if hasattr(lst, "on_epoch_end"):
+                lst.on_epoch_end(self)
 
     def _fit_batch(self, x, y):
         self._rng_key, sub = jax.random.split(self._rng_key)
