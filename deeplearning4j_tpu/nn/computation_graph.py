@@ -211,6 +211,7 @@ class ComputationGraph:
         self.epoch = 0
         self.listeners: list = []
         self.score_value: float = float("nan")
+        self._train_step = None
         self._updaters: Dict[str, Any] = {}
         for n in self.topo:
             if n.is_layer:
@@ -367,8 +368,16 @@ class ComputationGraph:
     def make_step_fn(self, weighted: bool = False):
         updaters = self._updaters
         layer_names = [n.name for n in self.topo if n.is_layer]
+        in_name = self.conf.inputs[0]
+        out_name = self.conf.outputs[0]
 
         def step(params, states, opt_states, iteration, inputs, labels, key, weights=None):
+            # Raw arrays (e.g. from ParallelWrapper) → dict form, for
+            # single-input/single-output graphs.
+            if not isinstance(inputs, dict):
+                inputs = {in_name: inputs}
+            if not isinstance(labels, dict):
+                labels = {out_name: labels}
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
             (loss, new_states), grads = jax.value_and_grad(self._loss, has_aux=True)(
@@ -394,10 +403,10 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(x, y) | fit(iterator) | fit(multi_data_set_iterator)."""
+        """fit(x, y) | fit([x1, x2], [y1, ...]) | fit(iterator)."""
         if labels is not None:
             for _ in range(epochs):
-                self._fit_batch([jnp.asarray(data)], [jnp.asarray(labels)])
+                self._fit_batch(data, labels)
                 self._end_epoch()
             return self
         for _ in range(epochs):
@@ -418,9 +427,13 @@ class ComputationGraph:
             if hasattr(lst, "on_epoch_end"):
                 lst.on_epoch_end(self)
 
-    def _fit_batch(self, features: Sequence, labels: Sequence):
-        inputs = dict(zip(self.conf.inputs, features))
-        labs = dict(zip(self.conf.outputs, labels))
+    def _fit_batch(self, features, labels):
+        if not isinstance(features, (list, tuple)):
+            features = [features]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
+        labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params, self.states, self.opt_states, loss = self._train_step(
             self.params, self.states, self.opt_states,
@@ -432,6 +445,18 @@ class ComputationGraph:
             lst.iteration_done(self, self.iteration, self.epoch)
 
     # ---------------------------------------------------------------- output
+    def make_forward_fn(self):
+        """fn(params, states, x) -> first-output activations, for serving
+        wrappers (ParallelInference) — single-input graphs."""
+        in_name = self.conf.inputs[0]
+        out_name = self.conf.outputs[0]
+
+        def fwd(params, states, x):
+            acts, _ = self._forward(params, states, {in_name: x}, training=False)
+            return acts[out_name]
+
+        return fwd
+
     def output(self, *inputs, train: bool = False):
         """Forward pass; returns a list of output activations (or a single
         array when the graph has one output — DL4J returns INDArray[]).

@@ -420,6 +420,129 @@ class Upsampling2D(Layer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class Deconvolution2D(Layer):
+    """Transposed convolution (conf/layers/Deconvolution2D.java)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: tuple = (2, 2)
+    stride: tuple = (2, 2)
+    padding: Any = "SAME"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+
+    def initialize(self, key, input_shape):
+        c_in = self.n_in or input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"W": winit.init(key, self.weight_init, (kh, kw, c_in, self.n_out))}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,))
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        x = self._maybe_dropout(x, training, key)
+        y = nnops.deconv2d(
+            x, params["W"], params.get("b"), strides=self.stride, padding=self.padding
+        )
+        return act.resolve(self.activation)(y), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            return (h * sh, w * sw, self.n_out)
+        kh, kw = self.kernel_size
+        return ((h - 1) * sh + kh, (w - 1) * sw + kw, self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise conv (conf/layers/SeparableConvolution2D.java —
+    the Xception building block)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: tuple = (3, 3)
+    stride: tuple = (1, 1)
+    padding: Any = "SAME"
+    depth_multiplier: int = 1
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+
+    def initialize(self, key, input_shape):
+        c_in = self.n_in or input_shape[-1]
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(key)
+        params = {
+            "depthW": winit.init(k1, self.weight_init, (kh, kw, c_in, self.depth_multiplier)),
+            "pointW": winit.init(k2, self.weight_init, (1, 1, c_in * self.depth_multiplier, self.n_out)),
+        }
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,))
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        x = self._maybe_dropout(x, training, key)
+        y = nnops.separable_conv2d(
+            x, params["depthW"], params["pointW"], params.get("b"),
+            strides=self.stride, padding=self.padding,
+        )
+        return act.resolve(self.activation)(y), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            return (-(-h // sh), -(-w // sw), self.n_out)
+        kh, kw = self.kernel_size
+        return ((h - kh) // sh + 1, (w - kw) // sw + 1, self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (conf/layers/LocalResponseNormalization.java — the
+    AlexNet-era normalization; GPU impl had a cuDNN helper)."""
+
+    n: int = 5  # window (depth radius = n // 2)
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        y = nnops.lrn(x, depth_radius=self.n // 2, bias=self.k,
+                      alpha=self.alpha, beta=self.beta)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Cropping2D(Layer):
+    """(conf/layers/convolutional/Cropping2D.java)."""
+
+    cropping: tuple = ((0, 0), (0, 0))  # ((top,bottom),(left,right))
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        (ct, cb), (cl, cr) = self.cropping
+        return x[:, ct : x.shape[1] - cb, cl : x.shape[2] - cr, :], state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        (ct, cb), (cl, cr) = self.cropping
+        return (h - ct - cb, w - cl - cr, c)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class LayerNormalization(Layer):
     """Layer norm over the last axis (SameDiff layers in the reference;
     first-class here for the transformer configs)."""

@@ -192,6 +192,15 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, self.epoch)
 
     # ---------------------------------------------------------------- output
+    def make_forward_fn(self):
+        """fn(params, states, x) -> output activations (serving wrappers)."""
+
+        def fwd(params, states, x):
+            out, _ = self._forward(params, states, x, training=False)
+            return out
+
+        return fwd
+
     def output(self, x, train: bool = False):
         """Forward pass (MultiLayerNetwork.output parity). The OutputLayer's
         apply() gives dense+activation, i.e. probabilities. ``train=True``

@@ -124,13 +124,7 @@ class ParallelInference:
         self.batch_limit = batch_limit
         self._params = self.mesh.replicate(model.params)
         self._states = self.mesh.replicate(model.states)
-        model_forward = model._forward
-
-        def fwd(params, states, x):
-            out, _ = model_forward(params, states, x, training=False)
-            return out
-
-        self._fwd = jax.jit(fwd)
+        self._fwd = jax.jit(model.make_forward_fn())
 
     def output(self, x):
         x = np.asarray(x)

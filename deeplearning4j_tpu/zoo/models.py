@@ -1,0 +1,434 @@
+"""Zoo model definitions (org/deeplearning4j/zoo/model/*.java parity).
+
+Every model is TPU-first: NHWC layout, fused conv+bn+relu left to XLA,
+ResNet/SqueezeNet/UNet expressed on ComputationGraph so the whole DAG traces
+into one XLA program. ``compute_dtype='bfloat16'`` puts the convs on the MXU
+in bf16 with fp32 params (recommended for benchmarks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.nn import (
+    ComputationGraph,
+    InputType,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    Deconvolution2D,
+    DenseLayer,
+    DropoutLayer,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    OutputLayer,
+    SeparableConvolution2D,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+
+
+@dataclasses.dataclass
+class ZooModel:
+    """Base (org/deeplearning4j/zoo/ZooModel.java parity)."""
+
+    num_classes: int = 1000
+    seed: int = 12345
+    input_shape: Tuple[int, int, int] = (224, 224, 3)  # HWC (NHWC batch layout)
+    compute_dtype: str = "float32"
+    updater: object = None
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the network (ZooModel.init parity)."""
+        conf = self.conf()
+        if hasattr(conf, "nodes"):
+            return ComputationGraph(conf).init()
+        return MultiLayerNetwork(conf).init()
+
+    def pretrained(self, *a, **kw):
+        raise NotImplementedError(
+            "pretrained weights need network egress (reference downloads from "
+            "dl4j blob storage); save/restore locally via ModelSerializer"
+        )
+
+    def _builder(self):
+        return (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(self.updater or Adam(1e-3))
+            .compute_dtype(self.compute_dtype)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Linear stacks (MultiLayerNetwork)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LeNet(ZooModel):
+    """zoo/model/LeNet.java — BASELINE config #1."""
+
+    num_classes: int = 10
+    input_shape: Tuple[int, int, int] = (28, 28, 1)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (
+            self._builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), padding="VALID", activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), padding="VALID", activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_in=500, n_out=self.num_classes))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+@dataclasses.dataclass
+class SimpleCNN(ZooModel):
+    """zoo/model/SimpleCNN.java."""
+
+    num_classes: int = 10
+    input_shape: Tuple[int, int, int] = (48, 48, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (
+            self._builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3), activation="relu"))
+            .layer(BatchNormalization())
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3), activation="relu"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3), activation="relu"))
+            .layer(BatchNormalization())
+            .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3), activation="relu"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2)))
+            .layer(DropoutLayer(rate=0.5))
+            .layer(GlobalPoolingLayer())
+            .layer(OutputLayer(n_in=32, n_out=self.num_classes))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+@dataclasses.dataclass
+class AlexNet(ZooModel):
+    """zoo/model/AlexNet.java (one-tower variant)."""
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (
+            self._builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4), padding="VALID", activation="relu"))
+            .layer(LocalResponseNormalization())
+            .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5), activation="relu"))
+            .layer(LocalResponseNormalization())
+            .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), activation="relu"))
+            .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), activation="relu"))
+            .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+            .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(DenseLayer(n_in=4096, n_out=4096, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_in=4096, n_out=self.num_classes))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+def _vgg_blocks(lb, spec):
+    for n_convs, channels in spec:
+        for _ in range(n_convs):
+            lb.layer(ConvolutionLayer(n_out=channels, kernel_size=(3, 3), activation="relu"))
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    return lb
+
+
+@dataclasses.dataclass
+class VGG16(ZooModel):
+    """zoo/model/VGG16.java."""
+
+    spec = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+    def conf(self):
+        h, w, c = self.input_shape
+        lb = self._builder().list()
+        _vgg_blocks(lb, self.spec)
+        return (
+            lb.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(DenseLayer(n_in=4096, n_out=4096, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_in=4096, n_out=self.num_classes))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+@dataclasses.dataclass
+class VGG19(VGG16):
+    """zoo/model/VGG19.java."""
+
+    spec = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+@dataclasses.dataclass
+class Darknet19(ZooModel):
+    """zoo/model/Darknet19.java."""
+
+    def conf(self):
+        h, w, c = self.input_shape
+
+        def conv_bn(lb, n_out, k):
+            lb.layer(ConvolutionLayer(n_out=n_out, kernel_size=(k, k), has_bias=False))
+            lb.layer(BatchNormalization())
+            lb.layer(ActivationLayer(activation="leakyrelu"))
+
+        lb = self._builder().list()
+        conv_bn(lb, 32, 3)
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conv_bn(lb, 64, 3)
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for a, b_, k in ((128, 64, 3), (256, 128, 3)):
+            conv_bn(lb, a, k)
+            conv_bn(lb, b_, 1)
+            conv_bn(lb, a, k)
+            lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for a, b_ in ((512, 256), (1024, 512)):
+            conv_bn(lb, a, 3)
+            conv_bn(lb, b_, 1)
+            conv_bn(lb, a, 3)
+            conv_bn(lb, b_, 1)
+            conv_bn(lb, a, 3)
+            if a == 512:
+                lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        lb.layer(ConvolutionLayer(n_out=self.num_classes, kernel_size=(1, 1)))
+        lb.layer(GlobalPoolingLayer())
+        return (
+            lb.layer(OutputLayer(n_in=self.num_classes, n_out=self.num_classes))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+# ---------------------------------------------------------------------------
+# DAG models (ComputationGraph)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResNet50(ZooModel):
+    """zoo/model/ResNet50.java — BASELINE config #2 and the flagship bench
+    model. ResNet-v1 bottleneck layout (stride on the first 1x1, as in the
+    reference/Keras); NHWC; every block is conv→bn→relu chains XLA fuses."""
+
+    updater: object = None
+
+    def conf(self):
+        h, w, c = self.input_shape
+        gb = (
+            self._builder()
+            .graph_builder()
+            .add_inputs("input")
+        )
+
+        def conv_bn(name, inp, n_out, k, stride=(1, 1), relu=True, pad="SAME"):
+            gb.add_layer(
+                f"{name}_conv",
+                ConvolutionLayer(n_out=n_out, kernel_size=(k, k), stride=stride,
+                                 padding=pad, has_bias=False),
+                inp,
+            )
+            gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            if relu:
+                gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_bn")
+                return f"{name}_relu"
+            return f"{name}_bn"
+
+        def bottleneck(name, inp, filters, stride, project):
+            f1, f2, f3 = filters
+            x = conv_bn(f"{name}_a", inp, f1, 1, stride=stride)
+            x = conv_bn(f"{name}_b", x, f2, 3)
+            x = conv_bn(f"{name}_c", x, f3, 1, relu=False)
+            if project:
+                sc = conv_bn(f"{name}_sc", inp, f3, 1, stride=stride, relu=False)
+            else:
+                sc = inp
+            gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+            gb.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+            return f"{name}_out"
+
+        x = conv_bn("stem", "input", 64, 7, stride=(2, 2))
+        gb.add_layer("stem_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), padding="SAME"), x)
+        x = "stem_pool"
+        stages = [
+            ("res2", 3, (64, 64, 256), (1, 1)),
+            ("res3", 4, (128, 128, 512), (2, 2)),
+            ("res4", 6, (256, 256, 1024), (2, 2)),
+            ("res5", 3, (512, 512, 2048), (2, 2)),
+        ]
+        for sname, blocks, filters, stride in stages:
+            x = bottleneck(f"{sname}a", x, filters, stride, project=True)
+            for i in range(1, blocks):
+                x = bottleneck(f"{sname}{chr(ord('a') + i)}", x, filters, (1, 1), project=False)
+        gb.add_layer("avgpool", GlobalPoolingLayer(), x)
+        gb.add_layer("output", OutputLayer(n_in=2048, n_out=self.num_classes), "avgpool")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()
+
+
+@dataclasses.dataclass
+class SqueezeNet(ZooModel):
+    """zoo/model/SqueezeNet.java — fire modules on ComputationGraph."""
+
+    def conf(self):
+        h, w, c = self.input_shape
+        gb = self._builder().graph_builder().add_inputs("input")
+
+        def fire(name, inp, squeeze, expand):
+            gb.add_layer(f"{name}_sq", ConvolutionLayer(n_out=squeeze, kernel_size=(1, 1), activation="relu"), inp)
+            gb.add_layer(f"{name}_e1", ConvolutionLayer(n_out=expand, kernel_size=(1, 1), activation="relu"), f"{name}_sq")
+            gb.add_layer(f"{name}_e3", ConvolutionLayer(n_out=expand, kernel_size=(3, 3), activation="relu"), f"{name}_sq")
+            gb.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+            return f"{name}_cat"
+
+        gb.add_layer("conv1", ConvolutionLayer(n_out=64, kernel_size=(3, 3), stride=(2, 2), padding="VALID", activation="relu"), "input")
+        gb.add_layer("pool1", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), "conv1")
+        x = fire("fire2", "pool1", 16, 64)
+        x = fire("fire3", x, 16, 64)
+        gb.add_layer("pool3", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), x)
+        x = fire("fire4", "pool3", 32, 128)
+        x = fire("fire5", x, 32, 128)
+        gb.add_layer("pool5", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), x)
+        x = fire("fire6", "pool5", 48, 192)
+        x = fire("fire7", x, 48, 192)
+        x = fire("fire8", x, 64, 256)
+        x = fire("fire9", x, 64, 256)
+        gb.add_layer("drop9", DropoutLayer(rate=0.5), x)
+        gb.add_layer("conv10", ConvolutionLayer(n_out=self.num_classes, kernel_size=(1, 1), activation="relu"), "drop9")
+        gb.add_layer("gap", GlobalPoolingLayer(), "conv10")
+        gb.add_layer("output", OutputLayer(n_in=self.num_classes, n_out=self.num_classes), "gap")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()
+
+
+@dataclasses.dataclass
+class UNet(ZooModel):
+    """zoo/model/UNet.java — encoder/decoder with skip merges. Output is a
+    per-pixel sigmoid map (the reference uses CnnLossLayer with XENT)."""
+
+    num_classes: int = 1
+    input_shape: Tuple[int, int, int] = (128, 128, 3)
+    base_filters: int = 16  # reference uses 64; configurable for memory
+
+    def conf(self):
+        h, w, c = self.input_shape
+        f = self.base_filters
+        gb = self._builder().graph_builder().add_inputs("input")
+
+        def double_conv(name, inp, n_out):
+            gb.add_layer(f"{name}_c1", ConvolutionLayer(n_out=n_out, kernel_size=(3, 3), activation="relu"), inp)
+            gb.add_layer(f"{name}_c2", ConvolutionLayer(n_out=n_out, kernel_size=(3, 3), activation="relu"), f"{name}_c1")
+            return f"{name}_c2"
+
+        # encoder
+        skips = []
+        x = "input"
+        for i, mult in enumerate((1, 2, 4, 8)):
+            x = double_conv(f"enc{i}", x, f * mult)
+            skips.append(x)
+            gb.add_layer(f"down{i}", SubsamplingLayer(kernel_size=(2, 2)), x)
+            x = f"down{i}"
+        x = double_conv("mid", x, f * 16)
+        # decoder
+        for i, mult in zip(range(3, -1, -1), (8, 4, 2, 1)):
+            gb.add_layer(f"up{i}", Deconvolution2D(n_out=f * mult, kernel_size=(2, 2), stride=(2, 2), activation="relu"), x)
+            gb.add_vertex(f"skip{i}", MergeVertex(), f"up{i}", skips[i])
+            x = double_conv(f"dec{i}", f"skip{i}", f * mult)
+        from deeplearning4j_tpu.nn.layers import LossLayer
+
+        gb.add_layer("logits", ConvolutionLayer(n_out=self.num_classes, kernel_size=(1, 1)), x)
+        gb.add_layer("output", LossLayer(loss="xent", activation="sigmoid"), "logits")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()
+
+
+@dataclasses.dataclass
+class Xception(ZooModel):
+    """zoo/model/Xception.java — separable convs with residual connections
+    (entry/middle/exit flow; middle-flow repeats configurable)."""
+
+    middle_repeats: int = 8
+
+    def conf(self):
+        h, w, c = self.input_shape
+        gb = self._builder().graph_builder().add_inputs("input")
+
+        def conv_bn(name, inp, n_out, k, stride=(1, 1), relu=True):
+            gb.add_layer(f"{name}_conv", ConvolutionLayer(n_out=n_out, kernel_size=(k, k), stride=stride, has_bias=False), inp)
+            gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            if relu:
+                gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_bn")
+                return f"{name}_relu"
+            return f"{name}_bn"
+
+        def sep_bn(name, inp, n_out, relu_before=True):
+            src = inp
+            if relu_before:
+                gb.add_layer(f"{name}_prerelu", ActivationLayer(activation="relu"), inp)
+                src = f"{name}_prerelu"
+            gb.add_layer(f"{name}_sep", SeparableConvolution2D(n_out=n_out, kernel_size=(3, 3), has_bias=False), src)
+            gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_sep")
+            return f"{name}_bn"
+
+        x = conv_bn("stem1", "input", 32, 3, stride=(2, 2))
+        x = conv_bn("stem2", x, 64, 3)
+        # entry-flow residual blocks
+        for i, n_out in enumerate((128, 256, 728)):
+            sc = conv_bn(f"entry{i}_sc", x, n_out, 1, stride=(2, 2), relu=False)
+            b = sep_bn(f"entry{i}_s1", x, n_out, relu_before=i > 0)
+            b = sep_bn(f"entry{i}_s2", b, n_out)
+            gb.add_layer(f"entry{i}_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), padding="SAME"), b)
+            gb.add_vertex(f"entry{i}_add", ElementWiseVertex(op="add"), f"entry{i}_pool", sc)
+            x = f"entry{i}_add"
+        # middle flow
+        for r in range(self.middle_repeats):
+            b = x
+            for j in range(3):
+                b = sep_bn(f"mid{r}_s{j}", b, 728)
+            gb.add_vertex(f"mid{r}_add", ElementWiseVertex(op="add"), b, x)
+            x = f"mid{r}_add"
+        # exit flow
+        sc = conv_bn("exit_sc", x, 1024, 1, stride=(2, 2), relu=False)
+        b = sep_bn("exit_s1", x, 728)
+        b = sep_bn("exit_s2", b, 1024)
+        gb.add_layer("exit_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), padding="SAME"), b)
+        gb.add_vertex("exit_add", ElementWiseVertex(op="add"), "exit_pool", sc)
+        b = sep_bn("exit_s3", "exit_add", 1536, relu_before=False)
+        gb.add_layer("exit_relu3", ActivationLayer(activation="relu"), b)
+        b = sep_bn("exit_s4", "exit_relu3", 2048, relu_before=False)
+        gb.add_layer("exit_relu4", ActivationLayer(activation="relu"), b)
+        gb.add_layer("gap", GlobalPoolingLayer(), "exit_relu4")
+        gb.add_layer("output", OutputLayer(n_in=2048, n_out=self.num_classes), "gap")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()

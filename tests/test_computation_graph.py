@@ -222,6 +222,35 @@ def test_stack_unstack(rng):
     np.testing.assert_allclose(np.asarray(back), b)
 
 
+def test_parallel_inference_serves_graph(rng):
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    net = ComputationGraph(_two_branch_graph()).init()
+    pi = ParallelInference(net)
+    x = rng.normal(size=(13, 4)).astype(np.float32)  # ragged vs 8 devices
+    out = pi.output(x)
+    assert out.shape == (13, 3)
+    np.testing.assert_allclose(out, np.asarray(net.output(x)), rtol=2e-3, atol=1e-5)
+
+
+def test_fit_multi_input_arrays(rng):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .graph_builder()
+        .add_inputs("a", "b")
+        .add_layer("out", OutputLayer(n_in=5, n_out=2), "a", "b")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(3), InputType.feed_forward(2))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    xa = rng.normal(size=(4, 3)).astype(np.float32)
+    xb = rng.normal(size=(4, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+    net.fit([xa, xb], [y], epochs=2)
+    assert np.isfinite(net.get_score())
+
+
 def test_json_round_trip():
     conf = _two_branch_graph()
     s = conf.to_json()
