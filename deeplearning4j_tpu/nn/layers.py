@@ -47,6 +47,9 @@ def register_layer(cls):
 def layer_from_dict(d: dict) -> "Layer":
     d = dict(d)
     cls = _LAYER_TYPES[d.pop("@layer")]
+    for k, v in list(d.items()):
+        if isinstance(v, dict) and "@layer" in v:  # nested wrapper (Bidirectional)
+            d[k] = layer_from_dict(v)
     return cls(**d)
 
 
@@ -293,15 +296,28 @@ class DropoutLayer(Layer):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class GlobalPoolingLayer(Layer):
-    """Global spatial pooling (conf/layers/GlobalPoolingLayer.java)."""
+    """Global pooling (conf/layers/GlobalPoolingLayer.java): spatial axes for
+    CNN (B,H,W,C) input, the time axis (mask-aware) for RNN (B,T,F) input —
+    same dual role as the reference."""
 
     pooling_type: str = "avg"
 
     def has_params(self):
         return False
 
-    def apply(self, params, state, x, *, training=False, key=None):
-        if self.pooling_type.lower() == "avg":
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        pt = self.pooling_type.lower()
+        if x.ndim == 3:  # (B,T,F) over time
+            if mask is not None:
+                m = mask[:, :, None].astype(x.dtype)
+                if pt == "avg":
+                    return jnp.sum(x * m, axis=1) / jnp.maximum(
+                        jnp.sum(m, axis=1), 1e-9
+                    ), state
+                neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+                return jnp.max(jnp.where(m > 0, x, neg), axis=1), state
+            return (jnp.mean(x, axis=1) if pt == "avg" else jnp.max(x, axis=1)), state
+        if pt == "avg":
             return nnops.global_avg_pool(x), state
         return nnops.global_max_pool(x), state
 
