@@ -77,18 +77,27 @@ class Layer:
         return True
 
     def regularization(self, params) -> jnp.ndarray:
-        """L1/L2 penalty on weight params (DL4J applies it to W, not biases)."""
+        """L1/L2 penalty on weight params (DL4J applies it to W, not biases).
+        Recurses into nested param dicts (e.g. Bidirectional's fwd/bwd) so the
+        bias check only ever sees leaf names."""
         reg = jnp.asarray(0.0, dtype=jnp.float32)
         if not params:
             return reg
-        for name, p in params.items():
-            if name.startswith("b") or name in ("gamma", "beta", "mean", "var"):
-                continue
-            if self.l1:
-                reg = reg + self.l1 * jnp.sum(jnp.abs(p))
-            if self.l2:
-                reg = reg + 0.5 * self.l2 * jnp.sum(jnp.square(p))
-        return reg
+
+        def walk(d, reg):
+            for name, p in d.items():
+                if isinstance(p, dict):
+                    reg = walk(p, reg)
+                    continue
+                if name.startswith("b") or name in ("gamma", "beta", "mean", "var"):
+                    continue
+                if self.l1:
+                    reg = reg + self.l1 * jnp.sum(jnp.abs(p))
+                if self.l2:
+                    reg = reg + 0.5 * self.l2 * jnp.sum(jnp.square(p))
+            return reg
+
+        return walk(params, reg)
 
     def _maybe_dropout(self, x, training, key):
         if training and self.dropout > 0.0 and key is not None:
@@ -301,12 +310,15 @@ class GlobalPoolingLayer(Layer):
     same dual role as the reference."""
 
     pooling_type: str = "avg"
+    pnorm: int = 2
 
     def has_params(self):
         return False
 
     def apply(self, params, state, x, *, training=False, key=None, mask=None):
         pt = self.pooling_type.lower()
+        if pt not in ("avg", "max", "sum", "pnorm"):
+            raise ValueError(f"unknown pooling_type {self.pooling_type!r}")
         if x.ndim == 3:  # (B,T,F) over time
             if mask is not None:
                 m = mask[:, :, None].astype(x.dtype)
@@ -314,12 +326,36 @@ class GlobalPoolingLayer(Layer):
                     return jnp.sum(x * m, axis=1) / jnp.maximum(
                         jnp.sum(m, axis=1), 1e-9
                     ), state
+                if pt == "sum":
+                    return jnp.sum(x * m, axis=1), state
+                if pt == "pnorm":
+                    return jnp.power(
+                        jnp.sum(jnp.power(jnp.abs(x) * m, self.pnorm), axis=1),
+                        1.0 / self.pnorm,
+                    ), state
                 neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
                 return jnp.max(jnp.where(m > 0, x, neg), axis=1), state
-            return (jnp.mean(x, axis=1) if pt == "avg" else jnp.max(x, axis=1)), state
+            if pt == "avg":
+                return jnp.mean(x, axis=1), state
+            if pt == "sum":
+                return jnp.sum(x, axis=1), state
+            if pt == "pnorm":
+                return jnp.power(
+                    jnp.sum(jnp.power(jnp.abs(x), self.pnorm), axis=1),
+                    1.0 / self.pnorm,
+                ), state
+            return jnp.max(x, axis=1), state
+        spatial = tuple(range(1, x.ndim - 1))  # (B,H,W,C) / (B,D,H,W,C)
         if pt == "avg":
-            return nnops.global_avg_pool(x), state
-        return nnops.global_max_pool(x), state
+            return jnp.mean(x, axis=spatial), state
+        if pt == "sum":
+            return jnp.sum(x, axis=spatial), state
+        if pt == "pnorm":
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(x), self.pnorm), axis=spatial),
+                1.0 / self.pnorm,
+            ), state
+        return jnp.max(x, axis=spatial), state
 
     def output_shape(self, input_shape):
         return (input_shape[-1],)

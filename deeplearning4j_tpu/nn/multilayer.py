@@ -20,6 +20,7 @@ TrainingListener.iterationDone cadence).
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -47,6 +48,14 @@ class MultiLayerNetwork:
             (lyr.updater or conf.updater or upd.Sgd(0.1)) for lyr in conf.layers
         ]
         self._rng_key = jax.random.PRNGKey(conf.seed)
+        # Mask plumbing (setLayerMaskArrays/feedForwardMaskArray parity):
+        # which layers' apply()/compute_loss() accept a mask kwarg.
+        self._mask_aware = [
+            "mask" in inspect.signature(lyr.apply).parameters for lyr in self.layers
+        ]
+        self._loss_mask_aware = hasattr(self.layers[-1], "compute_loss") and (
+            "mask" in inspect.signature(self.layers[-1].compute_loss).parameters
+        )
 
     # ------------------------------------------------------------------ init
     def init(self, input_shape=None) -> "MultiLayerNetwork":
@@ -89,32 +98,59 @@ class MultiLayerNetwork:
             params,
         )
 
-    def _forward(self, params, states, x, *, training, keys=None):
+    def _forward(self, params, states, x, *, training, keys=None, mask=None):
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states = []
         for i, lyr in enumerate(self.layers):
             k = keys[i] if keys is not None else None
-            h, ns = lyr.apply(cparams[i], states[i], h, training=training, key=k)
+            kw = {}
+            if (
+                mask is not None
+                and self._mask_aware[i]
+                and h.ndim == 3
+                and mask.shape[:2] == h.shape[:2]
+            ):
+                kw["mask"] = mask
+            h, ns = lyr.apply(cparams[i], states[i], h, training=training, key=k, **kw)
             new_states.append(ns)
+            if h.ndim < 3:
+                mask = None  # time axis consumed (LastTimeStep/GlobalPooling)
         return h, new_states
 
-    def _loss(self, params, states, x, y, keys, weights=None):
+    def _loss(self, params, states, x, y, keys, weights=None, mask=None,
+              label_mask=None):
         """Forward through all but the output layer, then fused loss.
         ``weights``: optional per-example loss weights (ParallelWrapper uses
-        zeros to mask padded examples exactly)."""
+        zeros to mask padded examples exactly). ``mask``/``label_mask``:
+        (B,T) feature/label masks for variable-length sequences."""
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states = []
+        fmask = mask
         for i, lyr in enumerate(self.layers[:-1]):
-            h, ns = lyr.apply(cparams[i], states[i], h, training=True, key=keys[i])
+            kw = {}
+            if (
+                fmask is not None
+                and self._mask_aware[i]
+                and h.ndim == 3
+                and fmask.shape[:2] == h.shape[:2]
+            ):
+                kw["mask"] = fmask
+            h, ns = lyr.apply(cparams[i], states[i], h, training=True, key=keys[i], **kw)
             new_states.append(ns)
+            if h.ndim < 3:
+                fmask = None
         out = self.layers[-1]
         if not hasattr(out, "compute_loss"):
             raise ValueError("last layer must be an OutputLayer/LossLayer")
+        loss_kw = {}
+        lm = label_mask if label_mask is not None else fmask
+        if lm is not None and self._loss_mask_aware:
+            loss_kw["mask"] = lm
         loss = out.compute_loss(
             cparams[-1], states[-1], h, y, training=True, key=keys[-1],
-            weights=weights,
+            weights=weights, **loss_kw,
         )
         new_states.append(states[-1])
         reg = sum(
@@ -131,11 +167,12 @@ class MultiLayerNetwork:
         updaters = self._updaters
         n_layers = len(self.layers)
 
-        def step(params, states, opt_states, iteration, x, y, key, weights=None):
+        def step(params, states, opt_states, iteration, x, y, key, weights=None,
+                 mask=None, label_mask=None):
             keys = list(jax.random.split(key, n_layers))
             (loss, new_states), grads = jax.value_and_grad(
                 self._loss, has_aux=True
-            )(params, states, x, y, keys, weights)
+            )(params, states, x, y, keys, weights, mask, label_mask)
             new_params, new_opts = [], []
             for i in range(n_layers):
                 if not grads[i]:
@@ -151,8 +188,10 @@ class MultiLayerNetwork:
 
         if weighted:
             return step
-        return lambda params, states, opt_states, iteration, x, y, key: step(
-            params, states, opt_states, iteration, x, y, key
+        return lambda params, states, opt_states, iteration, x, y, key, \
+            mask=None, label_mask=None: step(
+            params, states, opt_states, iteration, x, y, key,
+            mask=mask, label_mask=label_mask,
         )
 
     def _build_train_step(self):
@@ -170,7 +209,13 @@ class MultiLayerNetwork:
             if hasattr(data, "reset"):
                 data.reset()
             for ds in data:
-                self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels))
+                self._fit_batch(
+                    jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                    mask=None if getattr(ds, "features_mask", None) is None
+                    else jnp.asarray(ds.features_mask),
+                    label_mask=None if getattr(ds, "labels_mask", None) is None
+                    else jnp.asarray(ds.labels_mask),
+                )
             self._end_epoch()
         return self
 
@@ -180,11 +225,12 @@ class MultiLayerNetwork:
             if hasattr(lst, "on_epoch_end"):
                 lst.on_epoch_end(self)
 
-    def _fit_batch(self, x, y):
+    def _fit_batch(self, x, y, mask=None, label_mask=None):
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params, self.states, self.opt_states, loss = self._train_step(
             self.params, self.states, self.opt_states,
             jnp.asarray(self.iteration), x, y, sub,
+            mask=mask, label_mask=label_mask,
         )
         self.score_value = loss  # fetched lazily; float() forces transfer
         self.iteration += 1
@@ -201,15 +247,15 @@ class MultiLayerNetwork:
 
         return fwd
 
-    def output(self, x, train: bool = False):
+    def output(self, x, train: bool = False, mask=None):
         """Forward pass (MultiLayerNetwork.output parity). The OutputLayer's
         apply() gives dense+activation, i.e. probabilities. ``train=True``
         uses training-mode statistics (e.g. batchnorm batch stats) but no
-        dropout (no RNG is threaded, matching the reference's output(train))."""
-        if train:
-            out, _ = self._forward_train_jit(self.params, self.states, jnp.asarray(x))
-            return out
-        out, _ = self._forward_jit(self.params, self.states, jnp.asarray(x))
+        dropout (no RNG is threaded, matching the reference's output(train)).
+        ``mask``: (B,T) feature mask (output(x, fMask) parity)."""
+        mk = None if mask is None else jnp.asarray(mask)
+        fn = self._forward_train_jit if train else self._forward_jit
+        out, _ = fn(self.params, self.states, jnp.asarray(x), mask=mk)
         return out
 
     def feed_forward(self, x):

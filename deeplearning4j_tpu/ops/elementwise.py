@@ -229,3 +229,15 @@ op("scalar_set", "scalar", differentiable=False)(lambda x, s: jnp.full_like(x, s
 op("step", "scalar", differentiable=False)(
     lambda x, s=0.0: (x > s).astype(x.dtype)
 )
+
+
+# Bitwise shifts (reference: libnd4j declarable bitwise ops shift_bits /
+# rshift_bits and SDBitwise.leftShift/rightShift — path-cite).
+op("shift_left", "pairwise_bool", aliases=("left_shift", "shift_bits"),
+   differentiable=False)(
+    lambda x, y: jnp.left_shift(jnp.asarray(x), jnp.asarray(y))
+)
+op("shift_right", "pairwise_bool", aliases=("right_shift", "rshift_bits"),
+   differentiable=False)(
+    lambda x, y: jnp.right_shift(jnp.asarray(x), jnp.asarray(y))
+)

@@ -285,3 +285,15 @@ def segment_mean(data, segment_ids, num_segments):
     sums = jax.ops.segment_sum(data, segment_ids, num_segments)
     counts = jax.ops.segment_sum(jnp.ones_like(segment_ids, dtype=data.dtype), segment_ids, num_segments)
     return sums / jnp.maximum(counts, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+@op("confusion_matrix", "custom", differentiable=False)
+def confusion_matrix(labels, predictions, num_classes, weights=None):
+    """Counts[i,j] = weighted #(label==i, pred==j) — SDMath.confusionMatrix /
+    the reference's confusion_matrix declarable op (path-cite)."""
+    li = jnp.asarray(labels).astype(jnp.int32).reshape(-1)
+    pi = jnp.asarray(predictions).astype(jnp.int32).reshape(-1)
+    w = (jnp.ones_like(li, dtype=jnp.float32) if weights is None
+         else jnp.asarray(weights).reshape(-1))
+    flat = jnp.zeros((num_classes * num_classes,), w.dtype)
+    return flat.at[li * num_classes + pi].add(w).reshape(num_classes, num_classes)

@@ -1,0 +1,22 @@
+"""SameDiff-parity define-by-graph API (SURVEY.md §2.2 J3, §3.3).
+
+Reference parity: org/nd4j/autodiff/samediff/SameDiff.java, SDVariable.java,
+internal/{AbstractSession,InferenceSession,TrainingSession}.java and the
+namespaced op factories (ops/SD*.java) — path-cite, mount empty this round.
+
+TPU-native design: instead of the reference's op-at-a-time JVM session
+interpretation (one JNI crossing per op), the recorded graph is traced into
+ONE jaxpr/StableHLO program and compiled once per (outputs, input-shapes)
+signature — the whole forward (or forward+backward+updater) step is a single
+device launch. Reverse-mode autodiff is jax.grad over the traced function,
+replacing every per-op ``doDiff``.
+"""
+
+from deeplearning4j_tpu.samediff.core import (
+    SameDiff,
+    SDVariable,
+    TrainingConfig,
+    VariableType,
+)
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "VariableType"]
