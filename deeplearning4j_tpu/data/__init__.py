@@ -13,3 +13,9 @@ from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     DataSetIterator,
     MnistDataSetIterator,
 )
+from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
+    DataNormalization,
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)

@@ -1,0 +1,34 @@
+"""DataVec-parity ETL (SURVEY.md §2.2 J12).
+
+Reference: the `datavec/` module family — RecordReader zoo
+(org/datavec/api/records/reader/impl/**), schema-typed TransformProcess
+(org/datavec/api/transform/TransformProcess.java), image loading
+(datavec-data-image NativeImageLoader via JavaCPP OpenCV) — path-cites, mount
+empty this round.
+
+TPU-native stance: ETL is host-side work feeding the device input pipeline;
+records are plain Python lists / numpy arrays (no Writable object hierarchy —
+that existed for JVM serialization), transforms are pure functions over
+columns, and the iterator layer batches straight into numpy for device_put.
+"""
+
+from deeplearning4j_tpu.datavec.records import (  # noqa: F401
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReader,
+    RegexLineRecordReader,
+    SVMLightRecordReader,
+    TransformProcessRecordReader,
+)
+from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
+    ColumnType,
+    Schema,
+    TransformProcess,
+)
+from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)

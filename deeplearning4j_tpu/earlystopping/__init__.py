@@ -1,0 +1,349 @@
+"""Early stopping — org/deeplearning4j/earlystopping/** parity.
+
+Reference components (path-cite, mount empty this round):
+``EarlyStoppingConfiguration`` builder, epoch termination conditions
+(``MaxEpochsTerminationCondition``, ``ScoreImprovementEpochTerminationCondition``),
+iteration termination conditions (``MaxTimeIterationTerminationCondition``,
+``MaxScoreIterationTerminationCondition``, ``InvalidScoreIterationTerminationCondition``),
+``ScoreCalculator`` (``DataSetLossCalculator``), model savers
+(``InMemoryModelSaver``, ``LocalFileModelSaver``), ``EarlyStoppingTrainer``
+returning an ``EarlyStoppingResult`` with a ``TerminationReason``.
+
+The training loop itself is the jitted whole-step program from
+MultiLayerNetwork/ComputationGraph — early stopping is host-side control
+around it (scores are the only device→host traffic).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import os
+import time
+
+import jax
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, List, Optional
+
+
+# ----------------------------------------------------------------- conditions
+class EpochTerminationCondition:
+    requires_score = False  # skip on epochs with no validation score
+
+    def initialize(self): ...
+    def terminate(self, epoch: int, score: float) -> bool: ...
+
+
+class IterationTerminationCondition:
+    def initialize(self): ...
+    def terminate(self, score: float) -> bool: ...
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after ``max_epochs_without_improvement`` epochs with < min_improvement."""
+
+    requires_score = True
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+
+    def initialize(self):
+        self.best = math.inf
+        self.since = 0
+
+    def terminate(self, epoch, score):
+        if self.best - score >= self.min_improvement:
+            self.best = score
+            self.since = 0
+        else:
+            self.since += 1
+        return self.since > self.patience
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+
+    def initialize(self):
+        self.start = time.monotonic()
+
+    def terminate(self, score):
+        return time.monotonic() - self.start > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, score):
+        return score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    def terminate(self, score):
+        return math.isnan(score) or math.isinf(score)
+
+
+# ------------------------------------------------------------------- scoring
+class ScoreCalculator:
+    def calculate_score(self, model) -> float: ...
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Mean loss over a held-out iterator (DataSetLossCalculator parity)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model):
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            b = ds.features.shape[0] if hasattr(ds.features, "shape") else len(ds.features)
+            total += model.score(ds) * b
+            n += b
+        return total / n if self.average and n else total
+
+
+# -------------------------------------------------------------------- savers
+def _host_snapshot(model):
+    """Shallow-copy the model with params/states/opt_states pulled to host
+    numpy. The jitted train step donates the device buffers
+    (donate_argnums=(0,1,2) in MultiLayerNetwork._build_train_step), so a
+    plain reference-sharing copy would hold deleted arrays after the next
+    iteration on TPU; host copies are immune."""
+    import numpy as np
+
+    snap = copy.copy(model)
+    to_host = lambda t: jax.tree_util.tree_map(lambda x: np.asarray(x), t)
+    snap.params = to_host(model.params)
+    snap.states = to_host(model.states)
+    snap.opt_states = to_host(model.opt_states)
+    snap.listeners = []  # don't carry live listeners (e.g. the trainer's guard)
+    return snap
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best_model(self, model, score):
+        self.best = _host_snapshot(model)
+
+    def save_latest_model(self, model, score):
+        self.latest = _host_snapshot(model)
+
+    def get_best_model(self):
+        return self.best
+
+
+class LocalFileModelSaver:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score):
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        ModelSerializer.write_model(model, self._path("bestModel.zip"))
+
+    def save_latest_model(self, model, score):
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        ModelSerializer.write_model(model, self._path("latestModel.zip"))
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        return ModelSerializer.restore_model(self._path("bestModel.zip"))
+
+
+# --------------------------------------------------------------------- config
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: ScoreCalculator
+    model_saver: Any = field(default_factory=InMemoryModelSaver)
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(
+        default_factory=list
+    )
+    iteration_termination_conditions: List[IterationTerminationCondition] = field(
+        default_factory=list
+    )
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+    class Builder:
+        def __init__(self):
+            self._score_calc = None
+            self._saver = None
+            self._epoch_conds = []
+            self._iter_conds = []
+            self._every_n = 1
+            self._save_last = False
+
+        def score_calculator(self, sc):
+            self._score_calc = sc
+            return self
+
+        def model_saver(self, s):
+            self._saver = s
+            return self
+
+        def epoch_termination_conditions(self, *conds):
+            self._epoch_conds.extend(conds)
+            return self
+
+        def iteration_termination_conditions(self, *conds):
+            self._iter_conds.extend(conds)
+            return self
+
+        def evaluate_every_n_epochs(self, n):
+            self._every_n = n
+            return self
+
+        def save_last_model(self, b=True):
+            self._save_last = b
+            return self
+
+        def build(self):
+            return EarlyStoppingConfiguration(
+                score_calculator=self._score_calc,
+                model_saver=self._saver or InMemoryModelSaver(),
+                epoch_termination_conditions=self._epoch_conds,
+                iteration_termination_conditions=self._iter_conds,
+                evaluate_every_n_epochs=self._every_n,
+                save_last_model=self._save_last,
+            )
+
+    @staticmethod
+    def builder():
+        return EarlyStoppingConfiguration.Builder()
+
+
+class TerminationReason(Enum):
+    Error = "Error"
+    IterationTerminationCondition = "IterationTerminationCondition"
+    EpochTerminationCondition = "EpochTerminationCondition"
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: TerminationReason
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    score_vs_epoch: dict
+    best_model: Any
+
+
+# -------------------------------------------------------------------- trainer
+class EarlyStoppingTrainer:
+    """EarlyStoppingTrainer / EarlyStoppingGraphTrainer parity — drives
+    net.fit one epoch at a time, scoring and checking conditions between."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, network, train_iterator):
+        self.config = config
+        self.net = network
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+
+        best_score, best_epoch = math.inf, -1
+        scores: dict = {}
+        epoch = 0
+        reason, details = TerminationReason.EpochTerminationCondition, "max loop"
+
+        class _IterGuard:
+            """Listener checking iteration conditions during the epoch."""
+
+            def __init__(self):
+                self.tripped: Optional[str] = None
+
+            def iteration_done(self, model, iteration, ep):
+                if self.tripped:
+                    return
+                score = model.get_score()
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(score):
+                        self.tripped = type(c).__name__
+                        raise _IterStop(self.tripped)
+
+            def on_epoch_end(self, model):
+                pass
+
+        class _IterStop(Exception):
+            pass
+
+        saved_listeners = list(getattr(self.net, "listeners", []))
+        if cfg.iteration_termination_conditions:
+            # only install the guard when needed — get_score() forces a
+            # device→host sync per iteration
+            self.net.set_listeners(*saved_listeners, _IterGuard())
+        try:
+            while True:
+                try:
+                    if hasattr(self.iterator, "reset"):
+                        self.iterator.reset()
+                    self.net.fit(self.iterator, epochs=1)
+                except _IterStop as e:
+                    reason = TerminationReason.IterationTerminationCondition
+                    details = str(e)
+                    break
+                epoch += 1
+                if epoch % cfg.evaluate_every_n_epochs == 0:
+                    score = cfg.score_calculator.calculate_score(self.net)
+                    scores[epoch] = score
+                    if score < best_score:
+                        best_score, best_epoch = score, epoch
+                        cfg.model_saver.save_best_model(self.net, score)
+                if cfg.save_last_model:  # every epoch, eval or not
+                    cfg.model_saver.save_latest_model(self.net, scores.get(epoch))
+                stop = False
+                for c in cfg.epoch_termination_conditions:
+                    if c.requires_score and epoch not in scores:
+                        continue  # no validation ran this epoch
+                    if c.terminate(epoch, scores.get(epoch, math.inf)):
+                        reason = TerminationReason.EpochTerminationCondition
+                        details = type(c).__name__
+                        stop = True
+                        break
+                if stop:
+                    break
+        finally:
+            self.net.set_listeners(*saved_listeners)
+
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            score_vs_epoch=scores,
+            best_model=cfg.model_saver.get_best_model(),
+        )
+
+
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

@@ -68,6 +68,54 @@ class CollectScoresListener(TrainingListener):
             self.scores.append((iteration, model.get_score()))
 
 
+class CheckpointListener(TrainingListener):
+    """Periodic keep-N checkpoints via ModelSerializer
+    (org/deeplearning4j/optimize/listeners/CheckpointListener.java parity:
+    saveEveryNIterations / saveEveryNEpochs / keepLast)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = 0,
+                 save_every_n_epochs: int = 0, keep_last: int = 0,
+                 save_updater: bool = True):
+        import os
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.save_every_n_iterations = save_every_n_iterations
+        self.save_every_n_epochs = save_every_n_epochs
+        self.keep_last = keep_last
+        self.save_updater = save_updater
+        self.saved: list[str] = []
+
+    def _save(self, model, iteration, epoch):
+        import os
+
+        from deeplearning4j_tpu.util import ModelSerializer
+
+        path = os.path.join(
+            self.directory, f"checkpoint_iter{iteration}_epoch{epoch}.zip"
+        )
+        ModelSerializer.write_model(model, path, save_updater=self.save_updater)
+        self.saved.append(path)
+        while self.keep_last and len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iteration_done(self, model, iteration, epoch):
+        if (
+            self.save_every_n_iterations
+            and iteration % self.save_every_n_iterations == 0
+        ):
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model):
+        if self.save_every_n_epochs and model.epoch % self.save_every_n_epochs == 0:
+            self._save(model, model.iteration, model.epoch)
+
+    def last_checkpoint(self):
+        return self.saved[-1] if self.saved else None
+
+
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation on a held-out iterator (EvaluativeListener parity)."""
 

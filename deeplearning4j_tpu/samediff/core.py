@@ -39,9 +39,29 @@ class VariableType(enum.Enum):
     ARRAY = "ARRAY"            # op output, recomputed
 
 
-# Ops whose registry lowering returns a tuple. Value = fixed arity, or the
-# name of the attr holding the arity for variadic ones.
-_MULTI_OUT: Dict[str, Union[int, str]] = {
+def _split_arity(sd, args, attrs):
+    ns = attrs.get("num_or_sections")
+    if ns is None:
+        raise ValueError("split requires num_or_sections")
+    return ns if isinstance(ns, int) else len(tuple(ns)) + 1
+
+
+def _unstack_arity(sd, args, attrs):
+    # 'num' is arity-only (the lowering takes just axis) — consume it here.
+    num = attrs.pop("num", None)
+    if num is not None:
+        return num
+    axis = attrs.get("axis", 0)
+    shp = args[0].shape if hasattr(args[0], "shape") else None
+    if shp is not None and shp[axis] is not None and shp[axis] >= 0:
+        return shp[axis]
+    raise ValueError("unstack requires num= when the input shape is unknown")
+
+
+# Ops whose registry lowering returns a tuple. Value = fixed arity, or a
+# callable (sd, args, attrs) -> arity for variadic ones (attr names match the
+# registered lowering's signature; arity-only attrs are popped).
+_MULTI_OUT: Dict[str, Any] = {
     "moments": 2,
     "top_k": 2,
     "qr": 2,
@@ -50,9 +70,10 @@ _MULTI_OUT: Dict[str, Union[int, str]] = {
     "eig": 2,
     "svd": 3,
     "batchnorm_train": 3,
-    "split": "num",
-    "unstack": "num",
-    "dynamic_partition": "num",
+    "split": _split_arity,
+    "split_v": lambda sd, args, attrs: len(tuple(attrs["sizes"])),
+    "unstack": _unstack_arity,
+    "dynamic_partition": lambda sd, args, attrs: attrs["num_partitions"],
 }
 
 
@@ -294,13 +315,15 @@ class _OpNamespace:
             )
 
         def factory(*args, name_out=None, **attrs):
-            n_out = _MULTI_OUT.get(name)
-            if isinstance(n_out, str):
-                n_out = attrs.get(n_out)
-                if n_out is None:
-                    raise ValueError(f"{name} requires attr for output arity")
+            spec = _MULTI_OUT.get(name)
+            if spec is None:
+                n_out = 1
+            elif isinstance(spec, int):
+                n_out = spec
+            else:
+                n_out = spec(self._sd, args, attrs)
             ins = [a for a in args]
-            return self._sd._op(name, ins, attrs=attrs, n_out=n_out or 1,
+            return self._sd._op(name, ins, attrs=attrs, n_out=n_out,
                                 name=name_out)
 
         factory.__name__ = name
@@ -433,9 +456,12 @@ class SameDiff:
         self._jit_cache: Dict[Any, Any] = {}
         self._train_step = None
         self._opt_state = None
+        self._it_count = 0  # persists across fit() calls (LR schedules, Adam bias corr.)
         self.training_config: Optional[TrainingConfig] = None
         self._listeners: List[Any] = []
         self._rng_counter = 0
+        self._device_cache: Optional[Dict[str, Any]] = None
+        self._grad_fn_cache: Dict[Any, Any] = {}
 
     # -- namespaces ---------------------------------------------------------
     @property
@@ -606,6 +632,8 @@ class SameDiff:
     def _invalidate(self):
         self._jit_cache.clear()
         self._train_step = None
+        self._device_cache = None
+        self._grad_fn_cache.clear()
 
     # -- execution ----------------------------------------------------------
     def _trace(self, values: Dict[str, Any], targets: Sequence[str]):
@@ -672,7 +700,12 @@ class SameDiff:
         return {name: np.asarray(r) for name, r in zip(outputs, res)}
 
     def _device_arrays(self):
-        return {k: jnp.asarray(v) for k, v in self._arrays.items()}
+        """Device-resident copies of stored arrays, cached until the graph or
+        a value changes (_invalidate/set_arr) — avoids re-uploading the full
+        weight set host→device on every output() call."""
+        if self._device_cache is None:
+            self._device_cache = {k: jnp.asarray(v) for k, v in self._arrays.items()}
+        return self._device_cache
 
     def exec(self, feeds: Dict[str, Any], *outputs: Union[str, SDVariable]):
         names = [o.name if isinstance(o, SDVariable) else o for o in outputs]
@@ -749,7 +782,12 @@ class SameDiff:
                 diff[n] = phs.pop(n)
             else:
                 raise ValueError(f"cannot differentiate wrt ARRAY var {n!r}")
-        grads = jax.jit(jax.grad(lossfn))(diff, rest, phs)
+        sig = (tuple(sorted(diff)), tuple(sorted(rest)), tuple(sorted(phs)))
+        gfn = self._grad_fn_cache.get(sig)
+        if gfn is None:
+            gfn = jax.jit(jax.grad(lossfn))
+            self._grad_fn_cache[sig] = gfn
+        grads = gfn(diff, rest, phs)
         return {k: np.asarray(v) for k, v in grads.items()}
 
     # grad name convention parity: "x" -> grad variable named "x-grad"
@@ -807,11 +845,13 @@ class SameDiff:
         }
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        if self._opt_state is None:
+            # kept separate from _train_step: load() restores _opt_state with
+            # _train_step still None — re-initing here would zero Adam moments
             self._opt_state = cfg.updater.init_state(trainables)
 
         feat_names = list(cfg.data_set_feature_mapping)
         lab_names = list(cfg.data_set_label_mapping)
-        it_count = 0
         history = []
         for _ in range(epochs):
             losses = []
@@ -822,15 +862,16 @@ class SameDiff:
                 feeds = {n: jnp.asarray(a) for n, a in zip(feat_names, feats)}
                 feeds.update({n: jnp.asarray(a) for n, a in zip(lab_names, labs)})
                 trainables, self._opt_state, loss = self._train_step(
-                    trainables, self._opt_state, feeds, it_count)
-                it_count += 1
+                    trainables, self._opt_state, feeds, self._it_count)
+                self._it_count += 1
                 losses.append(loss)
                 for lst in self._listeners:
                     if hasattr(lst, "iteration_done"):
-                        lst.iteration_done(self, it_count, float(loss))
+                        lst.iteration_done(self, self._it_count, float(loss))
             history.append(float(np.mean([np.asarray(l) for l in losses])))
         for n, varr in trainables.items():
             self._arrays[n] = np.asarray(varr)
+        self._device_cache = None  # stored values changed; refresh on next output()
         # NOTE: no _invalidate() here — the output jit cache takes arrays as
         # runtime args, and clearing _train_step/_opt_state would silently
         # zero Adam moments between consecutive fit() calls.
@@ -864,6 +905,7 @@ class SameDiff:
             "loss_vars": self._loss_vars,
             "training_config": self.training_config.to_dict()
             if self.training_config else None,
+            "it_count": self._it_count,
         }
         buf = io.BytesIO()
         np.savez(buf, **self._arrays)
@@ -894,6 +936,7 @@ class SameDiff:
                 for o in node.outputs:
                     sd._producer[o] = node
             sd._loss_vars = meta["loss_vars"]
+            sd._it_count = meta.get("it_count", 0)
             if meta.get("training_config"):
                 sd.training_config = TrainingConfig.from_dict(meta["training_config"])
             if "updater.npz" in zf.namelist() and sd.training_config:

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities (reference: org/deeplearning4j/util/** and
+nd4j-common — SURVEY.md §2.2 J20)."""
+
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+__all__ = ["ModelSerializer"]

@@ -170,3 +170,60 @@ def test_custom_op_not_serializable(tmp_path):
     sd.custom_op(lambda v: v + 1, a)
     with pytest.raises(ValueError, match="custom"):
         sd.save(str(tmp_path / "x.sdz"))
+
+
+def test_variadic_multi_output_ops():
+    """split/split_v/unstack/dynamic_partition arity handling (regression:
+    the arity attr must match the registered lowering's signature)."""
+    sd = SameDiff()
+    x = sd.constant(np.arange(12, dtype=np.float32).reshape(6, 2), "x")
+    parts = sd.math.split(x, num_or_sections=3, axis=0)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[0].eval(), np.arange(4).reshape(2, 2))
+
+    rows = sd.math.unstack(x, axis=1)
+    assert len(rows) == 2
+    np.testing.assert_allclose(rows[1].eval(), np.arange(12).reshape(6, 2)[:, 1])
+
+    sv = sd.math.split_v(x, sizes=(2, 4), axis=0)
+    assert len(sv) == 2 and sv[1].eval().shape == (4, 2)
+
+    idx = sd.constant(np.array([0, 1, 0, 1, 0, 1]), "idx")
+    dp = sd.math.dynamic_partition(x, idx, num_partitions=2)
+    assert len(dp) == 2
+
+
+def test_resume_preserves_updater_state_and_iteration(tmp_path):
+    """Regression: fit after load() must not clobber restored Adam moments or
+    restart the iteration counter (LR schedules / bias correction)."""
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 3)).astype(np.float32)
+    ys = (xs @ np.array([[1.0], [-2.0], [0.5]], dtype=np.float32)).astype(np.float32)
+
+    def build():
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(-1, 3))
+        y = sd.placeholder("y", shape=(-1, 1))
+        w = sd.var("w", np.zeros((3, 1), dtype=np.float32))
+        pred = x @ w
+        loss = sd.loss.meanSquaredError(pred, y)
+        sd.set_loss_variables(loss)
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        return sd
+
+    a = build()
+    a.fit((xs, ys), epochs=3)
+    path = str(tmp_path / "mid.sdz")
+    a.save(path, save_updater_state=True)
+    a.fit((xs, ys), epochs=3)  # uninterrupted
+
+    b = SameDiff.load(path)
+    assert b._it_count == 3
+    assert b._opt_state is not None
+    b.fit((xs, ys), epochs=3)  # resumed
+
+    np.testing.assert_allclose(
+        a.get_variable("w").get_arr(), b.get_variable("w").get_arr(),
+        rtol=1e-5, atol=1e-6)

@@ -1,0 +1,201 @@
+"""ModelSerializer / CheckpointListener / EarlyStopping / normalizer tests —
+parity with the reference's ModelSerializerTest, CheckpointListener tests and
+EarlyStoppingTests (deeplearning4j-core; SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    normalizer_from_dict,
+)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn import (
+    InputType,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.listeners import CheckpointListener
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.util import ModelSerializer
+
+
+def _net(seed=42):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(0.01))
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=64):
+    xs = rng.standard_normal((n, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return xs, ys
+
+
+def test_save_restore_exact_outputs(tmp_path, rng):
+    net = _net()
+    xs, ys = _data(rng)
+    net.fit(xs, ys, epochs=3)
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.write_model(net, path)
+
+    restored = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_array_equal(
+        np.asarray(net.output(xs)), np.asarray(restored.output(xs))
+    )
+    assert restored.iteration == net.iteration
+    assert restored.epoch == net.epoch
+
+
+def test_resume_training_bit_exact(tmp_path, rng):
+    """Save mid-training, resume, and compare against uninterrupted run —
+    params must match exactly (updater state + RNG key round-trip)."""
+    xs, ys = _data(rng)
+    a = _net()
+    a.fit(xs, ys, epochs=2)
+    path = str(tmp_path / "mid.zip")
+    ModelSerializer.write_model(a, path)
+    a.fit(xs, ys, epochs=2)  # uninterrupted continuation
+
+    b = ModelSerializer.restore_multi_layer_network(path)
+    b.fit(xs, ys, epochs=2)  # resumed continuation
+
+    for pa, pb in zip(a.params, b.params):
+        for k in pa:
+            np.testing.assert_allclose(
+                np.asarray(pa[k]), np.asarray(pb[k]), rtol=1e-6, atol=1e-7
+            )
+
+
+def test_restore_without_updater_state(tmp_path, rng):
+    net = _net()
+    xs, ys = _data(rng)
+    net.fit(xs, ys, epochs=1)
+    path = str(tmp_path / "no_upd.zip")
+    ModelSerializer.write_model(net, path, save_updater=False)
+    restored = ModelSerializer.restore_multi_layer_network(path, load_updater=False)
+    np.testing.assert_array_equal(
+        np.asarray(net.output(xs)), np.asarray(restored.output(xs))
+    )
+
+
+def test_wrong_type_raises(tmp_path, rng):
+    net = _net()
+    path = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, path)
+    with pytest.raises(ValueError, match="MultiLayerNetwork"):
+        ModelSerializer.restore_computation_graph(path)
+
+
+def test_normalizer_rides_in_archive(tmp_path, rng):
+    net = _net()
+    xs, ys = _data(rng)
+    norm = NormalizerStandardize().fit(DataSet(xs, ys))
+    path = str(tmp_path / "with_norm.zip")
+    ModelSerializer.write_model(net, path, normalizer=norm)
+    restored_norm = ModelSerializer.restore_normalizer_from_file(path)
+    np.testing.assert_allclose(restored_norm.mean, norm.mean)
+    np.testing.assert_allclose(restored_norm.std, norm.std)
+
+
+def test_checkpoint_listener_keep_last(tmp_path, rng):
+    import os
+
+    net = _net()
+    xs, ys = _data(rng)
+    ckpt = CheckpointListener(
+        str(tmp_path / "ckpts"), save_every_n_iterations=2, keep_last=2
+    )
+    net.set_listeners(ckpt)
+    net.fit(ArrayDataSetIterator(xs, ys, batch=8), epochs=2)
+    assert len(ckpt.saved) == 2
+    assert all(os.path.exists(p) for p in ckpt.saved)
+    # restorable
+    restored = ModelSerializer.restore_model(ckpt.last_checkpoint())
+    assert restored.output(xs).shape == (64, 3)
+
+
+# ------------------------------------------------------------- normalizers
+def test_standardize_roundtrip(rng):
+    xs, ys = _data(rng, n=256)
+    norm = NormalizerStandardize().fit(DataSet(xs, ys))
+    ds = DataSet(xs.copy(), ys)
+    norm.transform(ds)
+    assert abs(ds.features.mean()) < 0.05
+    assert abs(ds.features.std() - 1.0) < 0.05
+    norm.revert(ds)
+    np.testing.assert_allclose(ds.features, xs, rtol=1e-4, atol=1e-5)
+
+
+def test_minmax_and_image_scaler(rng):
+    xs = rng.uniform(-5, 9, (100, 6)).astype(np.float32)
+    norm = NormalizerMinMaxScaler().fit(DataSet(xs, xs))
+    out = norm.normalize(xs)
+    assert out.min() >= -1e-6 and out.max() <= 1 + 1e-6
+    np.testing.assert_allclose(norm.denormalize(out), xs, rtol=1e-4, atol=1e-4)
+
+    img = (rng.uniform(0, 255, (4, 8, 8, 3))).astype(np.float32)
+    sc = ImagePreProcessingScaler()
+    np.testing.assert_allclose(sc.normalize(img), img / 255.0, rtol=1e-6)
+
+    for n in (norm, sc, NormalizerStandardize().fit(DataSet(xs, xs))):
+        back = normalizer_from_dict(n.to_dict())
+        np.testing.assert_allclose(back.normalize(xs), n.normalize(xs), rtol=1e-5)
+
+
+# ----------------------------------------------------------- early stopping
+def test_early_stopping_max_epochs(rng):
+    xs, ys = _data(rng, n=128)
+    it = ArrayDataSetIterator(xs, ys, batch=32)
+    val = ArrayDataSetIterator(xs, ys, batch=64)
+    esc = (
+        EarlyStoppingConfiguration.builder()
+        .score_calculator(DataSetLossCalculator(val))
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+        .iteration_termination_conditions(InvalidScoreIterationTerminationCondition())
+        .build()
+    )
+    result = EarlyStoppingTrainer(esc, _net(), it).fit()
+    assert result.termination_reason == TerminationReason.EpochTerminationCondition
+    assert result.total_epochs == 3
+    assert result.best_model is not None
+    assert result.best_model_score < 2.0
+
+
+def test_early_stopping_score_improvement_patience(rng):
+    xs, ys = _data(rng, n=128)
+    it = ArrayDataSetIterator(xs, ys, batch=32)
+    val = ArrayDataSetIterator(xs, ys, batch=64)
+    esc = (
+        EarlyStoppingConfiguration.builder()
+        .score_calculator(DataSetLossCalculator(val))
+        .epoch_termination_conditions(
+            MaxEpochsTerminationCondition(50),
+            ScoreImprovementEpochTerminationCondition(2, min_improvement=10.0),
+        )
+        .build()
+    )
+    result = EarlyStoppingTrainer(esc, _net(), it).fit()
+    # 10.0 improvement per epoch is unattainable → patience trips after 3 epochs
+    assert result.total_epochs <= 4
+    assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
