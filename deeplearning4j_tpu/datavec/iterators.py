@@ -7,8 +7,9 @@ and SequenceRecordReaderDataSetIterator.java (deeplearning4j-core; SURVEY.md
 Semantics mirrored: ``label_index`` picks the label column; ``num_classes``
 one-hots classification labels; regression=True keeps raw label values;
 image records ([HWC array, label]) batch into NHWC tensors. Sequence variant:
-``align`` pads ragged sequences and emits (B,T) masks — the reference's
-AlignmentMode.ALIGN_END — feeding the network mask plumbing.
+pads ragged sequences and emits (B,T) masks with the reference's
+AlignmentMode semantics (align_start default, align_end opt-in) — feeding the
+network mask plumbing.
 """
 
 from __future__ import annotations
@@ -75,15 +76,23 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
-    """Sequence records → (B, T, F) batches with ALIGN_END padding + masks."""
+    """Sequence records → (B, T, F) batches with padding + masks.
+
+    ``alignment_mode`` (AlignmentMode parity): "align_start" (default; data at
+    t=0..L-1, padding at the end) or "align_end" (right-aligned so the final
+    time steps coincide across the batch — last-step readouts line up)."""
 
     def __init__(self, reader, batch_size: int, label_index: int = -1,
-                 num_classes: Optional[int] = None, regression: bool = False):
+                 num_classes: Optional[int] = None, regression: bool = False,
+                 alignment_mode: str = "align_start"):
         self.reader = reader
         self.batch_size = batch_size
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
+        if alignment_mode.lower() not in ("align_start", "align_end"):
+            raise ValueError(f"unknown alignment_mode {alignment_mode!r}")
+        self.alignment_mode = alignment_mode.lower()
 
     def reset(self):
         self.reader.reset()
@@ -100,16 +109,17 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             y = np.zeros((B, T, self.num_classes), dtype=np.float32)
         for b, seq in enumerate(seqs):
             L = len(seq)
+            off = (T - L) if self.alignment_mode == "align_end" else 0
             for t, rec in enumerate(seq):
                 li = self.label_index if self.label_index >= 0 else len(rec) + self.label_index
                 lab = rec[li]
                 feats = [float(v) for i, v in enumerate(rec) if i != li]
-                x[b, t] = feats
+                x[b, off + t] = feats
                 if self.regression:
-                    y[b, t, 0] = float(lab)
+                    y[b, off + t, 0] = float(lab)
                 else:
-                    y[b, t, int(float(lab))] = 1.0
-            mask[b, :L] = 1.0
+                    y[b, off + t, int(float(lab))] = 1.0
+            mask[b, off:off + L] = 1.0
         return DataSet(x, y, features_mask=mask, labels_mask=mask.copy())
 
     def __iter__(self):

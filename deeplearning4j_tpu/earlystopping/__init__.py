@@ -172,7 +172,13 @@ class LocalFileModelSaver:
     def get_best_model(self):
         from deeplearning4j_tpu.util import ModelSerializer
 
-        return ModelSerializer.restore_model(self._path("bestModel.zip"))
+        path = self._path("bestModel.zip")
+        if not os.path.exists(path):
+            # training terminated before any best model was saved (e.g. NaN
+            # termination in epoch 1) — match InMemoryModelSaver: return None
+            # so the EarlyStoppingResult still carries the termination reason
+            return None
+        return ModelSerializer.restore_model(path)
 
 
 # --------------------------------------------------------------------- config

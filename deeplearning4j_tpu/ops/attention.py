@@ -1,0 +1,373 @@
+"""Attention ops: dot-product attention, multi-head attention, flash attention.
+
+Reference parity: libnd4j declarable ops
+``ops/declarable/generic/nn/dot_product_attention.cpp`` and
+``multi_head_dot_product_attention.cpp`` (path-cite, mount empty this round),
+surfaced on the JVM as ``SDNN.dotProductAttention`` /
+``multiHeadDotProductAttention`` and consumed by the DL4J attention layers
+(org/deeplearning4j/nn/conf/layers/SelfAttentionLayer.java et al.).
+
+TPU-native design:
+- Layout is [batch, heads, seq, head_dim] — seq x head_dim are the trailing
+  two dims so the (s, d) tiles map straight onto the MXU; the reference's
+  [batch, nIn, time] NCW layout is a BLAS-era artifact.
+- The exact path is three einsums + softmax that XLA fuses; the flash path is
+  a Pallas kernel (online softmax, O(S) memory) for long sequences — the
+  reference has NO long-context story (SURVEY.md §5.7: truncated BPTT only),
+  so this is where the TPU build goes past parity.
+- Backward of the flash path is the standard flash-attention backward
+  recomputation, written as a blockwise ``lax.scan`` that XLA fuses; no
+  S x S attention matrix is ever materialized in fwd or bwd.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+_NEG_BIG = -1e30
+
+
+def online_softmax_update(q, k, v, m, l, acc, scale, q_pos=None, k_pos=None):
+    """One online-softmax block update (the flash-attention inner step).
+
+    q: [..., sq, d]; k/v: [..., bk, d]; m/l: [..., sq] f32; acc: [..., sq, d]
+    f32. If q_pos/k_pos are given, applies the causal mask k_pos <= q_pos.
+    Shared by the blockwise-scan forward and the ring-attention body so the
+    numerically subtle m/l/acc correction exists exactly once.
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    causal = q_pos is not None
+    if causal:
+        s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, _NEG_BIG)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        # fully-masked rows: keep the spurious exp(0) mass out of l/acc
+        p = jnp.where(s <= _NEG_BIG / 2, 0.0, p)
+    l_new = corr * l + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+# ---------------------------------------------------------------------------
+# Exact reference implementation
+# ---------------------------------------------------------------------------
+
+
+@op("dot_product_attention", "attention", aliases=("dotProductAttention",))
+def dot_product_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    with_weights: bool = False,
+):
+    """Scaled dot-product attention, exact (materializes the S×S matrix).
+
+    q: [..., Sq, D], k: [..., Sk, D], v: [..., Sk, Dv].
+    mask: broadcastable to [..., Sq, Sk]; 1/True = attend, 0/False = blocked
+    (ND4J mask semantics). ``scale=None`` → 1/sqrt(D) ("scaled" attention,
+    the reference op's ``scaled=1`` arg).
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.promote_types(q.dtype, jnp.float32))
+    s = s * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+        k_pos = jnp.arange(sk)[None, :]
+        s = jnp.where(k_pos <= q_pos, s, _NEG_BIG)
+    if mask is not None:
+        s = jnp.where(jnp.asarray(mask, dtype=bool), s, _NEG_BIG)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("...qk,...kv->...qv", w.astype(v.dtype), v)
+    if with_weights:
+        return out, w
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flash attention — Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                      scale, causal, block_q, block_k, nk, kv_offset):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, _NEG_BIG)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    k = k_ref[0].astype(jnp.float32)  # (bk, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, bk)
+
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + kv_offset
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_BIG)
+
+    m_prev = m_s[:, 0]  # (bq,)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])  # (bq, bk)
+    if causal:
+        # fully-masked rows: keep p's spurious exp(0) mass out of l/acc
+        p = jnp.where((s <= _NEG_BIG / 2), 0.0, p)
+    l_new = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
+    acc[...] = acc[...] * corr[:, None] + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_s[...] = jnp.broadcast_to(m_new[:, None], m_s.shape)
+    l_s[...] = jnp.broadcast_to(l_new[:, None], l_s.shape)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_s[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[...] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse is (bq, 1): Mosaic requires the block's sublane dim divisible by
+        # 8, which a rank-2 (1, bq) block can't satisfy — so lse is rank-3.
+        lse_ref[0] = (m_s[:, 0] + jnp.log(safe_l))[:, None]
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    nq, nk = sq // bq, sk // bk
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        nk=nk, kv_offset=sk - sq,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)  # lse (BH,Sq,1) → (B,H,Sq)
+
+
+def _flash_fwd_jnp(q, k, v, scale, causal, block_k):
+    """Blockwise online-softmax forward in pure JAX (lax.scan over KV blocks).
+
+    Same math as the Pallas kernel; used off-TPU and anywhere Pallas can't run.
+    Returns (out, lse)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = min(block_k, sk)
+    nk = sk // bk
+    kb = jnp.moveaxis(k.reshape(b, h, nk, bk, d), 2, 0)  # (nk, b,h,bk,d)
+    vb = jnp.moveaxis(v.reshape(b, h, nk, bk, d), 2, 0)
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(sq) + (sk - sq)
+
+    def body(carry, inp):
+        m, l, acc, j = carry
+        kj, vj = inp
+        kp = j * bk + jnp.arange(bk) if causal else None
+        m, l, acc = online_softmax_update(
+            qf, kj, vj, m, l, acc, scale,
+            q_pos=q_pos if causal else None, k_pos=kp)
+        return (m, l, acc, j + 1), None
+
+    m0 = jnp.full((b, h, sq), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    a0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, acc, _), _ = lax.scan(body, (m0, l0, a0, jnp.int32(0)), (kb, vb))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l[..., None]).astype(q.dtype)
+    return out, m + jnp.log(safe_l)
+
+
+def _flash_bwd(scale, causal, block_k, res, do):
+    """Flash-attention backward: blockwise recomputation over KV blocks."""
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = min(block_k, sk)
+    nk = sk // bk
+    qf, of, dof = (t.astype(jnp.float32) for t in (q, o, do))
+    delta = jnp.sum(dof * of, axis=-1)  # (b,h,sq)
+    kb = jnp.moveaxis(k.reshape(b, h, nk, bk, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, nk, bk, d), 2, 0)
+    q_pos = jnp.arange(sq) + (sk - sq)
+
+    def body(carry, inp):
+        dq, j = carry
+        kj, vj = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = j * bk + jnp.arange(bk)
+            s = jnp.where(k_pos[None, None, None, :] <= q_pos[None, None, :, None], s, _NEG_BIG)
+        p = jnp.exp(s - lse[..., None])
+        if causal:
+            # fully-masked rows have s == lse == -1e30 → exp(0) = 1; their
+            # forward output is zeroed, so their gradient mass must be too
+            p = jnp.where(s <= _NEG_BIG / 2, 0.0, p)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj.astype(jnp.float32))
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return (dq, j + 1), (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (dq, _), (dkb, dvb) = lax.scan(body, (dq0, jnp.int32(0)), (kb, vb))
+    dk = jnp.moveaxis(dkb, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dvb, 0, 2).reshape(b, h, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, use_pallas):
+    o, _ = _flash_fwd_dispatch(q, k, v, scale, causal, block_q, block_k, use_pallas)
+    return o
+
+
+def _flash_fwd_dispatch(q, k, v, scale, causal, block_q, block_k, use_pallas):
+    if use_pallas == "interpret":
+        return _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, True)
+    if use_pallas and jax.default_backend() == "tpu":
+        return _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, False)
+    return _flash_fwd_jnp(q, k, v, scale, causal, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, use_pallas):
+    o, lse = _flash_fwd_dispatch(q, k, v, scale, causal, block_q, block_k, use_pallas)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, use_pallas, res, do):
+    return _flash_bwd(scale, causal, block_k, res, do)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@op("flash_attention", "attention")
+def flash_attention(
+    q,
+    k,
+    v,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
+    use_pallas=True,
+):
+    """Memory-efficient attention: [B,H,S,D] → [B,H,S,D], O(S) memory.
+
+    Pallas kernel on TPU (``use_pallas="interpret"`` forces the interpreter for
+    CPU tests), blockwise lax.scan elsewhere. Sequence lengths must divide the
+    effective block sizes; callers fall back to ``dot_product_attention``
+    otherwise (the nn layers do this automatically).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if sq % bq or sk % bk:
+        return dot_product_attention(q, k, v, scale=scale, causal=causal)
+    return _flash(q, k, v, float(scale), bool(causal), bq, bk, use_pallas)
+
+
+# ---------------------------------------------------------------------------
+# Multi-head attention (ND4J multiHeadDotProductAttention parity)
+# ---------------------------------------------------------------------------
+
+
+def _split_heads(x, n_heads):
+    b, t, f = x.shape
+    return jnp.transpose(x.reshape(b, t, n_heads, f // n_heads), (0, 2, 1, 3))
+
+
+def _merge_heads(x):
+    b, h, t, dh = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, h * dh)
+
+
+@op("multi_head_dot_product_attention", "attention",
+    aliases=("multiHeadDotProductAttention", "mha"))
+def multi_head_dot_product_attention(
+    queries,
+    keys,
+    values,
+    Wq,
+    Wk,
+    Wv,
+    Wo,
+    n_heads: int,
+    mask=None,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    flash: bool = False,
+):
+    """Projected multi-head attention over [B, T, F] sequences.
+
+    Wq/Wk/Wv: (F, H*Dh); Wo: (H*Dh, Fout). ``mask`` is a [B, Tk] padding mask
+    (ND4J semantics: 1 = valid) or a full [B, 1|H, Tq, Tk] attention mask.
+    """
+    q = _split_heads(queries @ Wq, n_heads)
+    k = _split_heads(keys @ Wk, n_heads)
+    v = _split_heads(values @ Wv, n_heads)
+    if flash and mask is None:
+        o = flash_attention(q, k, v, scale=scale, causal=causal)
+    else:
+        amask = None
+        if mask is not None:
+            mask = jnp.asarray(mask)
+            amask = mask[:, None, None, :] if mask.ndim == 2 else mask
+        o = dot_product_attention(q, k, v, mask=amask, scale=scale, causal=causal)
+    return _merge_heads(o) @ Wo

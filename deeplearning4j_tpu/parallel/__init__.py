@@ -7,4 +7,5 @@ one SPMD program: XLA emits the collectives over ICI/DCN.
 """
 
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh  # noqa: F401
+from deeplearning4j_tpu.parallel.ring import ring_attention, shard_sequence  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper  # noqa: F401

@@ -1,0 +1,92 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+The reference has NO long-context story — SURVEY.md §5.7: no ring attention,
+no sequence/context parallelism anywhere; long sequences are handled by
+truncated BPTT only. This module is the TPU-native extension the brief makes
+first-class: shard the sequence axis across a mesh axis and rotate K/V blocks
+around the ring with ``ppermute`` while each device accumulates its queries'
+online-softmax state (Liu et al., Ring Attention with Blockwise Transformers —
+PAPERS.md). Collectives ride ICI; each hop overlaps with the local block's
+compute under XLA's async collective scheduling.
+
+Layout: [batch, heads, seq, head_dim], sharded P(None, None, axis, None).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.ops.attention import _NEG_BIG, online_softmax_update
+
+
+def _ring_body(q, k, v, src_block, n_local, scale, causal, axis_name, m, l, acc):
+    """One online-softmax update of the local queries against one K/V block."""
+    q_pos = k_pos = None
+    if causal:
+        my = lax.axis_index(axis_name)
+        q_pos = my * n_local + jnp.arange(n_local)
+        k_pos = src_block * n_local + jnp.arange(n_local)
+    return online_softmax_update(q, k, v, m, l, acc, scale, q_pos=q_pos, k_pos=k_pos)
+
+
+def _ring_attention_local(q, k, v, *, axis_name, axis_size, scale, causal):
+    """Per-device body under shard_map: local q stays put, k/v ring-rotate."""
+    b, h, sl, d = q.shape
+    m = jnp.full((b, h, sl), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((b, h, sl), jnp.float32)
+    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    my = lax.axis_index(axis_name)
+    for i in range(axis_size):
+        # after i hops this device holds the block that started at (my - i)
+        src = (my - i) % axis_size
+        m, l, acc = _ring_body(q, k, v, src, sl, scale, causal, axis_name, m, l, acc)
+        if i + 1 < axis_size:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    scale: Optional[float] = None,
+    causal: bool = False,
+):
+    """Sequence-parallel attention: [B,H,S,D] with S sharded over ``axis_name``.
+
+    Exact (up to fp) equivalence with ``dot_product_attention``; memory and
+    compute per device are O(S/n · S) with the S×S matrix never materialized
+    on any one device. Differentiable (JAX AD through ppermute reverses the
+    ring). Sequence length must divide the axis size.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    axis_size = mesh.shape[axis_name]
+    spec = P(None, None, axis_name, None)
+    fn = partial(
+        _ring_attention_local,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        scale=float(scale),
+        causal=bool(causal),
+    )
+    shmap = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return shmap(q, k, v)
+
+
+def shard_sequence(x, mesh: Mesh, axis_name: str = "seq", dim: int = 2):
+    """Place an array with its ``dim`` axis sharded over ``axis_name``."""
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

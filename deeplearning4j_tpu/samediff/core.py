@@ -422,6 +422,29 @@ class TrainingConfig:
 
 
 # "getitem" lowering registered once, here (serializable index spec).
+def _merge_opt_state(fresh, old):
+    """Conform a saved/stale optimizer state to a freshly-initialized one:
+    the fresh tree drives the structure (current trainables), old values are
+    kept wherever path and shape still match — new variables start at zero,
+    removed ones are dropped."""
+    if isinstance(fresh, dict):
+        if not isinstance(old, dict):
+            return fresh
+        return {
+            k: _merge_opt_state(v, old[k]) if k in old else v
+            for k, v in fresh.items()
+        }
+    if isinstance(fresh, (tuple, list)):
+        if not isinstance(old, (tuple, list)) or len(old) != len(fresh):
+            return fresh
+        return type(fresh)(_merge_opt_state(f, o) for f, o in zip(fresh, old))
+    if getattr(old, "shape", None) == getattr(fresh, "shape", None) and (
+        getattr(old, "dtype", None) == getattr(fresh, "dtype", None)
+    ):
+        return old
+    return fresh
+
+
 def _getitem(x, spec=()):
     idx = []
     for it in spec:
@@ -849,6 +872,13 @@ class SameDiff:
             # kept separate from _train_step: load() restores _opt_state with
             # _train_step still None — re-initing here would zero Adam moments
             self._opt_state = cfg.updater.init_state(trainables)
+        else:
+            # the graph may have gained/lost trainables since the state was
+            # made (or loaded): rebuild the state's structure around the
+            # current trainables, keeping existing moments where they match
+            self._opt_state = _merge_opt_state(
+                cfg.updater.init_state(trainables), self._opt_state
+            )
 
         feat_names = list(cfg.data_set_feature_mapping)
         lab_names = list(cfg.data_set_label_mapping)

@@ -186,3 +186,18 @@ def test_transform_process_record_reader():
     )
     out = list(TransformProcessRecordReader(rr, tp))
     assert out == [[11.0, "4"], [12.0, "5"]]
+
+
+def test_sequence_iterator_align_end(tmp_path):
+    for i, L in enumerate((3, 5)):
+        rows = "\n".join(f"{t}.0,{t % 2}" for t in range(L))
+        (tmp_path / f"seq_{i}.csv").write_text(rows + "\n")
+    rr = CSVSequenceRecordReader(str(tmp_path))
+    it = SequenceRecordReaderDataSetIterator(
+        rr, batch_size=2, label_index=-1, num_classes=2, alignment_mode="align_end")
+    ds = next(iter(it))
+    # short sequence right-aligned: padding at the start, data at t=2..4
+    np.testing.assert_array_equal(ds.features_mask[0], [0, 0, 1, 1, 1])
+    np.testing.assert_array_equal(ds.features_mask[1], [1, 1, 1, 1, 1])
+    np.testing.assert_array_equal(ds.features[0, :2, 0], [0.0, 0.0])
+    np.testing.assert_array_equal(ds.features[0, 2:, 0], [0.0, 1.0, 2.0])

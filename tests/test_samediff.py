@@ -227,3 +227,30 @@ def test_resume_preserves_updater_state_and_iteration(tmp_path):
     np.testing.assert_allclose(
         a.get_variable("w").get_arr(), b.get_variable("w").get_arr(),
         rtol=1e-5, atol=1e-6)
+
+
+def test_fit_after_adding_trainable_keeps_moments():
+    # regression: _opt_state must conform to the current trainables when the
+    # graph gains a variable between fit() calls (stale-state crash)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(64, 3)).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [2.0], [-1.0]], np.float32))
+
+    sd = SameDiff()
+    x = sd.placeholder("x", shape=(-1, 3))
+    y = sd.placeholder("y", shape=(-1, 1))
+    w = sd.var("w", np.zeros((3, 1), np.float32))
+    pred = x @ w
+    loss = sd.loss.meanSquaredError(pred, y)
+    sd.set_loss_variables(loss)
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(learning_rate=0.05),
+        data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+    sd.fit((xv, yv), epochs=3)
+
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred2 = pred + b
+    loss2 = sd.loss.meanSquaredError(pred2, y)
+    sd.set_loss_variables(loss2)
+    hist = sd.fit((xv, yv), epochs=40)  # must not raise
+    assert hist[-1] < hist[0] and hist[-1] < 0.2, hist[-5:]
