@@ -1,0 +1,11 @@
+"""Model import: TF GraphDef and ONNX → SameDiff graphs; Keras HDF5 → networks.
+
+Reference parity: nd4j samediff-import (samediff-import-api/-tensorflow/-onnx,
+TensorflowFrameworkImporter.kt / OnnxFrameworkImporter.kt; legacy
+org/nd4j/imports/graphmapper/tf/TFGraphMapper.java) and
+deeplearning4j-modelimport (KerasModelImport.java) — SURVEY.md §2.2 J4/J13.
+"""
+
+from deeplearning4j_tpu.imports.tf_import import TFGraphMapper, import_graph_def  # noqa: F401
+from deeplearning4j_tpu.imports.onnx_import import OnnxImporter, import_onnx  # noqa: F401
+from deeplearning4j_tpu.imports.keras_import import KerasModelImport  # noqa: F401

@@ -1,0 +1,545 @@
+"""TF GraphDef → SameDiff importer.
+
+Reference parity: org/nd4j/imports/graphmapper/tf/TFGraphMapper.java and the
+Kotlin samediff-import-tensorflow module (TensorflowFrameworkImporter.kt with
+per-op import rules; SURVEY.md §2.2 J4, §3.3: "TF import entry ...
+[This is the BERT-config path in BASELINE.json]") — path-cite, mount empty
+this round.
+
+Design: one import rule per TF op type, mapping onto the op-registry waist —
+imported graphs execute through the same whole-graph-jit path as natively
+built SameDiff graphs (trace → XLA → one device launch), not per-op like the
+reference's InferenceSession. Shape-argument inputs (Reshape targets,
+reduction axes, ConcatV2 axis…) must be Const nodes: they become static attrs
+at import time, keeping the program jit-traceable with static shapes
+(TPU/XLA requirement).
+
+Parsing uses the installed tensorflow package only to decode protos/tensors
+(``tf.compat.v1.GraphDef`` / ``tf.make_ndarray``); no TF graph is ever
+executed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.samediff.core import SameDiff, SDVariable
+
+_RULES: Dict[str, Callable] = {}
+
+
+def rule(*tf_ops):
+    def deco(fn):
+        for t in tf_ops:
+            _RULES[t] = fn
+        return fn
+    return deco
+
+
+class UnsupportedOpError(NotImplementedError):
+    pass
+
+
+class TFGraphMapper:
+    """importGraph(GraphDef) parity. Use :func:`import_graph_def`."""
+
+    def __init__(self, graph_def):
+        self.gd = graph_def
+        self.sd = SameDiff()
+        self.vars: Dict[str, SDVariable] = {}      # "node:slot" -> var
+        self.const_vals: Dict[str, np.ndarray] = {}  # import-time constants
+        self.nodes = {n.name: n for n in graph_def.node}
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def _canon(name: str) -> str:
+        name = name.lstrip("^")
+        return name if ":" in name else name + ":0"
+
+    def get(self, name: str) -> SDVariable:
+        return self.vars[self._canon(name)]
+
+    def const(self, name: str) -> np.ndarray:
+        """Import-time value of a const input (shape args etc.)."""
+        key = self._canon(name)
+        if key not in self.const_vals:
+            raise UnsupportedOpError(
+                f"input {name!r} must be a constant (shape/axis arguments are "
+                "static under XLA); dynamic shape tensors are not importable")
+        return self.const_vals[key]
+
+    def set(self, node_name: str, var, slot: int = 0, const_val=None):
+        self.vars[f"{node_name}:{slot}"] = var
+        if const_val is not None:
+            self.const_vals[f"{node_name}:{slot}"] = np.asarray(const_val)
+
+    def inputs(self, node) -> List[str]:
+        return [i for i in node.input if not i.startswith("^")]
+
+    # --------------------------------------------------------------- import
+    def build(self, feed_placeholders: bool = True) -> SameDiff:
+        for node in self.gd.node:
+            fn = _RULES.get(node.op)
+            if fn is None:
+                raise UnsupportedOpError(
+                    f"no import rule for TF op {node.op!r} (node {node.name!r}); "
+                    f"{len(_RULES)} op types supported")
+            fn(self, node)
+        # TF node name → samediff var name (they differ when a rule emits a
+        # lowering postamble, e.g. the NCHW→NHWC boundary transposes)
+        self.sd.tf_name_map = {
+            k: v.name for k, v in self.vars.items()
+        }
+        return self.sd
+
+
+def import_graph_def(graph_def, *, name: Optional[str] = None) -> SameDiff:
+    """GraphDef proto | serialized bytes | path to .pb → SameDiff."""
+    if isinstance(graph_def, (str, bytes)):
+        import tensorflow as tf
+
+        gd = tf.compat.v1.GraphDef()
+        if isinstance(graph_def, str):
+            with open(graph_def, "rb") as f:
+                gd.ParseFromString(f.read())
+        else:
+            gd.ParseFromString(graph_def)
+        graph_def = gd
+    return TFGraphMapper(graph_def).build()
+
+
+# ---------------------------------------------------------------------------
+# Attr helpers
+# ---------------------------------------------------------------------------
+
+
+def _tf_dtype(attr_dt) -> np.dtype:
+    import tensorflow as tf
+
+    return np.dtype(tf.dtypes.as_dtype(attr_dt).as_numpy_dtype)
+
+
+def _nhwc(node) -> bool:
+    df = node.attr["data_format"].s.decode() if "data_format" in node.attr else "NHWC"
+    if df not in ("NHWC", "NCHW"):
+        raise UnsupportedOpError(f"data_format {df}")
+    return df == "NHWC"
+
+
+def _to_nhwc(m, node, x):
+    """TPU path is NHWC; transpose NCHW graphs at the boundary."""
+    if _nhwc(node):
+        return x, lambda y: y
+    t_in = m.sd.math.permute(x, axes=(0, 2, 3, 1))
+    return t_in, lambda y: m.sd.math.permute(y, axes=(0, 3, 1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+
+
+@rule("Placeholder", "PlaceholderWithDefault")
+def _placeholder(m, node):
+    import tensorflow as tf
+
+    dt = _tf_dtype(node.attr["dtype"].type)
+    shape = None
+    if "shape" in node.attr and not node.attr["shape"].shape.unknown_rank:
+        shape = tuple(
+            d.size if d.size >= 0 else -1 for d in node.attr["shape"].shape.dim
+        )
+    if node.op == "PlaceholderWithDefault":
+        default = m.const(m.inputs(node)[0])
+        m.set(node.name, m.sd.constant(default, name=node.name), const_val=default)
+        return
+    m.set(node.name, m.sd.placeholder(node.name, shape=shape, dtype=dt))
+
+
+@rule("Const")
+def _const(m, node):
+    import tensorflow as tf
+
+    val = tf.make_ndarray(node.attr["value"].tensor)
+    m.set(node.name, m.sd.constant(np.asarray(val), name=node.name), const_val=val)
+
+
+@rule("Identity", "StopGradient", "PreventGradient", "CheckNumerics")
+def _identity(m, node):
+    # a real graph node (not an alias): frozen-graph outputs are Identity
+    # nodes and callers address them by TF node name
+    src = m._canon(m.inputs(node)[0])
+    m.set(node.name, m.sd._op("identity", [m.vars[src]], name=node.name),
+          const_val=m.const_vals.get(src))
+
+
+@rule("NoOp", "Assert")
+def _noop(m, node):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Math
+# ---------------------------------------------------------------------------
+
+_BINOP = {
+    "Add": "add", "AddV2": "add", "Sub": "subtract", "Mul": "multiply",
+    "RealDiv": "divide", "Div": "divide", "Maximum": "maximum",
+    "Minimum": "minimum", "Pow": "pow", "SquaredDifference": "squareddifference",
+    "FloorDiv": "floordiv", "Mod": "mod", "Atan2": "atan2",
+    "Greater": "greater", "GreaterEqual": "greaterequal", "Less": "less",
+    "LessEqual": "lessequal", "Equal": "equals", "NotEqual": "notequals",
+    "LogicalAnd": "and", "LogicalOr": "or",
+}
+_UNOP = {
+    "Relu": "relu", "Relu6": "relu6", "Elu": "elu", "Selu": "selu",
+    "Softplus": "softplus", "Softsign": "softsign", "Tanh": "tanh",
+    "Sigmoid": "sigmoid", "Exp": "exp", "Log": "log", "Log1p": "log1p",
+    "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Square": "square", "Abs": "abs",
+    "Neg": "neg", "Sign": "sign", "Floor": "floor", "Ceil": "ceil",
+    "Round": "round", "Erf": "erf", "Erfc": "erfc", "Sin": "sin", "Cos": "cos",
+    "Tan": "tan", "Asin": "asin", "Acos": "acos", "Atan": "atan",
+    "Sinh": "sinh", "Cosh": "cosh", "Asinh": "asinh", "Acosh": "acosh",
+    "Atanh": "atanh", "Reciprocal": "reciprocal", "LogicalNot": "not",
+    "Expm1": "expm1", "IsNan": "isnan", "IsInf": "isinf", "IsFinite": "isfinite",
+}
+
+
+def _register_simple_rules():
+    def bin_rule(opname):
+        def fn(m, node):
+            a, b = (m.get(i) for i in m.inputs(node))
+            m.set(node.name, m.sd._op(opname, [a, b], name=node.name))
+        return fn
+
+    def un_rule(opname):
+        def fn(m, node):
+            m.set(node.name, m.sd._op(opname, [m.get(m.inputs(node)[0])],
+                                      name=node.name))
+        return fn
+
+    for tf_op, opname in _BINOP.items():
+        _RULES[tf_op] = bin_rule(opname)
+    for tf_op, opname in _UNOP.items():
+        _RULES[tf_op] = un_rule(opname)
+
+
+_register_simple_rules()
+
+
+@rule("MatMul")
+def _matmul(m, node):
+    a, b = (m.get(i) for i in m.inputs(node))
+    m.set(node.name, m.sd._op("matmul", [a, b], attrs=dict(
+        transpose_a=node.attr["transpose_a"].b,
+        transpose_b=node.attr["transpose_b"].b), name=node.name))
+
+
+@rule("BatchMatMul", "BatchMatMulV2")
+def _batch_matmul(m, node):
+    a, b = (m.get(i) for i in m.inputs(node))
+    m.set(node.name, m.sd._op("matmul", [a, b], attrs=dict(
+        transpose_a=node.attr["adj_x"].b, transpose_b=node.attr["adj_y"].b),
+        name=node.name))
+
+
+@rule("BiasAdd")
+def _bias_add(m, node):
+    x, b = (m.get(i) for i in m.inputs(node))
+    if not _nhwc(node):
+        raise UnsupportedOpError("BiasAdd NCHW")
+    m.set(node.name, m.sd._op("add", [x, b], name=node.name))
+
+
+@rule("AddN")
+def _add_n(m, node):
+    vs = [m.get(i) for i in m.inputs(node)]
+    acc = vs[0]
+    for v in vs[1:]:
+        acc = m.sd._op("add", [acc, v])
+    m.set(node.name, acc)
+
+
+@rule("Softmax")
+def _softmax(m, node):
+    m.set(node.name, m.sd._op("softmax", [m.get(m.inputs(node)[0])],
+                              attrs=dict(axis=-1), name=node.name))
+
+
+@rule("LogSoftmax")
+def _log_softmax(m, node):
+    m.set(node.name, m.sd._op("log_softmax", [m.get(m.inputs(node)[0])],
+                              attrs=dict(axis=-1), name=node.name))
+
+
+_REDUCE = {"Mean": "mean", "Sum": "sum", "Max": "max", "Min": "min",
+           "Prod": "prod", "All": "all", "Any": "any"}
+
+
+def _register_reduce_rules():
+    def red_rule(opname):
+        def fn(m, node):
+            x = m.get(m.inputs(node)[0])
+            axes = m.const(m.inputs(node)[1])
+            axis = tuple(int(a) for a in np.atleast_1d(axes))
+            m.set(node.name, m.sd._op(opname, [x], attrs=dict(
+                axis=axis if len(axis) > 1 else axis[0],
+                keepdims=bool(node.attr["keep_dims"].b)), name=node.name))
+        return fn
+
+    for tf_op, opname in _REDUCE.items():
+        _RULES[tf_op] = red_rule(opname)
+
+
+_register_reduce_rules()
+
+
+@rule("ArgMax")
+def _argmax(m, node):
+    x = m.get(m.inputs(node)[0])
+    axis = int(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("argmax", [x], attrs=dict(axis=axis), name=node.name))
+
+
+@rule("Cast")
+def _cast(m, node):
+    dt = _tf_dtype(node.attr["DstT"].type)
+    m.set(node.name, m.sd._op("cast", [m.get(m.inputs(node)[0])],
+                              attrs=dict(dtype=dt), name=node.name))
+
+
+@rule("Select", "SelectV2")
+def _select(m, node):
+    c, a, b = (m.get(i) for i in m.inputs(node))
+    m.set(node.name, m.sd._op("where", [c, a, b], name=node.name))
+
+
+# ---------------------------------------------------------------------------
+# Shape ops — shape arguments must be import-time constants
+# ---------------------------------------------------------------------------
+
+
+@rule("Reshape")
+def _reshape(m, node):
+    x = m.get(m.inputs(node)[0])
+    shape = tuple(int(s) for s in m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("reshape", [x], attrs=dict(shape=shape),
+                              name=node.name))
+
+
+@rule("Transpose")
+def _transpose(m, node):
+    x = m.get(m.inputs(node)[0])
+    perm = tuple(int(p) for p in m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("permute", [x], attrs=dict(axes=perm),
+                              name=node.name))
+
+
+@rule("ExpandDims")
+def _expand_dims(m, node):
+    x = m.get(m.inputs(node)[0])
+    axis = int(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("expand_dims", [x], attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("Squeeze")
+def _squeeze(m, node):
+    x = m.get(m.inputs(node)[0])
+    dims = tuple(node.attr["squeeze_dims"].list.i)
+    attrs = dict(axis=dims) if dims else {}
+    m.set(node.name, m.sd._op("squeeze", [x], attrs=attrs, name=node.name))
+
+
+@rule("ConcatV2")
+def _concat(m, node):
+    ins = m.inputs(node)
+    vs = [m.get(i) for i in ins[:-1]]
+    axis = int(m.const(ins[-1]))
+    m.set(node.name, m.sd._op("concat_n", vs, attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("Pack")
+def _pack(m, node):
+    vs = [m.get(i) for i in m.inputs(node)]
+    axis = int(node.attr["axis"].i)
+    m.set(node.name, m.sd._op("stack_n", vs, attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("Unpack")
+def _unpack(m, node):
+    x = m.get(m.inputs(node)[0])
+    num = int(node.attr["num"].i)
+    axis = int(node.attr["axis"].i)
+    outs = m.sd.math.unstack(x, axis=axis, num=num)
+    for i, v in enumerate(outs):
+        m.set(node.name, v, slot=i)
+
+
+@rule("Split")
+def _split(m, node):
+    axis = int(m.const(m.inputs(node)[0]))
+    x = m.get(m.inputs(node)[1])
+    n = int(node.attr["num_split"].i)
+    outs = m.sd.math.split(x, num_or_sections=n, axis=axis)
+    for i, v in enumerate(outs):
+        m.set(node.name, v, slot=i)
+
+
+@rule("GatherV2", "Gather")
+def _gather(m, node):
+    ins = m.inputs(node)
+    x, idx = m.get(ins[0]), m.get(ins[1])
+    axis = int(m.const(ins[2])) if len(ins) > 2 else 0
+    m.set(node.name, m.sd._op("gather", [x, idx], attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("Slice")
+def _slice(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    begin = [int(v) for v in m.const(ins[1])]
+    size = [int(v) for v in m.const(ins[2])]
+    m.set(node.name, m.sd._op("slice", [x], attrs=dict(begin=begin, sizes=size),
+                              name=node.name))
+
+
+@rule("StridedSlice")
+def _strided_slice(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    begin = [int(v) for v in m.const(ins[1])]
+    end = [int(v) for v in m.const(ins[2])]
+    strides = [int(v) for v in m.const(ins[3])]
+    masks = {k: int(node.attr[k].i) for k in
+             ("begin_mask", "end_mask", "ellipsis_mask", "new_axis_mask",
+              "shrink_axis_mask")}
+    if masks["ellipsis_mask"] or masks["new_axis_mask"]:
+        raise UnsupportedOpError("StridedSlice ellipsis/new_axis masks")
+    spec = []
+    for d in range(len(begin)):
+        b = None if masks["begin_mask"] & (1 << d) else begin[d]
+        e = None if masks["end_mask"] & (1 << d) else end[d]
+        if masks["shrink_axis_mask"] & (1 << d):
+            spec.append(("i", begin[d]))
+        else:
+            spec.append(("s", b, e, strides[d]))
+    m.set(node.name, m.sd._op("getitem", [x], attrs=dict(spec=tuple(spec)),
+                              name=node.name))
+
+
+@rule("Pad", "PadV2")
+def _pad(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    pads = [(int(a), int(b)) for a, b in np.asarray(m.const(ins[1]))]
+    cv = float(np.asarray(m.const(ins[2]))) if len(ins) > 2 else 0.0
+    m.set(node.name, m.sd._op("pad", [x], attrs=dict(
+        paddings=tuple(pads), constant_value=cv), name=node.name))
+
+
+@rule("Tile")
+def _tile(m, node):
+    x = m.get(m.inputs(node)[0])
+    reps = tuple(int(v) for v in m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("tile", [x], attrs=dict(reps=reps), name=node.name))
+
+
+@rule("Fill")
+def _fill(m, node):
+    shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    val = m.const(m.inputs(node)[1])
+    arr = np.full(shape, val)
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+
+
+@rule("OneHot")
+def _one_hot(m, node):
+    ins = m.inputs(node)
+    idx = m.get(ins[0])
+    depth = int(m.const(ins[1]))
+    on = float(np.asarray(m.const(ins[2])))
+    off = float(np.asarray(m.const(ins[3])))
+    axis = int(node.attr["axis"].i) if "axis" in node.attr else -1
+    m.set(node.name, m.sd._op("onehot", [idx], attrs=dict(
+        depth=depth, on_value=on, off_value=off, axis=axis), name=node.name))
+
+
+# ---------------------------------------------------------------------------
+# NN ops
+# ---------------------------------------------------------------------------
+
+
+def _strides_2d(node, nhwc):
+    s = list(node.attr["strides"].list.i)
+    return (s[1], s[2]) if nhwc else (s[2], s[3])
+
+
+@rule("Conv2D")
+def _conv2d(m, node):
+    x, w = (m.get(i) for i in m.inputs(node))
+    nhwc = _nhwc(node)
+    x, back = _to_nhwc(m, node, x)
+    dil = list(node.attr["dilations"].list.i) or [1, 1, 1, 1]
+    y = m.sd._op("conv2d", [x, w], attrs=dict(
+        strides=_strides_2d(node, nhwc),
+        padding=node.attr["padding"].s.decode(),
+        dilation=(dil[1], dil[2]) if nhwc else (dil[2], dil[3])),
+        name=node.name)
+    m.set(node.name, back(y))
+
+
+@rule("DepthwiseConv2dNative")
+def _depthwise(m, node):
+    x, w = (m.get(i) for i in m.inputs(node))
+    nhwc = _nhwc(node)
+    x, back = _to_nhwc(m, node, x)
+    y = m.sd._op("depthwise_conv2d", [x, w], attrs=dict(
+        strides=_strides_2d(node, nhwc),
+        padding=node.attr["padding"].s.decode()), name=node.name)
+    m.set(node.name, back(y))
+
+
+@rule("MaxPool", "AvgPool")
+def _pool(m, node):
+    x = m.get(m.inputs(node)[0])
+    nhwc = _nhwc(node)
+    x, back = _to_nhwc(m, node, x)
+    k = list(node.attr["ksize"].list.i)
+    y = m.sd._op("maxpool2d" if node.op == "MaxPool" else "avgpool2d", [x],
+                 attrs=dict(kernel=(k[1], k[2]) if nhwc else (k[2], k[3]),
+                            strides=_strides_2d(node, nhwc),
+                            padding=node.attr["padding"].s.decode()),
+                 name=node.name)
+    m.set(node.name, back(y))
+
+
+@rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(m, node):
+    if node.attr["is_training"].b:
+        raise UnsupportedOpError("FusedBatchNorm training mode (import frozen "
+                                 "inference graphs)")
+    ins = m.inputs(node)
+    x, gamma, beta, mean, var = (m.get(i) for i in ins[:5])
+    x, back = _to_nhwc(m, node, x)
+    eps = float(node.attr["epsilon"].f)
+    y = m.sd._op("batchnorm", [x, mean, var, gamma, beta],
+                 attrs=dict(eps=eps), name=node.name)
+    m.set(node.name, back(y))
+
+
+@rule("Shape")
+def _shape(m, node):
+    # static under XLA: materialize as a constant if the input shape is known
+    src = m._canon(m.inputs(node)[0])
+    v = m.vars[src]
+    shp = v.shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise UnsupportedOpError("Shape of dynamically-shaped tensor")
+    arr = np.asarray(shp, np.int32)
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)

@@ -1,0 +1,13 @@
+"""NLP: tokenizers, BERT data pipeline, word vectors.
+
+Reference parity: deeplearning4j-nlp (SURVEY.md §2.2 J15) —
+text/tokenization/**, iterator/BertIterator.java, models/** (Word2Vec et al.).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    BertWordPieceTokenizer,
+    DefaultTokenizer,
+    Vocab,
+)
+from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, GloVe, ParagraphVectors  # noqa: F401

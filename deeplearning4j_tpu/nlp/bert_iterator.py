@@ -1,0 +1,137 @@
+"""BertIterator: text → BERT training batches.
+
+Reference parity: deeplearning4j-nlp iterator/BertIterator.java — tasks
+SEQ_CLASSIFICATION (labeled sentences/pairs → [CLS] readout training) and
+UNSUPERVISED (masked-LM with the BertMaskedLMMasker 80/10/10 strategy),
+LengthHandling.FIXED_LENGTH truncate/pad, FeatureArrays with segment ids and
+masks — path-cite, mount empty this round.
+
+Emits DataSet batches consumable by MultiLayerNetwork: features (B,T,2)
+stacked [token_ids, segment_ids] (BertEmbeddingLayer input), features_mask
+(B,T); labels one-hot (B,C) for classification, (B,T,V) + labels_mask for
+masked LM.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nlp.tokenization import BertWordPieceTokenizer, Vocab
+
+
+class BertIterator:
+    SEQ_CLASSIFICATION = "seq_classification"
+    UNSUPERVISED = "unsupervised"
+
+    def __init__(
+        self,
+        tokenizer: BertWordPieceTokenizer,
+        *,
+        task: str = SEQ_CLASSIFICATION,
+        max_length: int = 128,
+        batch_size: int = 32,
+        sentences: Optional[Sequence[str]] = None,
+        labels: Optional[Sequence[int]] = None,
+        sentence_pairs: Optional[Sequence[Tuple[str, str]]] = None,
+        n_classes: Optional[int] = None,
+        mask_prob: float = 0.15,
+        seed: int = 0,
+    ):
+        if task not in (self.SEQ_CLASSIFICATION, self.UNSUPERVISED):
+            raise ValueError(f"unknown task {task!r}")
+        if sentences is None and sentence_pairs is None:
+            raise ValueError("provide sentences or sentence_pairs")
+        if task == self.SEQ_CLASSIFICATION and labels is None:
+            raise ValueError("SEQ_CLASSIFICATION requires labels")
+        self.tokenizer = tokenizer
+        self.vocab = tokenizer.vocab
+        self.task = task
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.sentences = sentences
+        self.labels = labels
+        self.sentence_pairs = sentence_pairs
+        self.n_classes = n_classes or (max(labels) + 1 if labels else None)
+        self.mask_prob = mask_prob
+        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+
+    def reset(self):
+        self._rng = np.random.default_rng(self._seed)
+
+    # ------------------------------------------------------------------
+    def _encode_one(self, i: int) -> Tuple[np.ndarray, np.ndarray, int]:
+        """→ (ids[T], segments[T], true_len), FIXED_LENGTH truncate/pad."""
+        v = self.vocab
+        T = self.max_length
+        if self.sentence_pairs is not None:
+            a, b = self.sentence_pairs[i]
+            ta = self.tokenizer.encode(a)
+            tb = self.tokenizer.encode(b)
+            # [CLS] a [SEP] b [SEP]; truncate the longer side first
+            budget = T - 3
+            while len(ta) + len(tb) > budget:
+                (ta if len(ta) >= len(tb) else tb).pop()
+            ids = [v.id(v.CLS)] + ta + [v.id(v.SEP)] + tb + [v.id(v.SEP)]
+            segs = [0] * (len(ta) + 2) + [1] * (len(tb) + 1)
+        else:
+            t = self.tokenizer.encode(self.sentences[i])[: T - 2]
+            ids = [v.id(v.CLS)] + t + [v.id(v.SEP)]
+            segs = [0] * len(ids)
+        L = len(ids)
+        out = np.full((T,), v.id(v.PAD), np.int32)
+        out[:L] = ids
+        so = np.zeros((T,), np.int32)
+        so[:L] = segs
+        return out, so, L
+
+    def _mask_tokens(self, ids: np.ndarray, L: int):
+        """BertMaskedLMMasker parity: each non-special position is chosen with
+        ``mask_prob``; chosen → 80% [MASK], 10% random id, 10% unchanged."""
+        v = self.vocab
+        labels = ids.copy()
+        lmask = np.zeros_like(ids, np.float32)
+        special = {v.id(v.CLS), v.id(v.SEP), v.id(v.PAD)}
+        masked = ids.copy()
+        for t in range(L):
+            if ids[t] in special or self._rng.random() >= self.mask_prob:
+                continue
+            lmask[t] = 1.0
+            r = self._rng.random()
+            if r < 0.8:
+                masked[t] = v.id(v.MASK)
+            elif r < 0.9:
+                masked[t] = self._rng.integers(0, len(v))
+        return masked, labels, lmask
+
+    def _emit(self, idxs: List[int]) -> DataSet:
+        B, T = len(idxs), self.max_length
+        feats = np.zeros((B, T, 2), np.float32)
+        fmask = np.zeros((B, T), np.float32)
+        if self.task == self.SEQ_CLASSIFICATION:
+            y = np.zeros((B, self.n_classes), np.float32)
+            for j, i in enumerate(idxs):
+                ids, segs, L = self._encode_one(i)
+                feats[j, :, 0], feats[j, :, 1] = ids, segs
+                fmask[j, :L] = 1.0
+                y[j, int(self.labels[i])] = 1.0
+            return DataSet(feats, y, features_mask=fmask)
+        V = len(self.vocab)
+        y = np.zeros((B, T, V), np.float32)
+        lmask = np.zeros((B, T), np.float32)
+        for j, i in enumerate(idxs):
+            ids, segs, L = self._encode_one(i)
+            masked, labels, lm = self._mask_tokens(ids, L)
+            feats[j, :, 0], feats[j, :, 1] = masked, segs
+            fmask[j, :L] = 1.0
+            y[j, np.arange(T), labels] = 1.0
+            lmask[j] = lm
+        return DataSet(feats, y, features_mask=fmask, labels_mask=lmask)
+
+    def __iter__(self):
+        n = len(self.sentence_pairs if self.sentence_pairs is not None else self.sentences)
+        for s in range(0, n, self.batch_size):
+            yield self._emit(list(range(s, min(s + self.batch_size, n))))

@@ -1,0 +1,140 @@
+"""Tokenizers: default whitespace/punct tokenizer + BERT WordPiece.
+
+Reference parity: deeplearning4j-nlp text/tokenization/tokenizer/** —
+DefaultTokenizer.java, BertWordPieceTokenizer.java (wraps
+BertWordPieceTokenizerFactory + the wordpiece vocab), and the
+BertWordPieceStreamTokenizer greedy longest-match algorithm — path-cite,
+mount empty this round. Pure-Python host-side code (tokenization is not a
+device workload); emits numpy int arrays ready for device feed.
+"""
+
+from __future__ import annotations
+
+import string
+import unicodedata
+from typing import Dict, Iterable, List, Optional
+
+
+class Vocab:
+    """token ↔ id table (BertWordPieceTokenizerFactory vocab parity).
+
+    File format: one token per line, id = line number (the BERT vocab.txt
+    convention)."""
+
+    PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+
+    def __init__(self, tokens: Iterable[str]):
+        self.tokens: List[str] = list(tokens)
+        self.index: Dict[str, int] = {t: i for i, t in enumerate(self.tokens)}
+
+    @classmethod
+    def load(cls, path: str) -> "Vocab":
+        with open(path, encoding="utf-8") as f:
+            return cls([ln.rstrip("\n") for ln in f if ln.rstrip("\n")])
+
+    @classmethod
+    def build(cls, corpus: Iterable[str], max_size: int = 30000) -> "Vocab":
+        """Build a word-level+wordpiece-ish vocab from a corpus (test/demo
+        helper; real BERT vocabs are loaded with :meth:`load`)."""
+        counts: Dict[str, int] = {}
+        tok = DefaultTokenizer()
+        for line in corpus:
+            for w in tok.tokenize(line.lower()):
+                counts[w] = counts.get(w, 0) + 1
+        special = [cls.PAD, cls.UNK, cls.CLS, cls.SEP, cls.MASK]
+        words = sorted(counts, key=lambda w: (-counts[w], w))[: max_size - len(special)]
+        return cls(special + words)
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def __contains__(self, t):
+        return t in self.index
+
+    def id(self, token: str) -> int:
+        return self.index.get(token, self.index.get(self.UNK, 0))
+
+    def token(self, i: int) -> str:
+        return self.tokens[i]
+
+
+class DefaultTokenizer:
+    """Whitespace + punctuation splitting, optional lowercase/accent-strip
+    (DefaultTokenizer.java + BERT BasicTokenizer behavior)."""
+
+    def __init__(self, lower_case: bool = True, strip_accents: bool = True):
+        self.lower_case = lower_case
+        self.strip_accents = strip_accents
+
+    def tokenize(self, text: str) -> List[str]:
+        if self.lower_case:
+            text = text.lower()
+        if self.strip_accents:
+            text = "".join(
+                c for c in unicodedata.normalize("NFD", text)
+                if unicodedata.category(c) != "Mn"
+            )
+        out: List[str] = []
+        cur = ""
+        for ch in text:
+            if ch.isspace():
+                if cur:
+                    out.append(cur)
+                    cur = ""
+            elif ch in string.punctuation:
+                if cur:
+                    out.append(cur)
+                    cur = ""
+                out.append(ch)
+            else:
+                cur += ch
+        if cur:
+            out.append(cur)
+        return out
+
+
+class BertWordPieceTokenizer:
+    """Greedy longest-match-first wordpiece over a basic-tokenized stream
+    (BertWordPieceTokenizer.java / the standard BERT WordpieceTokenizer).
+
+    Unknown words (no wordpiece cover) become [UNK]. Continuation pieces use
+    the ``##`` prefix convention."""
+
+    def __init__(self, vocab: Vocab, lower_case: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.basic = DefaultTokenizer(lower_case=lower_case)
+        self.max_chars_per_word = max_chars_per_word
+
+    def tokenize(self, text: str) -> List[str]:
+        pieces: List[str] = []
+        for word in self.basic.tokenize(text):
+            pieces.extend(self._wordpiece(word))
+        return pieces
+
+    def encode(self, text: str) -> List[int]:
+        return [self.vocab.id(t) for t in self.tokenize(text)]
+
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [Vocab.UNK]
+        if word in self.vocab:
+            return [word]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece: Optional[str] = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [Vocab.UNK]
+            out.append(piece)
+            start = end
+        return out

@@ -199,12 +199,16 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(x, y) | fit(iterator) | fit(iterator, epochs=N)."""
+        """fit(x, y) | fit(DataSet) | fit(iterator) | fit(iterator, epochs=N)."""
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(jnp.asarray(data), jnp.asarray(labels))
                 self._end_epoch()
             return self
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        if isinstance(data, DataSet):  # fit(DataSet) parity: one-batch iterator
+            data = [data]
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
@@ -267,22 +271,43 @@ class MultiLayerNetwork:
             acts.append(h)
         return acts
 
-    def score(self, dataset=None, x=None, y=None) -> float:
-        """Loss on a dataset (MultiLayerNetwork.score parity)."""
+    def score(self, dataset=None, x=None, y=None, mask=None, label_mask=None) -> float:
+        """Loss on a dataset (MultiLayerNetwork.score parity). Honors the
+        DataSet's feature/label masks, like training does."""
         if dataset is not None:
             x, y = dataset.features, dataset.labels
-        loss, _ = self._loss_eval(self.params, self.states, jnp.asarray(x), jnp.asarray(y))
+            mask = getattr(dataset, "features_mask", None)
+            label_mask = getattr(dataset, "labels_mask", None)
+        mk = None if mask is None else jnp.asarray(mask)
+        lmk = None if label_mask is None else jnp.asarray(label_mask)
+        loss, _ = self._loss_eval(
+            self.params, self.states, jnp.asarray(x), jnp.asarray(y), mk, lmk)
         return float(loss)
 
     @functools.cached_property
     def _loss_eval(self):
-        def eval_loss(params, states, x, y):
+        def eval_loss(params, states, x, y, mask, label_mask):
             h = self._cast(x)
             cparams = self._cast_params(params)
+            fmask = mask
             for i, lyr in enumerate(self.layers[:-1]):
-                h, _ = lyr.apply(cparams[i], states[i], h, training=False)
+                kw = {}
+                if (
+                    fmask is not None
+                    and self._mask_aware[i]
+                    and h.ndim == 3
+                    and fmask.shape[:2] == h.shape[:2]
+                ):
+                    kw["mask"] = fmask
+                h, _ = lyr.apply(cparams[i], states[i], h, training=False, **kw)
+                if h.ndim < 3:
+                    fmask = None
+            loss_kw = {}
+            lm = label_mask if label_mask is not None else fmask
+            if lm is not None and self._loss_mask_aware:
+                loss_kw["mask"] = lm
             loss = self.layers[-1].compute_loss(
-                cparams[-1], states[-1], h, y, training=False
+                cparams[-1], states[-1], h, y, training=False, **loss_kw
             )
             return loss, h
 
@@ -297,7 +322,8 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            preds = self.output(ds.features)
+            preds = self.output(ds.features,
+                                mask=getattr(ds, "features_mask", None))
             ev.eval(np.asarray(ds.labels), np.asarray(preds))
         return ev
 
@@ -308,7 +334,8 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            preds = self.output(ds.features)
+            preds = self.output(ds.features,
+                                mask=getattr(ds, "features_mask", None))
             ev.eval(np.asarray(ds.labels), np.asarray(preds))
         return ev
 

@@ -190,18 +190,27 @@ class GravesLSTM(BaseRecurrentLayer):
 @dataclasses.dataclass(frozen=True)
 class GRU(BaseRecurrentLayer):
     """Gated recurrent unit (libnd4j gruCell op / SameDiff gru — the DL4J
-    layer zoo lacks a GRU config layer; first-class here). Gates [r,z,n]."""
+    layer zoo lacks a GRU config layer; first-class here). Gates [r,z,n];
+    the reset gate multiplies the recurrent term AFTER the matmul (one fused
+    (H,3H) product per step — the CuDNN/Keras ``reset_after`` formulation,
+    which is also the MXU-friendly one). ``recurrent_bias`` adds the separate
+    recurrent bias of that formulation (Keras GRU import)."""
+
+    recurrent_bias: bool = False
 
     def initialize(self, key, input_shape):
         n_in = self.n_in or input_shape[-1]
         h = self.n_out
         k1, k2 = jax.random.split(key)
         rec_init = self.weight_init_recurrent or self.weight_init
-        return {
+        params = {
             "W": winit.init(k1, self.weight_init, (n_in, 3 * h)),
             "U": winit.init(k2, rec_init, (h, 3 * h)),
             "b": jnp.zeros((3 * h,)),
-        }, {}
+        }
+        if self.recurrent_bias:
+            params["b_rec"] = jnp.zeros((3 * h,))
+        return params, {}
 
     def init_carry(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.n_out), dtype)
@@ -211,9 +220,12 @@ class GRU(BaseRecurrentLayer):
         f_act = act.resolve(self.activation)
         g_act = act.resolve(self.gate_activation)
         xp = x @ params["W"].astype(x.dtype) + params["b"].astype(x.dtype)
+        b_rec = params.get("b_rec")
 
         def step(h_prev, xt):
             hU = h_prev @ params["U"].astype(xt.dtype)
+            if b_rec is not None:
+                hU = hU + b_rec.astype(xt.dtype)
             xr, xz, xn = jnp.split(xt, 3, axis=-1)
             hr, hz, hn = jnp.split(hU, 3, axis=-1)
             r = g_act(xr + hr)

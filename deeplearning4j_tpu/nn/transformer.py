@@ -1,0 +1,184 @@
+"""Transformer encoder layers: BERT embeddings + encoder blocks.
+
+Reference parity: the reference has no native transformer *layer* classes —
+BERT runs there as a TF-imported SameDiff graph (BASELINE config #4,
+SURVEY.md §3.3: TFGraphMapper.importGraph → SameDiff exec) over the attention
+declarable ops. Here the encoder is a first-class layer family so BERT builds
+natively in MultiLayerNetwork/ComputationGraph, with the TF-import path
+(deeplearning4j_tpu.samediff) as the parity route.
+
+TPU-native: [B,T,H] layout; each block is two residual sublayers whose
+matmuls XLA tiles onto the MXU; set ``flash=True`` on the attention for long
+sequences (Pallas kernel, no padding mask support).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as act
+from deeplearning4j_tpu.nn import weights as winit
+from deeplearning4j_tpu.nn.layers import Layer, register_layer
+from deeplearning4j_tpu.ops import attention as attn_ops
+from deeplearning4j_tpu.ops import nn as nnops
+from deeplearning4j_tpu.ops import random as randops
+
+
+def _layer_norm(x, gamma, beta, eps=1e-12):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * gamma + beta
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class BertEmbeddingLayer(Layer):
+    """BERT input embeddings: word + learned position + token-type, then
+    LayerNorm + dropout. Input: (B,T) int token ids, or (B,T,2) stacked
+    [token_ids, segment_ids] for sentence pairs."""
+
+    vocab_size: int = 0
+    hidden_size: int = 0
+    max_position: int = 512
+    type_vocab_size: int = 2
+    init_range: float = 0.02
+
+    def initialize(self, key, input_shape):
+        kw, kp, kt = jax.random.split(key, 3)
+        r = self.init_range
+        return {
+            "word": jax.random.normal(kw, (self.vocab_size, self.hidden_size)) * r,
+            "pos": jax.random.normal(kp, (self.max_position, self.hidden_size)) * r,
+            "type": jax.random.normal(kt, (self.type_vocab_size, self.hidden_size)) * r,
+            "gamma": jnp.ones((self.hidden_size,), jnp.float32),
+            "beta": jnp.zeros((self.hidden_size,), jnp.float32),
+        }, {}
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        if x.ndim == 3:
+            tokens = x[..., 0].astype(jnp.int32)
+            segments = x[..., 1].astype(jnp.int32)
+        else:
+            tokens = x.astype(jnp.int32)
+            segments = jnp.zeros_like(tokens)
+        t = tokens.shape[1]
+        h = (
+            jnp.take(params["word"], tokens, axis=0)
+            + params["pos"][None, :t]
+            + jnp.take(params["type"], segments, axis=0)
+        )
+        h = _layer_norm(h, params["gamma"], params["beta"])
+        return self._maybe_dropout(h, training, key), state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.hidden_size)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class TransformerEncoderBlock(Layer):
+    """One post-LN transformer encoder block (BERT layout):
+
+        h = LN(x + Dropout(MHA(x)));  out = LN(h + Dropout(FFN(h)))
+
+    ``mask``: (B,T) padding mask — masked keys are never attended to.
+    """
+
+    hidden_size: int = 0
+    n_heads: int = 1
+    ffn_size: int = 0  # default 4*hidden
+    activation: str = "gelu"
+    attn_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    init_range: float = 0.02
+    flash: bool = False
+    pre_norm: bool = False  # pre-LN variant (GPT-style)
+
+    @property
+    def _ffn(self):
+        return self.ffn_size or 4 * self.hidden_size
+
+    def initialize(self, key, input_shape):
+        hs = self.hidden_size
+        ks = jax.random.split(key, 6)
+        r = self.init_range
+        n = jax.random.normal
+        return {
+            "Wq": n(ks[0], (hs, hs)) * r, "bq": jnp.zeros((hs,)),
+            "Wk": n(ks[1], (hs, hs)) * r, "bk": jnp.zeros((hs,)),
+            "Wv": n(ks[2], (hs, hs)) * r, "bv": jnp.zeros((hs,)),
+            "Wo": n(ks[3], (hs, hs)) * r, "bo": jnp.zeros((hs,)),
+            "ln1_g": jnp.ones((hs,)), "ln1_b": jnp.zeros((hs,)),
+            "W1": n(ks[4], (hs, self._ffn)) * r, "b1": jnp.zeros((self._ffn,)),
+            "W2": n(ks[5], (self._ffn, hs)) * r, "b2": jnp.zeros((hs,)),
+            "ln2_g": jnp.ones((hs,)), "ln2_b": jnp.zeros((hs,)),
+        }, {}
+
+    def _mha(self, params, x, mask):
+        b, t, hs = x.shape
+        nh = self.n_heads
+        dh = hs // nh
+        split = lambda y: jnp.transpose(y.reshape(b, t, nh, dh), (0, 2, 1, 3))
+        q = split(x @ params["Wq"] + params["bq"])
+        k = split(x @ params["Wk"] + params["bk"])
+        v = split(x @ params["Wv"] + params["bv"])
+        if self.flash and mask is None:
+            o = attn_ops.flash_attention(q, k, v)
+        else:
+            amask = None if mask is None else mask[:, None, None, :].astype(bool)
+            o = attn_ops.dot_product_attention(q, k, v, mask=amask)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, hs)
+        return o @ params["Wo"] + params["bo"]
+
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+
+        def drop(h, k):
+            # sublayer-output dropout at hidden_dropout (a different rate
+            # from Layer.dropout, which is input dropout)
+            if training and self.hidden_dropout > 0.0 and k is not None:
+                return randops.dropout(h, k, self.hidden_dropout, training=True)
+            return h
+
+        fn = act.resolve(self.activation)
+        if self.pre_norm:
+            a = self._mha(params, _layer_norm(x, params["ln1_g"], params["ln1_b"]), mask)
+            h = x + drop(a, k1)
+            f = _layer_norm(h, params["ln2_g"], params["ln2_b"])
+            f = fn(f @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
+            out = h + drop(f, k2)
+        else:
+            a = self._mha(params, x, mask)
+            h = _layer_norm(x + drop(a, k1), params["ln1_g"], params["ln1_b"])
+            f = fn(h @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
+            out = _layer_norm(h + drop(f, k2), params["ln2_g"], params["ln2_b"])
+        if mask is not None:
+            out = out * mask[..., None].astype(out.dtype)
+        return out, state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.hidden_size)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class TimeStepLayer(Layer):
+    """Select one time step from (B,T,F) → (B,F). index=0 is BERT's [CLS]
+    readout (the reference does this with a SubsetVertex-style slice)."""
+
+    index: int = 0
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        return x[:, self.index], state
+
+    def output_shape(self, input_shape):
+        return (input_shape[-1],)

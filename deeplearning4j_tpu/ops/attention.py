@@ -95,6 +95,11 @@ def dot_product_attention(
     if mask is not None:
         s = jnp.where(jnp.asarray(mask, dtype=bool), s, _NEG_BIG)
     w = jax.nn.softmax(s, axis=-1)
+    if causal or mask is not None:
+        # fully-masked rows: softmax of uniform -1e30 is uniform — zero those
+        # rows instead (matches the flash path's empty-accumulator semantics)
+        valid = jnp.any(s > _NEG_BIG / 2, axis=-1, keepdims=True)
+        w = jnp.where(valid, w, 0.0)
     out = jnp.einsum("...qk,...kv->...qv", w.astype(v.dtype), v)
     if with_weights:
         return out, w

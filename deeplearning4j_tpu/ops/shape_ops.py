@@ -34,6 +34,9 @@ op("repeat", "shape")(jnp.repeat)
 op("concat", "shape", aliases=("concatenate",))(
     lambda arrays, axis=0: jnp.concatenate(arrays, axis=axis)
 )
+# vararg forms: graph sessions pass node inputs positionally (TF import)
+op("concat_n", "shape")(lambda *arrays, axis=0: jnp.concatenate(arrays, axis=axis))
+op("stack_n", "shape")(lambda *arrays, axis=0: jnp.stack(arrays, axis=axis))
 op("stack", "shape", aliases=("parallel_stack",))(
     lambda arrays, axis=0: jnp.stack(arrays, axis=axis)
 )

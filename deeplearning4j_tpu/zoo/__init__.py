@@ -9,6 +9,7 @@ Pretrained-weight download is stubbed: this machine has no egress; use
 ModelSerializer restore for locally saved weights instead.
 """
 
+from deeplearning4j_tpu.zoo.bert import Bert  # noqa: F401
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet,
     Darknet19,
@@ -16,6 +17,7 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     ResNet50,
     SimpleCNN,
     SqueezeNet,
+    TextGenerationLSTM,
     UNet,
     VGG16,
     VGG19,

@@ -1,0 +1,96 @@
+"""BERT models: native transformer-encoder configs (BASELINE config #4).
+
+Reference parity: the reference runs BERT as a TF-imported frozen SameDiff
+graph (BASELINE.json config #4 "BERT-base fine-tune (SameDiff TF import)";
+SURVEY.md §3.3) — it has no native BERT model class. Here BERT is a
+first-class zoo model over the transformer layer family, so fine-tune and
+masked-LM pretraining run through the ordinary MultiLayerNetwork.fit() path
+as ONE jitted train step; the TF-import route remains available through
+deeplearning4j_tpu.samediff for graph-parity work.
+
+Input convention (matches nlp.BertIterator): features (B,T,2) stacked
+[token_ids, segment_ids], features_mask (B,T).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.nn.transformer import (
+    BertEmbeddingLayer,
+    TimeStepLayer,
+    TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork
+from deeplearning4j_tpu.zoo.models import ZooModel
+
+
+@dataclasses.dataclass
+class Bert(ZooModel):
+    """Configurable BERT encoder. ``base()``/``tiny()`` give standard sizes;
+    ``task`` selects the head: "classification" ([CLS] → pooler → softmax over
+    num_classes) or "mlm" (per-token softmax over the vocab)."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_size: int = 0  # 0 → 4*hidden
+    max_length: int = 128
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    task: str = "classification"
+    num_classes: int = 2
+    flash: bool = False
+
+    @classmethod
+    def base(cls, **kw):
+        kw.setdefault("hidden_size", 768)
+        kw.setdefault("n_layers", 12)
+        kw.setdefault("n_heads", 12)
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("n_layers", 24)
+        kw.setdefault("n_heads", 16)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """BERT-tiny (2L/128H) — test/CI size."""
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 2)
+        return cls(**kw)
+
+    def conf(self):
+        lb = self._builder().list()
+        lb.layer(BertEmbeddingLayer(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            max_position=self.max_length, type_vocab_size=self.type_vocab_size,
+            dropout=self.hidden_dropout))
+        for _ in range(self.n_layers):
+            lb.layer(TransformerEncoderBlock(
+                hidden_size=self.hidden_size, n_heads=self.n_heads,
+                ffn_size=self.ffn_size, hidden_dropout=self.hidden_dropout,
+                flash=self.flash))
+        if self.task == "classification":
+            lb.layer(TimeStepLayer(index=0))  # [CLS]
+            lb.layer(DenseLayer(n_in=self.hidden_size, n_out=self.hidden_size,
+                                activation="tanh"))  # pooler
+            lb.layer(OutputLayer(n_in=self.hidden_size, n_out=self.num_classes,
+                                 loss="mcxent", activation="softmax"))
+        elif self.task == "mlm":
+            lb.layer(RnnOutputLayer(n_in=self.hidden_size, n_out=self.vocab_size,
+                                    loss="mcxent", activation="softmax"))
+        else:
+            raise ValueError(f"unknown task {self.task!r}")
+        lb.set_input_type(InputType.recurrent(2, self.max_length))
+        return lb.build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -432,3 +432,27 @@ class Xception(ZooModel):
         gb.set_outputs("output")
         gb.set_input_types(InputType.convolutional(h, w, c))
         return gb.build()
+
+
+@dataclasses.dataclass
+class TextGenerationLSTM(ZooModel):
+    """zoo/model/TextGenerationLSTM.java — char-level generation: stacked
+    LSTMs + per-timestep softmax (the GravesLSTM char-RNN, BASELINE #3's
+    model family). Input (B,T,vocab) one-hot; output per-step distribution."""
+
+    total_unique_characters: int = 47
+    units: int = 256
+    dropout: float = 0.2
+    max_length: int = 40
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+
+        v = self.total_unique_characters
+        lb = self._builder().list()
+        lb.layer(LSTM(n_in=v, n_out=self.units))
+        lb.layer(LSTM(n_in=self.units, n_out=self.units, dropout=self.dropout))
+        lb.layer(RnnOutputLayer(n_in=self.units, n_out=v, loss="mcxent",
+                                activation="softmax", dropout=self.dropout))
+        lb.set_input_type(InputType.recurrent(v, self.max_length))
+        return lb.build()

@@ -1,0 +1,90 @@
+"""Keras HDF5 import — per-model golden outputs vs tf.keras.
+
+Reference test parity: deeplearning4j-modelimport tests (full-model import
+vs Keras-saved activations; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports import KerasModelImport  # noqa: E402
+
+
+def _roundtrip(model, x, tmp_path, atol=1e-5):
+    path = str(tmp_path / "model.h5")
+    model.save(path)
+    golden = np.asarray(model(x))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, golden, atol=atol, rtol=1e-4)
+    return net
+
+
+def test_mlp(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(4, activation="softmax"),
+    ])
+    x = rng.normal(size=(5, 6)).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_cnn_bn_pool(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 12, 3)),
+        tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(4, 3, padding="valid"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(5, activation="softmax"),
+    ])
+    # non-trivial BN stats: run a training step so moving stats move
+    m.compile("sgd", "categorical_crossentropy")
+    xs = rng.normal(size=(16, 12, 12, 3)).astype(np.float32)
+    ys = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)]
+    m.fit(xs, ys, epochs=1, verbose=0)
+    x = rng.normal(size=(2, 12, 12, 3)).astype(np.float32)
+    _roundtrip(m, x, tmp_path, atol=1e-4)
+
+
+def test_lstm(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.LSTM(6, return_sequences=True),
+    ])
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    _roundtrip(m, x, tmp_path, atol=1e-5)
+
+
+def test_gru(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.GRU(6, return_sequences=True),
+    ])
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    _roundtrip(m, x, tmp_path, atol=1e-5)
+
+
+def test_embedding_pooling(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((9,)),
+        tf.keras.layers.Embedding(20, 8),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = rng.integers(0, 20, size=(4, 9)).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_unsupported_layer_reports_name(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((4, 4, 1)),
+        tf.keras.layers.ConvLSTM1D(2, 2),
+    ])
+    path = str(tmp_path / "m.h5")
+    m.save(path)
+    with pytest.raises(ValueError, match="ConvLSTM1D"):
+        KerasModelImport.import_keras_model_and_weights(path)

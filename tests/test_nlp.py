@@ -1,0 +1,132 @@
+"""NLP: tokenizers, BertIterator, word vectors.
+
+Reference test parity: deeplearning4j-nlp tests (BertWordPieceTokenizerTests,
+BertIteratorTest, Word2VecTests/Glove tests on tiny corpora; SURVEY.md §2.2
+J15)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BertIterator,
+    BertWordPieceTokenizer,
+    DefaultTokenizer,
+    GloVe,
+    ParagraphVectors,
+    Vocab,
+    Word2Vec,
+)
+
+
+class TestTokenizers:
+    def test_default_tokenizer(self):
+        t = DefaultTokenizer()
+        assert t.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+
+    def test_wordpiece_greedy_longest_match(self):
+        v = Vocab(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                   "un", "##aff", "##able", "##ord", "play", "##ing", "the"])
+        tok = BertWordPieceTokenizer(v)
+        assert tok.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert tok.tokenize("playing the") == ["play", "##ing", "the"]
+        assert tok.tokenize("xyzzy") == ["[UNK]"]
+
+    def test_vocab_file_roundtrip(self, tmp_path):
+        p = tmp_path / "vocab.txt"
+        p.write_text("[PAD]\n[UNK]\nhello\nworld\n")
+        v = Vocab.load(str(p))
+        assert v.id("hello") == 2 and v.token(3) == "world"
+        assert v.id("missing") == v.id("[UNK]")
+
+
+class TestBertIterator:
+    def _tok(self, texts):
+        return BertWordPieceTokenizer(Vocab.build(texts))
+
+    def test_classification_batches(self):
+        texts = ["the cat sat", "a dog ran fast", "the cat ran"] * 4
+        labels = [0, 1, 0] * 4
+        it = BertIterator(self._tok(texts), task=BertIterator.SEQ_CLASSIFICATION,
+                          sentences=texts, labels=labels, max_length=8,
+                          batch_size=4, n_classes=2)
+        batches = list(it)
+        assert len(batches) == 3
+        ds = batches[0]
+        assert ds.features.shape == (4, 8, 2)
+        assert ds.labels.shape == (4, 2)
+        v = it.vocab
+        # [CLS] first, [SEP] closes each sequence, mask covers the tokens
+        assert ds.features[0, 0, 0] == v.id(v.CLS)
+        L = int(ds.features_mask[0].sum())
+        assert ds.features[0, L - 1, 0] == v.id(v.SEP)
+
+    def test_sentence_pairs_segments(self):
+        texts = ["the cat sat on the mat", "a dog ran"]
+        pairs = [(texts[0], texts[1])]
+        it = BertIterator(self._tok(texts), task=BertIterator.SEQ_CLASSIFICATION,
+                          sentence_pairs=pairs, labels=[1], max_length=16,
+                          batch_size=1, n_classes=2)
+        ds = next(iter(it))
+        segs = ds.features[0, :, 1]
+        assert segs.max() == 1.0  # second sentence marked segment 1
+        # segment 1 region ends where the mask ends
+        L = int(ds.features_mask[0].sum())
+        assert segs[L - 1] == 1.0 and segs[0] == 0.0
+
+    def test_unsupervised_mlm_masking(self):
+        texts = ["the quick brown fox jumps over the lazy dog again"] * 8
+        it = BertIterator(self._tok(texts), task=BertIterator.UNSUPERVISED,
+                          sentences=texts, max_length=12, batch_size=8,
+                          mask_prob=0.5, seed=3)
+        ds = next(iter(it))
+        assert ds.labels.shape == (8, 12, len(it.vocab))
+        assert ds.labels_mask.sum() > 0  # some positions masked
+        v = it.vocab
+        # masked-position labels hold the ORIGINAL token, not [MASK]
+        b, t = np.argwhere(ds.labels_mask > 0)[0]
+        orig = int(np.argmax(ds.labels[b, t]))
+        assert orig not in (v.id(v.MASK), v.id(v.PAD))
+        # [MASK] appears somewhere in the inputs
+        assert (ds.features[..., 0] == v.id(v.MASK)).any()
+
+    def test_reset_reproducible(self):
+        texts = ["a b c d e f g"] * 4
+        it = BertIterator(self._tok(texts), task=BertIterator.UNSUPERVISED,
+                          sentences=texts, max_length=8, batch_size=4, seed=1)
+        a = next(iter(it)).features.copy()
+        it.reset()
+        b = next(iter(it)).features.copy()
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.fixture(scope="module")
+def toy_corpus():
+    # two topic clusters; co-occurrence forces king/queen and cat/dog together
+    rng = np.random.default_rng(0)
+    royal = ["king queen royal palace crown throne"] * 40
+    pets = ["cat dog pet tail fur paw"] * 40
+    lines = royal + pets
+    rng.shuffle(lines)
+    return lines
+
+
+class TestWordVectors:
+    def test_word2vec_learns_topics(self, toy_corpus):
+        w2v = Word2Vec(min_word_frequency=5, layer_size=16, window_size=3,
+                       negative=4, epochs=10, subsample=0, seed=0).fit(toy_corpus)
+        assert w2v.has_word("king") and w2v.has_word("cat")
+        assert w2v.similarity("king", "queen") > w2v.similarity("king", "dog")
+        near = w2v.words_nearest("cat", 3)
+        assert "king" not in near
+
+    def test_glove_learns_topics(self, toy_corpus):
+        g = GloVe(min_word_frequency=5, layer_size=8, epochs=40, seed=0).fit(toy_corpus)
+        assert g.similarity("king", "queen") > g.similarity("king", "dog")
+
+    def test_paragraph_vectors_infer(self, toy_corpus):
+        pv = ParagraphVectors(min_word_frequency=5, layer_size=16, window_size=3,
+                              negative=4, epochs=6, subsample=0, seed=0).fit(toy_corpus)
+        assert pv.doc_vectors.shape[0] == len(toy_corpus)
+        v = pv.infer_vector("king queen royal")
+        assert v.shape == (16,)
+        assert np.isfinite(v).all()
