@@ -8,10 +8,11 @@ under layers/**) — SURVEY.md §2.2 J13 — path-cite, mount empty this round.
 Reads the Keras v2 HDF5 format (h5py): ``model_config`` JSON attr +
 ``model_weights`` groups. Sequential models map onto MultiLayerNetwork,
 functional single-path models too; the supported layer set mirrors the
-reference's core coverage (Dense, Conv2D, DepthwiseConv2D, SeparableConv2D,
-MaxPooling2D/AveragePooling2D, BatchNormalization, LayerNormalization,
-Dropout, Flatten, Activation, Embedding, LSTM, GRU, SimpleRNN, Bidirectional,
-GlobalMax/AveragePooling2D/1D, ZeroPadding2D, UpSampling2D, Cropping2D).
+reference's core coverage (Dense, Conv2D, SeparableConv2D,
+MaxPooling2D/AveragePooling2D, BatchNormalization,
+Dropout, Flatten, Activation, Embedding, LSTM, GRU, SimpleRNN,
+GlobalMax/AveragePooling2D/1D, ZeroPadding2D, UpSampling2D, Cropping2D,
+LayerNormalization).
 
 Weight-layout conversions (Keras → here):
 - Dense kernel (in, out) — same.

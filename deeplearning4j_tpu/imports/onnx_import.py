@@ -132,6 +132,11 @@ class OnnxImporter:
     def get(self, name: str) -> SDVariable:
         return self.vars[name]
 
+    @staticmethod
+    def has_input(node, i: int) -> bool:
+        """ONNX optional-input convention: empty-string name = omitted."""
+        return len(node.inputs) > i and node.inputs[i] != ""
+
     def const(self, name: str) -> np.ndarray:
         if name not in self.const_vals:
             raise NotImplementedError(
@@ -159,6 +164,13 @@ class OnnxImporter:
                     f"no import rule for ONNX op {node.op_type!r} "
                     f"({len(_ORULES)} op types supported)")
             fn(self, node)
+        # rules that lower one ONNX node to several graph ops (Gemm, Conv)
+        # leave the final var under an internal name; alias graph outputs to
+        # their ONNX names so callers can address them
+        for out in self.graph_outputs:
+            v = self.vars.get(out)
+            if v is not None and v.name != out:
+                self.vars[out] = self.sd._op("identity", [v], name=out)
         self.sd.onnx_outputs = list(self.graph_outputs)
         return self.sd
 
@@ -265,9 +277,13 @@ def _o_flatten(m, node):
     axis = node.attr("axis", 1)
     if axis != 1:
         raise NotImplementedError("Flatten axis != 1")
-    m.set(node.outputs[0], m.sd._op("reshape", [x],
-                                    attrs=dict(shape=(x.shape[0] or -1, -1))
-                                    if x.shape else dict(shape=(-1,)),
+    shp = x.shape
+    if shp is not None and all(s is not None and s >= 0 for s in shp[1:]):
+        trailing = int(np.prod(shp[1:])) if len(shp) > 1 else 1
+        shape = (-1, trailing)  # batch dim may be dynamic
+    else:
+        raise NotImplementedError("Flatten with unknown trailing dims")
+    m.set(node.outputs[0], m.sd._op("reshape", [x], attrs=dict(shape=shape),
                                     name=node.outputs[0]))
 
 
@@ -292,7 +308,7 @@ def _o_concat(m, node):
 def _o_squeeze(m, node):
     x = m.get(node.inputs[0])
     axes = node.attr("axes")
-    if axes is None and len(node.inputs) > 1:  # opset 13: axes as input
+    if axes is None and m.has_input(node, 1):  # opset 13: axes as input
         axes = [int(a) for a in m.const(node.inputs[1])]
     m.set(node.outputs[0], m.sd._op(
         "squeeze", [x], attrs=dict(axis=tuple(axes)) if axes else {},
@@ -303,7 +319,7 @@ def _o_squeeze(m, node):
 def _o_unsqueeze(m, node):
     x = m.get(node.inputs[0])
     axes = node.attr("axes")
-    if axes is None and len(node.inputs) > 1:
+    if axes is None and m.has_input(node, 1):
         axes = [int(a) for a in m.const(node.inputs[1])]
     v = x
     for a in sorted(axes):
@@ -325,7 +341,7 @@ def _o_reduce(m, node):
               "ReduceMin": "min"}[node.op_type]
     x = m.get(node.inputs[0])
     axes = node.attr("axes")
-    if axes is None and len(node.inputs) > 1:
+    if axes is None and m.has_input(node, 1):
         axes = [int(a) for a in m.const(node.inputs[1])]
     kd = bool(node.attr("keepdims", 1))
     attrs = dict(keepdims=kd)
@@ -350,8 +366,10 @@ def _o_dropout(m, node):  # inference: identity
 @orule("Clip")
 def _o_clip(m, node):
     x = m.get(node.inputs[0])
-    lo = float(np.asarray(m.const(node.inputs[1]))) if len(node.inputs) > 1 else node.attr("min", -np.inf)
-    hi = float(np.asarray(m.const(node.inputs[2]))) if len(node.inputs) > 2 else node.attr("max", np.inf)
+    lo = (float(np.asarray(m.const(node.inputs[1])))
+          if m.has_input(node, 1) else node.attr("min", -np.inf))
+    hi = (float(np.asarray(m.const(node.inputs[2])))
+          if m.has_input(node, 2) else node.attr("max", np.inf))
     m.set(node.outputs[0], m.sd._op("clipbyvalue", [x],
                                     attrs=dict(clip_min=lo, clip_max=hi),
                                     name=node.outputs[0]))

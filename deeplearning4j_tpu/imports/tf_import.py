@@ -79,7 +79,7 @@ class TFGraphMapper:
         return [i for i in node.input if not i.startswith("^")]
 
     # --------------------------------------------------------------- import
-    def build(self, feed_placeholders: bool = True) -> SameDiff:
+    def build(self) -> SameDiff:
         for node in self.gd.node:
             fn = _RULES.get(node.op)
             if fn is None:

@@ -54,7 +54,7 @@ class BertIterator:
         self.sentences = sentences
         self.labels = labels
         self.sentence_pairs = sentence_pairs
-        self.n_classes = n_classes or (max(labels) + 1 if labels else None)
+        self.n_classes = n_classes or (int(max(labels)) + 1 if labels is not None and len(labels) else None)
         self.mask_prob = mask_prob
         self._rng = np.random.default_rng(seed)
         self._seed = seed

@@ -230,6 +230,8 @@ class MultiLayerNetwork:
                 lst.on_epoch_end(self)
 
     def _fit_batch(self, x, y, mask=None, label_mask=None):
+        if self._train_step is None:  # cleared by external training masters
+            self._train_step = self._build_train_step()
         self._rng_key, sub = jax.random.split(self._rng_key)
         self.params, self.states, self.opt_states, loss = self._train_step(
             self.params, self.states, self.opt_states,

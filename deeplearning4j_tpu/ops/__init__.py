@@ -27,6 +27,7 @@ from deeplearning4j_tpu.ops.registry import (  # noqa: F401
 # Importing the family modules registers their ops.
 from deeplearning4j_tpu.ops import (  # noqa: F401
     attention,
+    compression,
     elementwise,
     linalg,
     nn,

@@ -1,0 +1,89 @@
+"""Gradient-compression ops: threshold + bitmap encoding.
+
+Reference parity: the native threshold/bitmap encode-decode ops exposed on
+``OpExecutioner`` (``DefaultOpExecutioner.thresholdEncode/bitmapEncode``,
+native impls in libnd4j legacy ops; SURVEY.md §2.4) used by
+EncodedGradientsAccumulator for async compressed gradient sharing.
+
+TPU-native framing: over ICI the right collective is a dense bf16/fp32
+all-reduce (SURVEY.md §2.4: "implement dense collectives first"), so these
+ops exist for the DCN-bound opt-in path and for API parity. They are pure
+jittable functions: encode returns the dense quantized tensor (what the
+collective reduces) plus the residual (error feedback kept locally) —
+the sparse/bitmap byte packings used for the reference's UDP transport are
+provided as host-side helpers for wire-format parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+@op("threshold_encode", "compression", aliases=("encode_threshold",))
+def threshold_encode(g, threshold):
+    """→ (quantized, residual): quantized = ±threshold where |g| > threshold,
+    else 0; residual = g - quantized (kept locally, added to the next step's
+    gradient — error-feedback SGD, the accumulator's ResidualPostProcessor)."""
+    t = jnp.asarray(threshold, g.dtype)
+    mask = jnp.abs(g) > t
+    quantized = jnp.where(mask, jnp.sign(g) * t, jnp.zeros_like(g))
+    return quantized, g - quantized
+
+
+@op("threshold_decode", "compression", aliases=("decode_threshold",))
+def threshold_decode(quantized, target=None):
+    """Dense decode is the identity; with ``target`` adds in place (the
+    reference's decode accumulates into the params/updates buffer)."""
+    return quantized if target is None else target + quantized
+
+
+@op("bitmap_encode", "compression", aliases=("encode_bitmap",))
+def bitmap_encode(g, threshold):
+    """2-bit-per-element encoding (libnd4j bitmap format): code 1 = +t,
+    2 = -t, 0 = below threshold. Returns (codes packed 16/int32, residual)."""
+    t = jnp.asarray(threshold, g.dtype)
+    flat = g.ravel()
+    n = flat.shape[0]
+    pad = (-n) % 16
+    f = jnp.pad(flat, (0, pad))
+    codes = jnp.where(f > t, 1, jnp.where(f < -t, 2, 0)).astype(jnp.uint32)
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    packed = jnp.sum(codes.reshape(-1, 16) << shifts[None, :], axis=1,
+                     dtype=jnp.uint32)
+    quantized = jnp.where(jnp.abs(flat) > t, jnp.sign(flat) * t,
+                          jnp.zeros_like(flat)).reshape(g.shape)
+    return packed, g - quantized
+
+
+@op("bitmap_decode", "compression", aliases=("decode_bitmap",))
+def bitmap_decode(packed, threshold, shape):
+    """Unpack 2-bit codes back to a dense ±threshold tensor of ``shape``."""
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    codes = (packed[:, None] >> shifts[None, :]) & 0x3
+    n = int(np.prod(shape))
+    flat = codes.reshape(-1)[:n]
+    t = jnp.asarray(threshold, jnp.float32)
+    return jnp.where(flat == 1, t, jnp.where(flat == 2, -t, 0.0)).reshape(shape)
+
+
+# ----------------------------------------------------------- host packers
+
+
+def sparse_pack(quantized: np.ndarray, threshold: float) -> np.ndarray:
+    """Host-side sparse wire format (reference's threshold message shape:
+    int32 indices, sign folded into the index sign bit; index 0 offset by 1)."""
+    flat = np.asarray(quantized).ravel()
+    idx = np.nonzero(flat)[0].astype(np.int64)
+    signs = np.sign(flat[idx]).astype(np.int64)
+    return (signs * (idx + 1)).astype(np.int64)
+
+
+def sparse_unpack(message: np.ndarray, threshold: float, shape) -> np.ndarray:
+    out = np.zeros(int(np.prod(shape)), np.float32)
+    msg = np.asarray(message, np.int64)
+    idx = np.abs(msg) - 1
+    out[idx] = np.sign(msg) * threshold
+    return out.reshape(shape)

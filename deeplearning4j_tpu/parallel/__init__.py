@@ -6,6 +6,19 @@ entire NCCL/Aeron/accumulator machinery collapses into sharding annotations on
 one SPMD program: XLA emits the collectives over ICI/DCN.
 """
 
+from deeplearning4j_tpu.parallel import distributed  # noqa: F401
+from deeplearning4j_tpu.parallel.accumulator import (  # noqa: F401
+    AdaptiveThresholdAlgorithm,
+    EncodedGradientsAccumulator,
+    FixedThresholdAlgorithm,
+    ResidualClippingPostProcessor,
+)
+from deeplearning4j_tpu.parallel.masters import (  # noqa: F401
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    SparkComputationGraph,
+    SparkDl4jMultiLayer,
+)
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh  # noqa: F401
 from deeplearning4j_tpu.parallel.ring import ring_attention, shard_sequence  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper  # noqa: F401

@@ -1,0 +1,114 @@
+"""EncodedGradientsAccumulator + threshold algorithms — gradient sharing.
+
+Reference parity: org/deeplearning4j/optimize/solvers/accumulation/
+{EncodedGradientsAccumulator,IndexedTail}.java, encoding/ResidualPostProcessor
+(ResidualClippingPostProcessor), threshold algos
+(AdaptiveThresholdAlgorithm, TargetSparsityThresholdAlgorithm,
+FixedThresholdAlgorithm) — SURVEY.md §2.2 J16 — path-cite, mount empty this
+round.
+
+TPU-native framing: the reference's accumulator is an async queue fabric
+between trainer threads + Aeron. Here sharing is synchronous inside the SPMD
+step (see parallel.masters.SharedTrainingMaster): each device threshold-
+encodes (gradient + residual), the quantized tensors all-reduce over ICI/DCN,
+and the residual stays in device-local state. This class carries the
+threshold adaptation + residual policy, as pure functions usable inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.compression import threshold_encode
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedThresholdAlgorithm:
+    """FixedThresholdAlgorithm.java parity."""
+
+    threshold: float = 1e-3
+
+    def init_state(self):
+        return jnp.asarray(self.threshold, jnp.float32)
+
+    def update(self, t, sparsity_ratio):
+        return t
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveThresholdAlgorithm:
+    """AdaptiveThresholdAlgorithm.java parity: drift the threshold so the
+    fraction of transmitted elements stays near ``target_ratio``."""
+
+    initial: float = 1e-3
+    target_ratio: float = 1e-3   # desired fraction of entries above threshold
+    decay: float = 1.2
+    min_threshold: float = 1e-6
+    max_threshold: float = 1.0
+
+    def init_state(self):
+        return jnp.asarray(self.initial, jnp.float32)
+
+    def update(self, t, sparsity_ratio):
+        too_dense = sparsity_ratio > self.target_ratio * 3.0
+        too_sparse = sparsity_ratio < self.target_ratio / 3.0
+        t = jnp.where(too_dense, t * self.decay,
+                      jnp.where(too_sparse, t / self.decay, t))
+        return jnp.clip(t, self.min_threshold, self.max_threshold)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidualClippingPostProcessor:
+    """ResidualClippingPostProcessor.java parity: every ``frequency`` steps,
+    clip the residual to ±``max_multiplier``·threshold so stale error can't
+    blow up."""
+
+    max_multiplier: float = 5.0
+    frequency: int = 5
+
+    def apply(self, residual, threshold, iteration):
+        lim = threshold * self.max_multiplier
+        clipped = jax.tree_util.tree_map(
+            lambda r: jnp.clip(r, -lim, lim), residual)
+        do = (iteration % self.frequency) == 0
+        return jax.tree_util.tree_map(
+            lambda c, r: jnp.where(do, c, r), clipped, residual)
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodedGradientsAccumulator:
+    """Pure-function core of the reference accumulator: encode (with error
+    feedback) one flat gradient pytree.
+
+    ``encode(grads, residual, threshold, iteration)`` →
+    (quantized_tree, new_residual_tree, sparsity_ratio). All jittable; the
+    caller reduces ``quantized`` across workers (psum) and applies it.
+    """
+
+    threshold_algorithm: object = AdaptiveThresholdAlgorithm()
+    residual_post_processor: object = ResidualClippingPostProcessor()
+
+    def init_residual(self, grads_template):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads_template)
+
+    def encode(self, grads, residual, threshold, iteration):
+        carried = jax.tree_util.tree_map(lambda g, r: g + r, grads, residual)
+        enc = jax.tree_util.tree_map(
+            lambda x: threshold_encode(x, threshold), carried)
+        quantized = jax.tree_util.tree_map(
+            lambda x: x[0], enc, is_leaf=lambda x: isinstance(x, tuple))
+        new_residual = jax.tree_util.tree_map(
+            lambda x: x[1], enc, is_leaf=lambda x: isinstance(x, tuple))
+        if self.residual_post_processor is not None:
+            new_residual = self.residual_post_processor.apply(
+                new_residual, threshold, iteration)
+        leaves = jax.tree_util.tree_leaves(quantized)
+        nz = sum(jnp.sum(q != 0).astype(jnp.float32) for q in leaves)
+        total = sum(q.size for q in leaves)
+        ratio = nz / total
+        new_threshold = self.threshold_algorithm.update(threshold, ratio)
+        return quantized, new_residual, new_threshold, ratio

@@ -1,0 +1,88 @@
+"""Multi-host bootstrap: the control plane for DCN-spanning meshes.
+
+Reference parity: nd4j-parameter-server (ModelParameterServer,
+AeronUdpTransport, MeshOrganizer — SURVEY.md §2.2 J17, §2.4) and the Spark
+driver's role as coordinator in §3.4.
+
+TPU-native collapse: there is no parameter-server process and no UDP mesh to
+organize — the data plane is XLA collectives over ICI within a slice and DCN
+across slices, emitted by the compiler from the SAME single-program step the
+tests run on one host. What remains of J17 is only bootstrap: every process
+must find the coordinator, learn its process id, and see the global device
+set. That is ``jax.distributed.initialize`` (PJRT distributed runtime — a
+tiny gRPC control plane), wrapped here with the reference's vocabulary.
+
+Usage on each host of a pod/multi-slice job:
+
+    from deeplearning4j_tpu.parallel import distributed
+    distributed.initialize(coordinator="10.0.0.1:8476",
+                           num_processes=4, process_id=host_idx)
+    mesh = distributed.global_mesh(data=-1)     # all chips across all hosts
+    ParallelWrapper(net, mesh=mesh).fit(iterator)
+
+The test story mirrors the reference's (§4 "distributed without a cluster"):
+multi-chip behavior is validated on the 8-virtual-device CPU mesh in-process;
+``initialize`` itself is exercised single-process (num_processes=1), which
+runs the full coordinator service on localhost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+               process_id: Optional[int] = None, local_device_ids=None) -> None:
+    """ModelParameterServer-bootstrap parity over jax.distributed.
+
+    ``coordinator``: "host:port" of process 0 (the reference's master/driver
+    address). No-op when already initialized or when running single-process
+    with no coordinator given."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator is None and (num_processes is None or num_processes <= 1):
+        return  # single-process: nothing to bootstrap
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def global_mesh(data: int = -1, model: int = 1, seq: int = 1) -> TrainingMesh:
+    """Mesh over ALL devices visible across processes. ``data=-1`` fills the
+    data axis with whatever model*seq leaves."""
+    devices = jax.devices()  # global list under jax.distributed
+    if data <= 0:
+        data = len(devices) // (model * seq)
+    return TrainingMesh(data=data, model=model, seq=seq, devices=devices)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints/logs (driver
+    parity: the Spark master's save/report role in §3.4)."""
+    return jax.process_index() == 0

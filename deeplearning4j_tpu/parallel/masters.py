@@ -1,0 +1,243 @@
+"""Training masters: parameter averaging + shared (compressed) gradient
+training over the mesh, with the Spark-facade entry points.
+
+Reference parity (SURVEY.md §2.2 J18, §3.4):
+- ParameterAveragingTrainingMaster.java (dl4j-spark impl/paramavg): each
+  worker fits locally for ``averaging_frequency`` minibatches, then params +
+  updater state are averaged cluster-wide (Spark aggregate).
+- SharedTrainingMaster.java (dl4j-spark-parameterserver): decentralized
+  gradient sharing — every step each worker threshold-encodes (grad +
+  residual) and exchanges the sparse update over Aeron, applying the sum of
+  everyone's quantized updates; residual stays local (call stack §3.4).
+- SparkDl4jMultiLayer.java — the user facade.
+
+TPU-native collapse: "workers" are mesh devices along the 'data' axis inside
+ONE SPMD program per step (shard_map). Parameter averaging keeps genuinely
+divergent per-device params (leading stacked axis) and pmean-averages every N
+steps — semantically identical to the Spark master with zero serialization.
+Shared training runs the encode → psum(quantized) → decode → update chain
+inside the step: the psum over ICI/DCN replaces the Aeron mesh, the residual
+is device-local state, and the threshold adapts exactly like
+AdaptiveThresholdAlgorithm. No Spark, no parameter server process, no
+message queues — the collective IS the parameter server.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.parallel.accumulator import EncodedGradientsAccumulator
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+
+
+def _stack_tree(tree, n):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
+
+
+def _unstack_first(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+class ParameterAveragingTrainingMaster:
+    """Sync parameter averaging every ``averaging_frequency`` minibatches."""
+
+    def __init__(self, averaging_frequency: int = 5, mesh: Optional[TrainingMesh] = None):
+        self.averaging_frequency = averaging_frequency
+        self.mesh = mesh or TrainingMesh(data=len(jax.devices()))
+        self._step = None
+        self._avg = None
+
+    # -- compiled programs --------------------------------------------------
+    def _build(self, model):
+        mesh = self.mesh.mesh
+        step_fn = model.make_step_fn(weighted=True)
+
+        def local_step(params, states, opts, iteration, x, y, keys, w):
+            params, states, opts = map(_unstack_first, (params, states, opts))
+            key = keys[0]
+            new_p, new_s, new_o, loss = step_fn(
+                params, states, opts, iteration, x, y, key, w)
+            one = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
+            return one(new_p), one(new_s), one(new_o), loss[None]
+
+        def average(params, opts, states):
+            avg = lambda t: jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, "data"), t)
+            return avg(params), avg(opts), avg(states)
+
+        stacked = P("data")
+        self._step = jax.jit(
+            jax.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(stacked, stacked, stacked, P(), stacked, stacked,
+                          stacked, stacked),
+                out_specs=(stacked, stacked, stacked, stacked),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._avg = jax.jit(
+            jax.shard_map(
+                average, mesh=mesh, in_specs=(stacked, stacked, stacked),
+                out_specs=(stacked, stacked, stacked), check_vma=False,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
+    # -- orchestration ------------------------------------------------------
+    def fit(self, model, iterator, epochs: int = 1):
+        if self._step is None:
+            self._build(model)
+        n = self.mesh.data
+        shard = NamedSharding(self.mesh.mesh, P("data"))
+        params = jax.tree_util.tree_map(np.asarray, model.params)
+        params = jax.device_put(_stack_tree(params, n), shard)
+        states = jax.device_put(_stack_tree(
+            jax.tree_util.tree_map(np.asarray, model.states), n), shard)
+        opts = jax.device_put(_stack_tree(
+            jax.tree_util.tree_map(np.asarray, model.opt_states), n), shard)
+        since_avg = 0
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x, y, w = self.mesh.pad_shard_batch(ds.features, ds.labels)
+                model._rng_key, sub = jax.random.split(model._rng_key)
+                keys = jax.device_put(
+                    jax.random.split(sub, n), shard)
+                params, states, opts, loss = self._step(
+                    params, states, opts, jnp.asarray(model.iteration),
+                    x, y, keys, w)
+                model.iteration += 1
+                model.score_value = float(jnp.mean(loss))
+                since_avg += 1
+                if since_avg >= self.averaging_frequency:
+                    params, opts, states = self._avg(params, opts, states)
+                    since_avg = 0
+                for lst in model.listeners:
+                    lst.iteration_done(model, model.iteration, model.epoch)
+            model.epoch += 1
+        if since_avg:
+            params, opts, states = self._avg(params, opts, states)
+        model.params = jax.tree_util.tree_map(np.asarray, _unstack_first(params))
+        model.states = jax.tree_util.tree_map(np.asarray, _unstack_first(states))
+        model.opt_states = jax.tree_util.tree_map(np.asarray, _unstack_first(opts))
+        model._train_step = None  # params left host-side; rejit on next fit
+        return model
+
+
+class SharedTrainingMaster:
+    """Every-step compressed gradient sharing with error feedback."""
+
+    def __init__(self, threshold: float = 1e-3, mesh: Optional[TrainingMesh] = None,
+                 accumulator: Optional[EncodedGradientsAccumulator] = None):
+        self.mesh = mesh or TrainingMesh(data=len(jax.devices()))
+        self.accumulator = accumulator or EncodedGradientsAccumulator()
+        self.initial_threshold = threshold
+        self._step = None
+
+    def _build(self, model):
+        mesh = self.mesh.mesh
+        n_layers = len(model.layers)
+        updaters = model._updaters
+        acc = self.accumulator
+
+        def local_step(params, states, opts, residual, threshold, iteration,
+                       x, y, keys, w):
+            residual = _unstack_first(residual)
+            threshold = threshold[0]
+            key = keys[0]
+            lkeys = list(jax.random.split(key, n_layers))
+            (loss, new_states), grads = jax.value_and_grad(
+                model._loss, has_aux=True)(params, states, x, y, lkeys, w)
+            quant, new_res, new_thr, _ratio = acc.encode(
+                grads, residual, threshold, iteration)
+            shared = jax.tree_util.tree_map(
+                lambda q: lax.pmean(q, "data"), quant)
+            new_params, new_opts = [], []
+            for i in range(n_layers):
+                if not grads[i]:
+                    new_params.append(params[i])
+                    new_opts.append(opts[i])
+                    continue
+                p, s = upd.apply_updater(
+                    updaters[i], params[i], shared[i], opts[i], iteration)
+                new_params.append(p)
+                new_opts.append(s)
+            # non-trainable state (batchnorm stats) kept consistent by pmean
+            new_states = jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, "data") if jnp.issubdtype(
+                    jnp.asarray(v).dtype, jnp.floating) else v, new_states)
+            one = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
+            return (new_params, new_states, new_opts, one(new_res),
+                    new_thr[None], lax.pmean(loss, "data"))
+
+        stacked = P("data")
+        rep = P()
+        self._step = jax.jit(
+            jax.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(rep, rep, rep, stacked, stacked, rep, stacked,
+                          stacked, stacked, stacked),
+                out_specs=(rep, rep, rep, stacked, stacked, rep),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def fit(self, model, iterator, epochs: int = 1):
+        if self._step is None:
+            self._build(model)
+        n = self.mesh.data
+        mesh = self.mesh.mesh
+        shard = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        params = jax.device_put(model.params, rep)
+        states = jax.device_put(model.states, rep)
+        opts = jax.device_put(model.opt_states, rep)
+        residual = jax.device_put(
+            _stack_tree(self.accumulator.init_residual(model.params), n), shard)
+        threshold = jax.device_put(
+            jnp.full((n,), self.initial_threshold, jnp.float32), shard)
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x, y, w = self.mesh.pad_shard_batch(ds.features, ds.labels)
+                model._rng_key, sub = jax.random.split(model._rng_key)
+                keys = jax.device_put(jax.random.split(sub, n), shard)
+                params, states, opts, residual, threshold, loss = self._step(
+                    params, states, opts, residual, threshold,
+                    jnp.asarray(model.iteration), x, y, keys, w)
+                model.iteration += 1
+                model.score_value = float(loss)
+                for lst in model.listeners:
+                    lst.iteration_done(model, model.iteration, model.epoch)
+            model.epoch += 1
+        model.params, model.states, model.opt_states = params, states, opts
+        return model
+
+
+class SparkDl4jMultiLayer:
+    """User facade (SparkDl4jMultiLayer.java parity): wraps a network and a
+    TrainingMaster. The SparkContext argument is accepted and ignored —
+    there is no Spark; the mesh is the cluster."""
+
+    def __init__(self, sc, network, training_master):
+        self.network = network
+        self.training_master = training_master
+
+    def fit(self, iterator, epochs: int = 1):
+        return self.training_master.fit(self.network, iterator, epochs=epochs)
+
+
+SparkComputationGraph = SparkDl4jMultiLayer  # same facade over ComputationGraph

@@ -58,6 +58,21 @@ class TrainingMesh:
         )
         return out if len(out) > 1 else out[0]
 
+    def pad_shard_batch(self, x, y):
+        """Pad (x, y) to 'data'-axis divisibility and shard; returns
+        (x, y, weights) where padded rows carry loss weight 0 so a weighted
+        loss divides by the REAL example count — gradients stay exact for
+        ragged batches, not just divisible ones."""
+        x, y = np.asarray(x), np.asarray(y)
+        n = len(x)
+        pad = (self.data - n % self.data) % self.data
+        w = np.ones(n + pad, np.float32)
+        if pad:
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+            y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)], axis=0)
+            w[n:] = 0.0
+        return self.shard_batch(x, y, w)
+
     def replicate(self, tree, keep_existing: bool = True):
         """Place a pytree fully replicated. Leaves already carrying a
         NamedSharding on THIS mesh keep their placement (so tensor-parallel

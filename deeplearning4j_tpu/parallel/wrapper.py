@@ -89,18 +89,7 @@ class ParallelWrapper:
         return model
 
     def _shard(self, x, y):
-        """Pad to mesh divisibility; padded rows carry loss weight 0, so the
-        weighted loss divides by the REAL example count — gradients are exact
-        for ragged batches, not just divisible ones."""
-        n = len(x)
-        d = self.mesh.data
-        pad = (d - n % d) % d
-        w = np.ones(n + pad, dtype=np.float32)
-        if pad:
-            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
-            y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)], axis=0)
-            w[n:] = 0.0
-        return self.mesh.shard_batch(np.asarray(x), np.asarray(y), w)
+        return self.mesh.pad_shard_batch(x, y)
 
     def average_model(self):
         """No-op for API parity: params are kept consistent every step by the

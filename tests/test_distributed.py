@@ -1,0 +1,153 @@
+"""Distributed training: compression ops, accumulator, training masters.
+
+Reference test parity: the Spark-master tests run on local[N] in-process and
+parameter-server tests on embedded loopback transport (SURVEY.md §4,
+"distributed without a cluster") — here the 8-virtual-device CPU mesh plays
+that role.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import compression as C
+from deeplearning4j_tpu.parallel import (
+    AdaptiveThresholdAlgorithm,
+    EncodedGradientsAccumulator,
+    FixedThresholdAlgorithm,
+    ParameterAveragingTrainingMaster,
+    ResidualClippingPostProcessor,
+    SharedTrainingMaster,
+    SparkDl4jMultiLayer,
+    TrainingMesh,
+    distributed,
+)
+
+
+class TestCompressionOps:
+    def test_threshold_roundtrip_with_residual(self, rng):
+        g = jnp.asarray(rng.normal(size=(64,)) * 0.01, jnp.float32)
+        q, r = C.threshold_encode(g, 1e-2)
+        np.testing.assert_allclose(q + r, g, atol=1e-7)
+        assert set(np.unique(np.abs(np.asarray(q)))) <= {0.0, np.float32(1e-2)}
+
+    def test_bitmap_roundtrip(self, rng):
+        g = jnp.asarray(rng.normal(size=(50,)) * 0.01, jnp.float32)
+        packed, residual = C.bitmap_encode(g, 1e-2)
+        dec = C.bitmap_decode(packed, 1e-2, (50,))
+        np.testing.assert_allclose(dec + residual, g, atol=1e-7)
+
+    def test_sparse_pack_unpack(self, rng):
+        g = jnp.asarray(rng.normal(size=(40,)) * 0.01, jnp.float32)
+        q, _ = C.threshold_encode(g, 1e-2)
+        msg = C.sparse_pack(np.asarray(q), 1e-2)
+        back = C.sparse_unpack(msg, 1e-2, (40,))
+        np.testing.assert_allclose(back, q, atol=1e-7)
+        assert msg.size == int((np.asarray(q) != 0).sum())
+
+
+class TestAccumulator:
+    def test_error_feedback_preserves_signal(self, rng):
+        acc = EncodedGradientsAccumulator(
+            threshold_algorithm=FixedThresholdAlgorithm(1e-2),
+            residual_post_processor=None)
+        g = {"w": jnp.asarray(rng.normal(size=(32,)) * 0.005, jnp.float32)}
+        residual = acc.init_residual(g)
+        t = acc.threshold_algorithm.init_state()
+        total = jnp.zeros((32,))
+        for it in range(50):
+            quant, residual, t, _ = acc.encode(g, residual, t, it)
+            total = total + quant["w"]
+        # over many steps the transmitted sum approaches the true sum (error
+        # feedback: nothing is lost, only delayed)
+        np.testing.assert_allclose(total / 50, g["w"], atol=1.2e-2)
+
+    def test_adaptive_threshold_moves_toward_target(self):
+        algo = AdaptiveThresholdAlgorithm(initial=1e-3, target_ratio=0.1)
+        t = algo.init_state()
+        t_dense = algo.update(t, jnp.asarray(0.9))   # too dense → raise t
+        t_sparse = algo.update(t, jnp.asarray(0.001))  # too sparse → lower t
+        assert float(t_dense) > float(t) > float(t_sparse)
+
+    def test_residual_clipping(self):
+        pp = ResidualClippingPostProcessor(max_multiplier=2.0, frequency=1)
+        r = {"w": jnp.asarray([10.0, -10.0, 0.5])}
+        out = pp.apply(r, jnp.asarray(1.0), jnp.asarray(0))
+        np.testing.assert_allclose(out["w"], [2.0, -2.0, 0.5])
+
+
+def _classifier_and_data(rng, n=256):
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (
+        InputType,
+        MultiLayerNetwork,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Adam(0.01))
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    centers = rng.standard_normal((3, 4)) * 3.0
+    ys = rng.integers(0, 3, n)
+    xs = (centers[ys] + rng.standard_normal((n, 4))).astype(np.float32)
+    yoh = np.eye(3, dtype=np.float32)[ys]
+    return net, ArrayDataSetIterator(xs, yoh, batch=64), xs, yoh
+
+
+@pytest.mark.multichip
+class TestTrainingMasters:
+    def test_parameter_averaging_learns(self, rng):
+        net, it, xs, ys = _classifier_and_data(rng)
+        master = ParameterAveragingTrainingMaster(
+            averaging_frequency=2, mesh=TrainingMesh(data=8))
+        s0 = net.score(x=xs, y=ys)
+        SparkDl4jMultiLayer(None, net, master).fit(it, epochs=12)
+        assert net.score(x=xs, y=ys) < s0 * 0.5
+        acc = (np.argmax(net.output(xs), 1) == np.argmax(ys, 1)).mean()
+        assert acc > 0.85, acc
+
+    def test_shared_training_learns(self, rng):
+        net, it, xs, ys = _classifier_and_data(rng)
+        master = SharedTrainingMaster(threshold=1e-3, mesh=TrainingMesh(data=8))
+        s0 = net.score(x=xs, y=ys)
+        SparkDl4jMultiLayer(None, net, master).fit(it, epochs=12)
+        assert net.score(x=xs, y=ys) < s0 * 0.5
+        acc = (np.argmax(net.output(xs), 1) == np.argmax(ys, 1)).mean()
+        assert acc > 0.85, acc
+
+    def test_shared_training_matches_dense_direction(self, rng):
+        # with a huge threshold nothing transmits on step 1 → params unchanged
+        net, it, xs, ys = _classifier_and_data(rng)
+        master = SharedTrainingMaster(
+            threshold=1e3, mesh=TrainingMesh(data=8),
+            accumulator=EncodedGradientsAccumulator(
+                threshold_algorithm=FixedThresholdAlgorithm(1e3),
+                residual_post_processor=None))
+        p0 = np.asarray(net.params[0]["W"]).copy()
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        one = ArrayDataSetIterator(xs[:64], ys[:64], batch=64)
+        master.fit(net, one, epochs=1)
+        np.testing.assert_allclose(np.asarray(net.params[0]["W"]), p0, atol=1e-7)
+
+
+class TestDistributedBootstrap:
+    def test_single_process_noop(self):
+        distributed.initialize()  # no coordinator, single process: no-op
+        assert distributed.process_count() == 1
+        assert distributed.is_coordinator()
+
+    def test_global_mesh_shapes(self):
+        m = distributed.global_mesh(model=2)
+        assert m.model == 2
+        assert m.n_devices == len(jax.devices())

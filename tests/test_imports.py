@@ -264,3 +264,32 @@ class TestOnnxImport:
         out = np.asarray(sd.output({"x": x}, ["c"])["c"])
         ref = np.transpose(x, (0, 2, 1)).mean(2)
         np.testing.assert_allclose(out, np.concatenate([ref, ref], 1), atol=1e-6)
+
+    def test_flatten_dynamic_batch(self, rng):
+        w1 = rng.normal(size=(6, 3)).astype(np.float32) * 0.3
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("Flatten", ["x"], ["f"]),
+                _onnx_node("Gemm", ["f", "w1"], ["y"]),
+            ],
+            initializers=[_onnx_tensor("w1", w1)],
+            inputs=[_onnx_input("x", (-1, 2, 3))],  # dynamic batch dim
+            outputs=["y"],
+        )
+        sd = import_onnx(model)
+        x = rng.normal(size=(5, 2, 3)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        np.testing.assert_allclose(out, x.reshape(5, 6) @ w1, atol=1e-5)
+
+    def test_clip_omitted_optional_input(self, rng):
+        hi = np.asarray(0.5, np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("Clip", ["x", "", "hi"], ["y"])],
+            initializers=[_onnx_tensor("hi", hi.reshape(()))],
+            inputs=[_onnx_input("x", (4,))],
+            outputs=["y"],
+        )
+        sd = import_onnx(model)
+        x = np.asarray([-2.0, 0.1, 0.4, 3.0], np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        np.testing.assert_allclose(out, np.minimum(x, 0.5), atol=1e-6)
