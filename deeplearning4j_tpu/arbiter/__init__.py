@@ -1,0 +1,202 @@
+"""Arbiter: hyperparameter optimization.
+
+Reference parity: the ``arbiter/`` module (SURVEY.md §2.2 J21) —
+ParameterSpace implementations (ContinuousParameterSpace,
+IntegerParameterSpace, DiscreteParameterSpace), candidate generators
+(RandomSearchGenerator, GridSearchCandidateGenerator), and the
+OptimizationRunner with score functions + termination conditions —
+path-cite, mount empty this round.
+
+API:
+
+    space = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log_scale=True),
+             "hidden": IntegerParameterSpace(8, 64),
+             "act": DiscreteParameterSpace("relu", "tanh")}
+    runner = OptimizationRunner(
+        space, RandomSearchGenerator(16, seed=0),
+        model_builder=lambda cfg: build_net(cfg),
+        score_fn=lambda net: net.score(x=xv, y=yv),
+        minimize=True)
+    result = runner.execute()
+    result.best_candidate, result.best_score, result.results
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ParameterSpace:
+    def sample(self, rng) -> Any:
+        raise NotImplementedError
+
+    def grid(self, n: int) -> List[Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class ContinuousParameterSpace(ParameterSpace):
+    low: float
+    high: float
+    log_scale: bool = False
+
+    def sample(self, rng):
+        if self.log_scale:
+            return float(np.exp(rng.uniform(np.log(self.low), np.log(self.high))))
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self, n):
+        if self.log_scale:
+            return list(np.exp(np.linspace(np.log(self.low), np.log(self.high), n)))
+        return list(np.linspace(self.low, self.high, n))
+
+
+@dataclasses.dataclass
+class IntegerParameterSpace(ParameterSpace):
+    low: int
+    high: int  # inclusive
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid(self, n):
+        return sorted({int(round(v)) for v in
+                       np.linspace(self.low, self.high, min(n, self.high - self.low + 1))})
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, *values):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self, n):
+        return list(self.values)
+
+
+@dataclasses.dataclass
+class FixedValue(ParameterSpace):
+    value: Any
+
+    def sample(self, rng):
+        return self.value
+
+    def grid(self, n):
+        return [self.value]
+
+
+class RandomSearchGenerator:
+    """RandomSearchGenerator parity: n i.i.d. samples from the space."""
+
+    def __init__(self, num_candidates: int, seed: int = 0):
+        self.num_candidates = num_candidates
+        self.seed = seed
+
+    def candidates(self, space: Dict[str, ParameterSpace]):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.num_candidates):
+            yield {k: s.sample(rng) for k, s in space.items()}
+
+
+class GridSearchCandidateGenerator:
+    """GridSearchCandidateGenerator parity: cartesian product of per-space
+    discretizations (``discretization_count`` points for continuous)."""
+
+    def __init__(self, discretization_count: int = 5):
+        self.discretization_count = discretization_count
+
+    def candidates(self, space: Dict[str, ParameterSpace]):
+        keys = list(space)
+        axes = [space[k].grid(self.discretization_count) for k in keys]
+        for combo in itertools.product(*axes):
+            yield dict(zip(keys, combo))
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    candidate: Dict[str, Any]
+    score: float
+    duration_s: float
+    index: int
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OptimizationResult:
+    best_candidate: Optional[Dict[str, Any]]
+    best_score: float
+    best_model: Any
+    results: List[CandidateResult]
+
+
+class MaxCandidatesCondition:
+    def __init__(self, n):
+        self.n = n
+
+    def done(self, n_done, elapsed):
+        return n_done >= self.n
+
+
+class MaxTimeCondition:
+    def __init__(self, seconds):
+        self.seconds = seconds
+
+    def done(self, n_done, elapsed):
+        return elapsed >= self.seconds
+
+
+class OptimizationRunner:
+    """LocalOptimizationRunner parity: evaluate candidates sequentially (the
+    reference parallelizes over executors; on one host the accelerator is the
+    bottleneck and sequential keeps it saturated)."""
+
+    def __init__(self, space: Dict[str, ParameterSpace], generator,
+                 model_builder: Callable[[Dict[str, Any]], Any],
+                 score_fn: Callable[[Any], float], minimize: bool = True,
+                 termination_conditions: Sequence = ()):
+        self.space = space
+        self.generator = generator
+        self.model_builder = model_builder
+        self.score_fn = score_fn
+        self.minimize = minimize
+        self.termination_conditions = list(termination_conditions)
+
+    def execute(self) -> OptimizationResult:
+        results: List[CandidateResult] = []
+        best: Optional[CandidateResult] = None
+        best_model = None
+        t_start = time.monotonic()
+        for i, cand in enumerate(self.generator.candidates(self.space)):
+            elapsed = time.monotonic() - t_start
+            if any(c.done(len(results), elapsed) for c in self.termination_conditions):
+                break
+            t0 = time.monotonic()
+            try:
+                model = self.model_builder(cand)
+                score = float(self.score_fn(model))
+                cr = CandidateResult(cand, score, time.monotonic() - t0, i)
+            except Exception as e:  # failed candidates recorded, not fatal
+                cr = CandidateResult(cand, math.nan, time.monotonic() - t0, i,
+                                     error=repr(e))
+                model = None
+            results.append(cr)
+            if not math.isnan(cr.score) and (
+                best is None
+                or (self.minimize and cr.score < best.score)
+                or (not self.minimize and cr.score > best.score)
+            ):
+                best = cr
+                best_model = model
+        return OptimizationResult(
+            best_candidate=best.candidate if best else None,
+            best_score=best.score if best else math.nan,
+            best_model=best_model,
+            results=results,
+        )

@@ -1,0 +1,189 @@
+"""Object detection: YOLOv2 output layer + detection decoding.
+
+Reference parity: org/deeplearning4j/nn/conf/layers/objdetect/
+Yolo2OutputLayer.java (+ impl org/deeplearning4j/nn/layers/objdetect/
+Yolo2OutputLayer.java, YoloUtils.java, DetectedObject.java) — path-cite,
+mount empty this round.
+
+Label format matches the reference: labels (B, 4+C, Sy, Sx)... transposed to
+our NHWC world as (B, Sy, Sx, 4+C): channels [x1, y1, x2, y2] in GRID units
+plus one-hot class, zero rows where no object. Network output is
+(B, Sy, Sx, A*(5+C)) from a 1x1 conv head.
+
+The loss is YOLOv2's: sigmoid(tx,ty) center offsets + exp(tw,th)*anchor
+sizes, squared-error on position/size for the responsible anchor (best IOU),
+confidence targets = IOU for responsible anchors and 0 (weighted by
+lambda_noobj) elsewhere, softmax cross-entropy on classes. The whole loss is
+one jittable function — the reference computes per-cell on the JVM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers import Layer, register_layer
+
+
+def _iou_wh(wh1, wh2):
+    """IOU of boxes sharing a center: intersection of widths/heights."""
+    inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * jnp.minimum(wh1[..., 1], wh2[..., 1])
+    union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Yolo2OutputLayer(Layer):
+    """conf/layers/objdetect/Yolo2OutputLayer.java parity (loss-only layer)."""
+
+    anchors: Tuple[Tuple[float, float], ...] = ()  # (A, 2) in grid units
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def has_params(self):
+        return False
+
+    @property
+    def n_anchors(self):
+        return len(self.anchors)
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        return x, state  # predictions pass through; loss via compute_loss
+
+    def _split(self, x, n_classes):
+        b, sy, sx, _ = x.shape
+        a = self.n_anchors
+        x = x.reshape(b, sy, sx, a, 5 + n_classes)
+        txy = x[..., 0:2]
+        twh = x[..., 2:4]
+        tc = x[..., 4]
+        tcls = x[..., 5:]
+        return txy, twh, tc, tcls
+
+    def compute_loss(self, params, state, x, labels, *, training=True,
+                     key=None, weights=None, mask=None):
+        """labels (B, Sy, Sx, 4+C): [x1,y1,x2,y2] grid units + one-hot class;
+        all-zero class vector = no object in cell."""
+        labels = jnp.asarray(labels, jnp.float32)
+        b, sy, sx, _ = x.shape
+        n_classes = labels.shape[-1] - 4
+        anchors = jnp.asarray(self.anchors, jnp.float32)  # (A,2)
+        txy, twh, tc, tcls = self._split(x.astype(jnp.float32), n_classes)
+
+        # predicted boxes in grid units
+        pred_xy = jax.nn.sigmoid(txy)                       # offset in cell
+        pred_wh = jnp.exp(twh) * anchors[None, None, None]  # (B,Sy,Sx,A,2)
+        pred_conf = jax.nn.sigmoid(tc)
+
+        # ground truth per cell
+        gt_x1, gt_y1 = labels[..., 0], labels[..., 1]
+        gt_x2, gt_y2 = labels[..., 2], labels[..., 3]
+        gt_wh = jnp.stack([gt_x2 - gt_x1, gt_y2 - gt_y1], -1)   # (B,Sy,Sx,2)
+        gt_cxy = jnp.stack([(gt_x1 + gt_x2) / 2, (gt_y1 + gt_y2) / 2], -1)
+        cell_xy = gt_cxy - jnp.floor(gt_cxy)                    # offset in cell
+        obj = (jnp.sum(labels[..., 4:], -1) > 0).astype(jnp.float32)  # (B,Sy,Sx)
+
+        # responsible anchor: best IOU with gt by shape
+        ious_a = _iou_wh(gt_wh[..., None, :], anchors[None, None, None])  # (B,Sy,Sx,A)
+        resp = jax.nn.one_hot(jnp.argmax(ious_a, -1), self.n_anchors)     # (B,Sy,Sx,A)
+        resp = resp * obj[..., None]
+
+        # position/size loss (sqrt on wh as in the paper/reference)
+        pos = jnp.sum(resp[..., None] * (pred_xy - cell_xy[..., None, :]) ** 2,
+                      axis=(-2, -1))
+        siz = jnp.sum(resp[..., None] * (jnp.sqrt(jnp.maximum(pred_wh, 1e-9))
+                                         - jnp.sqrt(jnp.maximum(gt_wh[..., None, :], 1e-9))) ** 2,
+                      axis=(-2, -1))
+
+        # confidence: target IOU(pred, gt) for responsible anchors, 0 others
+        # (IOU is a LABEL — stop_gradient, else box sizes inflate to chase it)
+        iou_pg = jax.lax.stop_gradient(_iou_wh(pred_wh, gt_wh[..., None, :]))
+        conf_obj = jnp.sum(resp * (pred_conf - iou_pg) ** 2, -1)
+        conf_noobj = jnp.sum((1.0 - resp) * pred_conf ** 2, -1)
+
+        # class loss: softmax xent on responsible anchors
+        logp = jax.nn.log_softmax(tcls, axis=-1)
+        cls = -jnp.sum(resp[..., None] * labels[..., None, 4:] * logp,
+                       axis=(-2, -1))
+
+        per_cell = (self.lambda_coord * (pos + siz)
+                    + conf_obj + self.lambda_noobj * conf_noobj + cls * obj)
+        per_ex = jnp.sum(per_cell, axis=(1, 2))
+        if weights is not None:
+            return jnp.sum(per_ex * weights) / jnp.maximum(jnp.sum(weights), 1e-9)
+        return jnp.mean(per_ex)
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    """org/deeplearning4j/nn/layers/objdetect/DetectedObject.java parity."""
+
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    predicted_class: int
+    confidence: float
+
+    def top_left(self):
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    def bottom_right(self):
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, network_output,
+                          threshold: float = 0.5,
+                          nms_threshold: float = 0.4) -> List[List[DetectedObject]]:
+    """YoloUtils.getPredictedObjects + NMS parity (host-side decode)."""
+    out = np.asarray(network_output, np.float32)
+    b, sy, sx, _ = out.shape
+    a = layer.n_anchors
+    n_classes = out.shape[-1] // a - 5
+    out = out.reshape(b, sy, sx, a, 5 + n_classes)
+    anchors = np.asarray(layer.anchors, np.float32)
+    results: List[List[DetectedObject]] = []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for bi in range(b):
+        objs: List[DetectedObject] = []
+        conf = sig(out[bi, ..., 4])
+        for yi, xi, ai in zip(*np.nonzero(conf > threshold)):
+            o = out[bi, yi, xi, ai]
+            cx = xi + sig(o[0])
+            cy = yi + sig(o[1])
+            w = float(np.exp(o[2]) * anchors[ai, 0])
+            h = float(np.exp(o[3]) * anchors[ai, 1])
+            cls = int(np.argmax(o[5:]))
+            objs.append(DetectedObject(float(cx), float(cy), w, h, cls,
+                                       float(conf[yi, xi, ai])))
+        results.append(_nms(objs, nms_threshold))
+    return results
+
+
+def _nms(objs: List[DetectedObject], thr: float) -> List[DetectedObject]:
+    objs = sorted(objs, key=lambda o: -o.confidence)
+    kept: List[DetectedObject] = []
+    for o in objs:
+        if all(_iou_xy(o, k) < thr for k in kept):
+            kept.append(o)
+    return kept
+
+
+def _iou_xy(a: DetectedObject, b: DetectedObject) -> float:
+    ax1, ay1 = a.top_left()
+    ax2, ay2 = a.bottom_right()
+    bx1, by1 = b.top_left()
+    bx2, by2 = b.bottom_right()
+    ix = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    iy = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = ix * iy
+    union = a.width * a.height + b.width * b.height - inter
+    return inter / max(union, 1e-9)

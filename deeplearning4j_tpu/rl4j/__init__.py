@@ -1,0 +1,28 @@
+"""RL4J parity: deep reinforcement learning (DQN, advantage actor-critic).
+
+Reference parity: the ``rl4j/`` module (SURVEY.md §2.2 J21) —
+QLearningDiscreteDense (DQN with replay + target net + epsilon-greedy,
+rl4j-core org/deeplearning4j/rl4j/learning/sync/qlearning/discrete/),
+A3CDiscreteDense (async advantage actor-critic,
+learning/async/a3c/discrete/), the MDP interface (rl4j-api
+org/deeplearning4j/rl4j/mdp/MDP.java), and policies (policy/DQNPolicy,
+ACPolicy) — path-cite, mount empty this round.
+
+TPU-native notes: the A3C design (many async CPU actors racing a shared
+net) is a GPU-starving workaround; here the advantage-actor-critic trains
+synchronously (A2C — the de-facto modern equivalent) with one jitted
+update. DQN's Q-update is a single fused jit step; replay sampling stays
+host-side (numpy) like the reference's ExpReplay.
+"""
+
+from deeplearning4j_tpu.rl4j.mdp import MDP, CartPole, SimpleToyMDP  # noqa: F401
+from deeplearning4j_tpu.rl4j.dqn import (  # noqa: F401
+    DQNPolicy,
+    QLearningConfiguration,
+    QLearningDiscreteDense,
+)
+from deeplearning4j_tpu.rl4j.a2c import (  # noqa: F401
+    A2CConfiguration,
+    A2CDiscreteDense,
+    ACPolicy,
+)

@@ -1,0 +1,164 @@
+"""Advantage actor-critic: A3CDiscreteDense parity (synchronous form).
+
+Reference parity: rl4j-core
+org/deeplearning4j/rl4j/learning/async/a3c/discrete/A3CDiscreteDense.java
+(+ ActorCriticFactorySeparateStdDense, policy/ACPolicy) — path-cite, mount
+empty this round.
+
+The reference's asynchrony (many CPU threads mutating a shared net through
+stale gradients) exists to keep a GPU busy with tiny batches; on TPU the
+same algorithm runs synchronously over a batch of parallel environment
+rollouts (A2C) with ONE jitted update — same estimator, no races.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.rl4j.dqn import _JIT_MLP, _mlp_apply, _mlp_init
+from deeplearning4j_tpu.rl4j.mdp import MDP
+
+
+@dataclasses.dataclass
+class A2CConfiguration:
+    """A3C.AsyncConfiguration parity (sync form: num_envs replaces
+    num_threads)."""
+
+    seed: int = 0
+    gamma: float = 0.99
+    n_steps: int = 8              # rollout length (nstep parity)
+    num_envs: int = 8             # parallel rollouts (numThread parity)
+    max_updates: int = 500
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    hidden: tuple = (64,)
+
+
+class ACPolicy:
+    """policy/ACPolicy parity: sample (or argmax) from the actor head."""
+
+    def __init__(self, actor_params, deterministic: bool = True, seed: int = 0):
+        self.params = actor_params
+        self.deterministic = deterministic
+        self._apply = _JIT_MLP
+        self.rng = np.random.default_rng(seed)
+
+    def next_action(self, obs) -> int:
+        logits = np.asarray(self._apply(self.params, jnp.asarray(obs)[None])[0])
+        if self.deterministic:
+            return int(np.argmax(logits))
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return int(self.rng.choice(len(p), p=p))
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class A2CDiscreteDense:
+    def __init__(self, mdp_factory, conf: A2CConfiguration = None):
+        """``mdp_factory``: () -> MDP (one per parallel environment)."""
+        self.conf = conf or A2CConfiguration()
+        c = self.conf
+        self.envs: List[MDP] = [mdp_factory() for _ in range(c.num_envs)]
+        proto = self.envs[0]
+        key = jax.random.PRNGKey(c.seed)
+        ka, kc = jax.random.split(key)
+        self.actor = _mlp_init(ka, (proto.obs_size,) + c.hidden + (proto.n_actions,))
+        self.critic = _mlp_init(kc, (proto.obs_size,) + c.hidden + (1,))
+        self.updater = upd.Adam(c.learning_rate)
+        self.opt_state = self.updater.init_state(
+            {"actor": self.actor, "critic": self.critic})
+        self._update = self._build_update()
+        self.rng = np.random.default_rng(c.seed)
+        self._obs = [env.reset() for env in self.envs]
+        self.update_rewards: List[float] = []
+
+    def _build_update(self):
+        c = self.conf
+        updater = self.updater
+
+        @jax.jit
+        def update(params, opt_state, it, obs, actions, returns):
+            def loss_fn(params):
+                logits = _mlp_apply(params["actor"], obs)
+                values = _mlp_apply(params["critic"], obs)[:, 0]
+                logp = jax.nn.log_softmax(logits)
+                p = jax.nn.softmax(logits)
+                adv = returns - values
+                chosen = jnp.take_along_axis(
+                    logp, actions[:, None].astype(jnp.int32), 1)[:, 0]
+                policy_loss = -jnp.mean(chosen * jax.lax.stop_gradient(adv))
+                value_loss = jnp.mean(adv ** 2)
+                entropy = -jnp.mean(jnp.sum(p * logp, axis=-1))
+                return (policy_loss + c.value_coef * value_loss
+                        - c.entropy_coef * entropy)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = upd.apply_updater(
+                updater, params, grads, opt_state, it)
+            return new_params, new_opt, loss
+
+        return update
+
+    def _sample_actions(self, logits):
+        logits = np.asarray(logits)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.asarray(
+            [self.rng.choice(p.shape[-1], p=row) for row in p], np.int32)
+
+    def train(self) -> "A2CDiscreteDense":
+        c = self.conf
+        apply_actor = apply_critic = _JIT_MLP
+        params = {"actor": self.actor, "critic": self.critic}
+        for upd_i in range(c.max_updates):
+            obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+            for _ in range(c.n_steps):
+                obs = np.asarray(self._obs, np.float32)
+                actions = self._sample_actions(apply_actor(params["actor"], obs))
+                rewards = np.zeros(c.num_envs, np.float32)
+                dones = np.zeros(c.num_envs, np.float32)
+                for i, env in enumerate(self.envs):
+                    nxt, r, done = env.step(int(actions[i]))
+                    rewards[i] = r
+                    dones[i] = float(done)
+                    self._obs[i] = env.reset() if done else nxt
+                obs_buf.append(obs)
+                act_buf.append(actions)
+                rew_buf.append(rewards)
+                done_buf.append(dones)
+            # bootstrapped n-step returns
+            last_v = np.asarray(
+                apply_critic(params["critic"],
+                             np.asarray(self._obs, np.float32)))[:, 0]
+            returns = np.zeros((c.n_steps, c.num_envs), np.float32)
+            running = last_v
+            for t in reversed(range(c.n_steps)):
+                running = rew_buf[t] + c.gamma * (1.0 - done_buf[t]) * running
+                returns[t] = running
+            params, self.opt_state, _ = self._update(
+                params, self.opt_state, jnp.asarray(upd_i),
+                jnp.asarray(np.concatenate(obs_buf)),
+                jnp.asarray(np.concatenate(act_buf)),
+                jnp.asarray(returns.reshape(-1)))
+            self.update_rewards.append(float(np.mean(np.concatenate(rew_buf))))
+        self.actor, self.critic = params["actor"], params["critic"]
+        return self
+
+    def get_policy(self) -> ACPolicy:
+        return ACPolicy(self.actor)

@@ -1,0 +1,203 @@
+"""DQN: QLearningDiscreteDense parity.
+
+Reference parity: rl4j-core
+org/deeplearning4j/rl4j/learning/sync/qlearning/discrete/QLearningDiscreteDense.java
+(+ QLearning.QLConfiguration, ExpReplay, policy/DQNPolicy,
+network/dqn/DQNFactoryStdDense) — path-cite, mount empty this round.
+
+TPU-native: the Q-update (gather Q(s,a), TD target with the target network,
+Huber/MSE loss, Adam) is ONE jitted function over the replay minibatch; the
+replay buffer and epsilon-greedy rollouts stay host-side like the
+reference's sync learner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.nn import weights as winit
+from deeplearning4j_tpu.rl4j.mdp import MDP
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """QLearning.QLConfiguration parity."""
+
+    seed: int = 0
+    max_epoch_step: int = 500
+    max_step: int = 10000
+    exp_replay_size: int = 10000
+    batch_size: int = 64
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0          # Huber delta
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000       # linear anneal steps
+    learning_rate: float = 1e-3
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append({"W": winit.init(sub, "xavier", (a, b)),
+                       "b": jnp.zeros((b,))})
+    return params
+
+
+def _mlp_apply(params, x):
+    h = x
+    for i, p in enumerate(params):
+        h = h @ p["W"] + p["b"]
+        if i < len(params) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+# one shared trace cache for every policy/learner instance
+_JIT_MLP = jax.jit(_mlp_apply)
+
+
+class ReplayBuffer:
+    """ExpReplay parity (host-side ring buffer)."""
+
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.size = 0
+        self.pos = 0
+        self.rng = np.random.default_rng(seed)
+
+    def store(self, s, a, r, s2, done):
+        i = self.pos
+        self.obs[i], self.actions[i], self.rewards[i] = s, a, r
+        self.next_obs[i], self.dones[i] = s2, float(done)
+        self.pos = (self.pos + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch):
+        idx = self.rng.integers(0, self.size, batch)
+        return (self.obs[idx], self.actions[idx], self.rewards[idx],
+                self.next_obs[idx], self.dones[idx])
+
+
+class DQNPolicy:
+    """policy/DQNPolicy parity: greedy play with the learned Q-net."""
+
+    def __init__(self, params, apply_fn=None):
+        self.params = params
+        self._apply = jax.jit(apply_fn) if apply_fn is not None else _JIT_MLP
+
+    def next_action(self, obs) -> int:
+        q = self._apply(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(q[0]))
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class QLearningDiscreteDense:
+    """QLearningDiscreteDense parity: train a dense Q-network on an MDP."""
+
+    def __init__(self, mdp: MDP, conf: QLearningConfiguration = None):
+        self.mdp = mdp
+        self.conf = conf or QLearningConfiguration()
+        c = self.conf
+        sizes = (mdp.obs_size,) + tuple(c.hidden) + (mdp.n_actions,)
+        key = jax.random.PRNGKey(c.seed)
+        self.params = _mlp_init(key, sizes)
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.updater = upd.Adam(c.learning_rate)
+        self.opt_state = self.updater.init_state(self.params)
+        self.replay = ReplayBuffer(c.exp_replay_size, mdp.obs_size, c.seed)
+        self.step_count = 0
+        self.epoch_rewards: List[float] = []
+        self._train = self._build_train()
+        self._q = _JIT_MLP
+        self.rng = np.random.default_rng(c.seed)
+
+    def _build_train(self):
+        c = self.conf
+        updater = self.updater
+
+        @jax.jit
+        def train(params, target_params, opt_state, it, s, a, r, s2, done):
+            q_next = jnp.max(_mlp_apply(target_params, s2), axis=-1)
+            target = r * c.reward_factor + c.gamma * (1.0 - done) * q_next
+
+            def loss_fn(params):
+                q = _mlp_apply(params, s)
+                q_sa = jnp.take_along_axis(q, a[:, None].astype(jnp.int32), 1)[:, 0]
+                err = q_sa - target
+                # Huber (error_clamp parity)
+                d = c.error_clamp
+                l = jnp.where(jnp.abs(err) <= d, 0.5 * err ** 2,
+                              d * (jnp.abs(err) - 0.5 * d))
+                return jnp.mean(l)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = upd.apply_updater(
+                updater, params, grads, opt_state, it)
+            return new_params, new_opt, loss
+
+        return train
+
+    def epsilon(self) -> float:
+        c = self.conf
+        frac = min(1.0, self.step_count / c.epsilon_nb_step)
+        return 1.0 + frac * (c.min_epsilon - 1.0)
+
+    def train(self) -> "QLearningDiscreteDense":
+        """Run until max_step environment steps (learning() parity)."""
+        c = self.conf
+        while self.step_count < c.max_step:
+            obs = self.mdp.reset()
+            ep_reward = 0.0
+            for _ in range(c.max_epoch_step):
+                if self.rng.random() < self.epsilon():
+                    action = int(self.rng.integers(0, self.mdp.n_actions))
+                else:
+                    action = int(jnp.argmax(
+                        self._q(self.params, jnp.asarray(obs)[None])[0]))
+                nxt, r, done = self.mdp.step(action)
+                self.replay.store(obs, action, r, nxt, done)
+                obs = nxt
+                ep_reward += r
+                self.step_count += 1
+                if self.replay.size >= max(c.update_start, c.batch_size):
+                    s, a, rr, s2, dn = self.replay.sample(c.batch_size)
+                    self.params, self.opt_state, _ = self._train(
+                        self.params, self.target_params, self.opt_state,
+                        jnp.asarray(self.step_count), jnp.asarray(s),
+                        jnp.asarray(a), jnp.asarray(rr), jnp.asarray(s2),
+                        jnp.asarray(dn))
+                if self.step_count % c.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+                if done or self.step_count >= c.max_step:
+                    break
+            self.epoch_rewards.append(ep_reward)
+        return self
+
+    def get_policy(self) -> DQNPolicy:
+        return DQNPolicy(self.params)

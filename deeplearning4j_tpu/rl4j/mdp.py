@@ -1,0 +1,119 @@
+"""MDP interface + built-in toy environments.
+
+Reference parity: rl4j-api org/deeplearning4j/rl4j/mdp/MDP.java and the
+bundled toy MDPs (rl4j-core mdp/toy/SimpleToy.java; CartPole lives in
+rl4j-gym in the reference — implemented natively here since there is no gym
+dependency) — path-cite, mount empty this round.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class MDP:
+    """MDP.java parity: reset/step/action-space/observation-space."""
+
+    obs_size: int
+    n_actions: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        """→ (observation, reward, done)."""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+
+class SimpleToyMDP(MDP):
+    """mdp/toy/SimpleToy.java parity: a chain MDP of ``length`` states;
+    action 1 advances (+1 reward at the end), action 0 ends the episode."""
+
+    obs_size = 2
+    n_actions = 2
+
+    def __init__(self, length: int = 10):
+        self.length = length
+        self.pos = 0
+        self.done = False
+
+    def _obs(self):
+        return np.asarray([self.pos / self.length, 1.0], np.float32)
+
+    def reset(self):
+        self.pos = 0
+        self.done = False
+        return self._obs()
+
+    def step(self, action):
+        if action == 1:
+            self.pos += 1
+            reward = 1.0 if self.pos >= self.length else 0.1
+            self.done = self.pos >= self.length
+        else:
+            reward = 0.0
+            self.done = True
+        return self._obs(), reward, self.done
+
+    def is_done(self):
+        return self.done
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (Barto–Sutton–Anderson dynamics, the same
+    physics as gym's CartPole-v1). Reward +1 per step; episode ends when the
+    pole falls past 12° or the cart leaves ±2.4, or after 500 steps."""
+
+    obs_size = 4
+    n_actions = 2
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * np.pi / 180
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros(4, np.float32)
+        self.steps = 0
+        self.done = False
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self.steps = 0
+        self.done = False
+        return self.state.copy()
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pm_len = self.POLE_MASS * self.POLE_HALF_LEN
+        cos, sin = np.cos(theta), np.sin(theta)
+        temp = (force + pm_len * theta_dot ** 2 * sin) / total_mass
+        theta_acc = (self.GRAVITY * sin - cos * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0 - self.POLE_MASS * cos ** 2 / total_mass))
+        x_acc = temp - pm_len * theta_acc * cos / total_mass
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        theta += self.DT * theta_dot
+        theta_dot += self.DT * theta_acc
+        self.state = np.asarray([x, x_dot, theta, theta_dot], np.float32)
+        self.steps += 1
+        self.done = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+            or self.steps >= self.MAX_STEPS)
+        return self.state.copy(), 1.0, self.done
+
+    def is_done(self):
+        return self.done

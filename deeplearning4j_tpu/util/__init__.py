@@ -2,5 +2,24 @@
 nd4j-common — SURVEY.md §2.2 J20)."""
 
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+from deeplearning4j_tpu.util.profiler import (
+    NaNPanicError,
+    OpProfiler,
+    ProfilerConfig,
+    StepTimer,
+    check_numerics,
+    device_trace,
+)
+from deeplearning4j_tpu.util.stats import (
+    CrashReportingUtil,
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsListener,
+    to_csv,
+)
 
-__all__ = ["ModelSerializer"]
+__all__ = [
+    "ModelSerializer", "OpProfiler", "ProfilerConfig", "StepTimer",
+    "NaNPanicError", "check_numerics", "device_trace", "CrashReportingUtil",
+    "FileStatsStorage", "InMemoryStatsStorage", "StatsListener", "to_csv",
+]

@@ -1,0 +1,194 @@
+"""Profiling + correctness guards: op profiler, Chrome trace, NaN panic.
+
+Reference parity (SURVEY.md §5.1–5.2):
+- OpProfiler / ProfilerConfig      org/nd4j/linalg/profiler/{OpProfiler,ProfilerConfig}.java
+  (per-op wall time + invocation counts, enabled on the executioner via
+  profilingConfigurableHookIn/Out)
+- ProfilingListener (Chrome trace) org/nd4j/autodiff/listeners/profiler/ProfilingListener.java
+- NaN/Inf panic                    OpExecutionerUtil.checkForAny via ProfilerConfig.nanPanic
+- PerformanceTracker (bandwidth)   org/nd4j/linalg/memory/PerformanceTracker-style counters
+
+TPU-native notes: under jit there is no per-op host boundary to hook — XLA
+fuses the graph — so per-op timing instruments the *eager/by-name* dispatch
+path (exec_op), exactly where the reference hooks DefaultOpExecutioner, and
+whole-step timing comes from the listeners. For kernel-level depth the JAX
+profiler (jax.profiler.trace → TensorBoard/XPlane) is exposed via
+``device_trace``; the Chrome-trace exporter writes the same
+chrome://tracing JSON the reference's ProfilingListener produces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    """ProfilerConfig.java parity."""
+
+    profile_ops: bool = True
+    check_for_nan: bool = False      # nanPanic
+    check_for_inf: bool = False
+    stack_trace: bool = False        # record call sites per op
+
+
+class OpProfiler:
+    """Singleton per-op timing/count profiler (OpProfiler.getInstance parity).
+
+    Wraps the registry's exec_op; use ``start()``/``stop()`` or the
+    ``profile()`` context manager. Times are host wall-clock including device
+    sync (the honest eager number)."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self, config: Optional[ProfilerConfig] = None):
+        self.config = config or ProfilerConfig()
+        self.reset()
+        self._orig_exec = None
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def reset(self):
+        self.invocations: Dict[str, int] = defaultdict(int)
+        self.total_ns: Dict[str, int] = defaultdict(int)
+        self.events: List[dict] = []  # chrome trace events
+        self._t0 = time.perf_counter_ns()
+
+    # -- hook ---------------------------------------------------------------
+    def start(self):
+        """Install the exec hook (profilingHookIn/Out parity)."""
+        from deeplearning4j_tpu.ops import registry
+
+        if self._orig_exec is not None:
+            return self
+        orig = registry.exec_op
+        cfg = self.config
+        prof = self
+
+        def wrapped(name, *args, **kwargs):
+            t0 = time.perf_counter_ns()
+            out = orig(name, *args, **kwargs)
+            out = jax.block_until_ready(out)
+            t1 = time.perf_counter_ns()
+            if cfg.profile_ops:
+                prof.invocations[name] += 1
+                prof.total_ns[name] += t1 - t0
+                prof.events.append({
+                    "name": name, "ph": "X", "pid": 0, "tid": 0,
+                    "ts": (t0 - prof._t0) / 1e3, "dur": (t1 - t0) / 1e3,
+                })
+            if cfg.check_for_nan or cfg.check_for_inf:
+                _panic_check(name, out, cfg)
+            return out
+
+        registry.exec_op = wrapped
+        self._orig_exec = orig
+        return self
+
+    def stop(self):
+        from deeplearning4j_tpu.ops import registry
+
+        if self._orig_exec is not None:
+            registry.exec_op = self._orig_exec
+            self._orig_exec = None
+        return self
+
+    @contextlib.contextmanager
+    def profile(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self) -> str:
+        """printOutDashboard parity: per-op totals sorted by time."""
+        rows = sorted(self.total_ns.items(), key=lambda kv: -kv[1])
+        lines = [f"{'op':<32}{'calls':>8}{'total ms':>12}{'mean us':>12}"]
+        for name, ns in rows:
+            n = self.invocations[name]
+            lines.append(
+                f"{name:<32}{n:>8}{ns / 1e6:>12.3f}{ns / 1e3 / max(n, 1):>12.1f}")
+        return "\n".join(lines)
+
+    def write_chrome_trace(self, path: str):
+        """ProfilingListener parity: chrome://tracing JSON."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+
+
+class NaNPanicError(FloatingPointError):
+    pass
+
+
+def _panic_check(name, out, cfg):
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if cfg.check_for_nan and np.isnan(arr).any():
+            raise NaNPanicError(f"NaN produced by op {name!r} (nanPanic)")
+        if cfg.check_for_inf and np.isinf(arr).any():
+            raise NaNPanicError(f"Inf produced by op {name!r} (infPanic)")
+
+
+def check_numerics(tree, where: str = ""):
+    """OpExecutionerUtil.checkForAny parity, usable on any pytree (params,
+    grads) from user code or listeners."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            key = jax.tree_util.keystr(path)
+            raise NaNPanicError(f"non-finite values at {where}{key}")
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Kernel-level device profile via the JAX profiler (TensorBoard/XPlane
+    format — the depth the reference never had)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Whole-train-step Chrome-trace recorder: use as a TrainingListener.
+    Produces one 'X' event per iteration (the reference ProfilingListener's
+    per-op rows collapse into one fused-step row under XLA — that is the
+    point of whole-graph compilation)."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self._t0 = time.perf_counter_ns()
+        self._last = None
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter_ns()
+        if self._last is not None:
+            self.events.append({
+                "name": f"train_step[{iteration}]", "ph": "X", "pid": 0,
+                "tid": 0, "ts": (self._last - self._t0) / 1e3,
+                "dur": (now - self._last) / 1e3,
+            })
+        self._last = now
+
+    def write_chrome_trace(self, path: str):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events, "displayTimeUnit": "ms"}, f)

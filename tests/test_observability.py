@@ -1,0 +1,138 @@
+"""Profiler, NaN panic, stats storage, crash dump.
+
+Reference test parity: OpProfiler/ProfilerConfig tests and StatsListener →
+StatsStorage round-trips (SURVEY.md §5.1/5.5)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import (
+    CrashReportingUtil,
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    NaNPanicError,
+    OpProfiler,
+    ProfilerConfig,
+    StatsListener,
+    StepTimer,
+    check_numerics,
+    to_csv,
+)
+
+
+class TestOpProfiler:
+    def test_records_op_timings(self):
+        from deeplearning4j_tpu.ops import registry
+
+        prof = OpProfiler(ProfilerConfig())
+        x = jnp.ones((8, 8))
+        with prof.profile():
+            registry.exec_op("add", x, x)
+            registry.exec_op("add", x, x)
+            registry.exec_op("matmul", x, x)
+        assert prof.invocations["add"] == 2
+        assert prof.invocations["matmul"] == 1
+        assert prof.total_ns["add"] > 0
+        assert "add" in prof.summary()
+
+    def test_hook_removed_after_stop(self):
+        from deeplearning4j_tpu.ops import registry
+
+        prof = OpProfiler(ProfilerConfig())
+        with prof.profile():
+            pass
+        before = len(prof.events)
+        registry.exec_op("add", jnp.ones(2), jnp.ones(2))
+        assert len(prof.events) == before
+
+    def test_chrome_trace_format(self, tmp_path):
+        from deeplearning4j_tpu.ops import registry
+
+        prof = OpProfiler(ProfilerConfig())
+        with prof.profile():
+            registry.exec_op("sum", jnp.ones((4,)))
+        p = tmp_path / "trace.json"
+        prof.write_chrome_trace(str(p))
+        data = json.loads(p.read_text())
+        assert data["traceEvents"][0]["ph"] == "X"
+        assert data["traceEvents"][0]["name"] == "sum"
+
+    def test_nan_panic(self):
+        from deeplearning4j_tpu.ops import registry
+
+        prof = OpProfiler(ProfilerConfig(check_for_nan=True))
+        with prof.profile():
+            with pytest.raises(NaNPanicError, match="log"):
+                registry.exec_op("log", jnp.asarray([-1.0]))  # NaN
+
+    def test_check_numerics(self):
+        check_numerics({"w": jnp.ones(3)})
+        with pytest.raises(NaNPanicError, match="w"):
+            check_numerics({"w": jnp.asarray([1.0, np.nan])})
+
+
+class TestStats:
+    def _train(self, listener, rng):
+        from deeplearning4j_tpu.nn import (
+            InputType, MultiLayerNetwork, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.listeners.append(listener)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        for _ in range(5):
+            net._fit_batch(x, y)
+        return net
+
+    def test_stats_listener_memory(self, rng):
+        storage = InMemoryStatsStorage()
+        self._train(StatsListener(storage, frequency=1), rng)
+        assert len(storage.records) == 5
+        r = storage.records[-1]
+        assert "layer0.W" in r["params"]
+        assert {"mean", "std", "min", "max", "l2"} <= set(r["params"]["layer0.W"])
+        assert "updates" in r
+        assert len(storage.scores()) == 5
+
+    def test_file_storage_roundtrip_and_csv(self, rng, tmp_path):
+        p = tmp_path / "stats.jsonl"
+        storage = FileStatsStorage(str(p))
+        self._train(StatsListener(storage, frequency=2,
+                                  collect_histograms=False), rng)
+        reloaded = FileStatsStorage(str(p))
+        assert len(reloaded.records) == len(storage.records) > 0
+        csv = tmp_path / "curves.csv"
+        to_csv(reloaded, str(csv))
+        assert csv.read_text().startswith("session,iteration")
+
+    def test_step_timer_trace(self, rng, tmp_path):
+        timer = StepTimer()
+        self._train(timer, rng)
+        p = tmp_path / "steps.json"
+        timer.write_chrome_trace(str(p))
+        ev = json.loads(p.read_text())["traceEvents"]
+        assert len(ev) == 4  # N-1 intervals
+        assert all(e["dur"] > 0 for e in ev)
+
+    def test_crash_dump(self, rng, tmp_path):
+        net = self._train(StepTimer(), rng)
+        p = tmp_path / "crash.json"
+        try:
+            raise MemoryError("boom")
+        except MemoryError as e:
+            CrashReportingUtil.write_crash_dump(net, str(p), e)
+        info = json.loads(p.read_text())
+        assert info["exception"] == "MemoryError('boom')"
+        assert info["param_bytes"]["layer0.W"] > 0
+        assert info["config"] == ["DenseLayer", "OutputLayer"]

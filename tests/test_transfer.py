@@ -1,0 +1,127 @@
+"""Transfer learning: freeze, replace, featurize.
+
+Reference test parity: deeplearning4j-core TransferLearning* tests
+(SURVEY.md §4 DL4J integration row)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    FineTuneConfiguration,
+    FrozenLayer,
+    InputType,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _base_net(rng, n_classes=3):
+    conf = (
+        NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01)).list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(DenseLayer(n_in=16, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_in=8, n_out=n_classes, loss="mcxent",
+                           activation="softmax"))
+        .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    xs = rng.standard_normal((64, 4)).astype(np.float32)
+    ys = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, 64)]
+    net.fit(xs, ys, epochs=5)
+    return net, xs, ys
+
+
+def test_frozen_layers_do_not_move(rng):
+    net, xs, ys = _base_net(rng)
+    new = (TransferLearning.Builder(net)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.1)))
+           .set_feature_extractor(0)
+           .build())
+    assert isinstance(new.layers[0], FrozenLayer)
+    w0 = np.asarray(new.params[0]["W"]).copy()
+    w1 = np.asarray(new.params[1]["W"]).copy()
+    new.fit(xs, ys, epochs=3)
+    np.testing.assert_array_equal(np.asarray(new.params[0]["W"]), w0)
+    assert np.abs(np.asarray(new.params[1]["W"]) - w1).max() > 1e-6
+
+
+def test_nout_replace_new_head(rng):
+    net, xs, _ = _base_net(rng, n_classes=3)
+    new = (TransferLearning.Builder(net)
+           .set_feature_extractor(1)
+           .n_out_replace(2, 5)
+           .build())
+    out = new.output(xs)
+    assert out.shape == (64, 5)
+    # frozen features preserved from the base net
+    np.testing.assert_allclose(np.asarray(new.params[0]["W"]),
+                               np.asarray(net.params[0]["W"]))
+
+
+def test_remove_and_add_layers(rng):
+    net, xs, _ = _base_net(rng)
+    new = (TransferLearning.Builder(net)
+           .set_feature_extractor(0)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_in=8, n_out=7, loss="mcxent",
+                                  activation="softmax"))
+           .build())
+    assert new.output(xs).shape == (64, 7)
+    ys = np.eye(7, dtype=np.float32)[np.random.default_rng(0).integers(0, 7, 64)]
+    new.fit(xs, ys, epochs=3)  # trains without error
+
+
+def test_helper_featurize_matches_prefix(rng):
+    net, xs, ys = _base_net(rng)
+    frozen = (TransferLearning.Builder(net).set_feature_extractor(1).build())
+    helper = TransferLearningHelper(frozen, frozen_until=1)
+    feats = np.asarray(helper.featurize(xs))
+    assert feats.shape == (64, 8)
+    acts = net.feed_forward(xs)
+    np.testing.assert_allclose(feats, np.asarray(acts[2]), atol=1e-5)
+    tail = helper.unfrozen_graph()
+    out = tail.output(feats)
+    np.testing.assert_allclose(out, np.asarray(net.output(xs)), atol=1e-5)
+    # training the tail moves the shared (unfrozen) head params
+    w = np.asarray(tail.params[0]["W"]).copy()
+    tail.fit(feats, ys, epochs=2)
+    assert np.abs(np.asarray(tail.params[0]["W"]) - w).max() > 1e-7
+
+
+def test_tail_training_does_not_delete_source_buffers(rng):
+    net, xs, ys = _base_net(rng)
+    frozen = TransferLearning.Builder(net).set_feature_extractor(1).build()
+    helper = TransferLearningHelper(frozen, frozen_until=1)
+    feats = np.asarray(helper.featurize(xs))
+    tail = helper.unfrozen_graph()
+    tail.fit(feats, ys, epochs=2)
+    # the source network must remain fully usable (no donated-buffer deletion)
+    out = frozen.output(xs)
+    assert np.isfinite(np.asarray(out)).all()
+    # and copy_back writes the trained tail into the source
+    helper.copy_back()
+    np.testing.assert_allclose(np.asarray(frozen.params[2]["W"]),
+                               np.asarray(tail.params[0]["W"]))
+
+
+def test_nout_replace_reinits_shape_ripple_layers(rng):
+    # a width change ripples into BatchNormalization (no n_in field): stale
+    # (16,) stats must not be grafted over the fresh (10,) ones
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01)).list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(BatchNormalization())
+        .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                           activation="softmax"))
+        .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    xs = rng.standard_normal((8, 4)).astype(np.float32)
+    new = TransferLearning.Builder(net).n_out_replace(0, 10).build()
+    out = new.output(xs)  # must not crash on stale BN shapes
+    assert np.asarray(out).shape == (8, 3)
