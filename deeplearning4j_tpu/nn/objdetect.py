@@ -66,7 +66,10 @@ class Yolo2OutputLayer(Layer):
         return txy, twh, tc, tcls
 
     def compute_loss(self, params, state, x, labels, *, training=True,
-                     key=None, weights=None, mask=None):
+                     key=None, weights=None):
+        # no mask parameter on purpose: declaring one makes the network route
+        # (B,T) label masks here, which have no YOLO meaning — per-example
+        # exclusion goes through ``weights``
         """labels (B, Sy, Sx, 4+C): [x1,y1,x2,y2] grid units + one-hot class;
         all-zero class vector = no object in cell."""
         labels = jnp.asarray(labels, jnp.float32)

@@ -26,14 +26,20 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import functools
+import inspect
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import Layer, register_layer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@functools.lru_cache(maxsize=None)
+def _accepts_mask(layer_cls) -> bool:
+    return "mask" in inspect.signature(layer_cls.apply).parameters
 
 
 @register_layer
@@ -59,9 +65,7 @@ class FrozenLayer(Layer):
     def apply(self, params, state, x, *, training=False, key=None, mask=None):
         frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
         kw = {}
-        import inspect
-
-        if "mask" in inspect.signature(self.inner.apply).parameters:
+        if _accepts_mask(type(self.inner)):
             kw["mask"] = mask
         # frozen layers run in inference mode (batchnorm uses running stats,
         # no dropout) — FrozenLayer.java does exactly this
@@ -167,6 +171,14 @@ class TransferLearning:
                         layers[i] = FrozenLayer(inner=layers[i])
 
             ft = self._fine_tune or FineTuneConfiguration()
+            if ft.dropout is not None:
+                # global dropout override on trainable (unfrozen) layers
+                start = (self._freeze_until + 1
+                         if self._freeze_until is not None else 0)
+                for i in range(start, len(layers)):
+                    if not isinstance(layers[i], FrozenLayer):
+                        layers[i] = dataclasses.replace(layers[i],
+                                                        dropout=ft.dropout)
             conf = dataclasses.replace(
                 src.conf, layers=layers,
                 updater=ft.updater or src.conf.updater,
@@ -222,10 +234,7 @@ class TransferLearningHelper:
         ]
         conf = dataclasses.replace(self.net.conf, layers=tail_layers,
                                    input_shape=None)
-        tail = MultiLayerNetwork.__new__(MultiLayerNetwork)
-        tail.__init__(conf)
-        import functools
-
+        tail = MultiLayerNetwork(conf)
         tail.params = jax.tree_util.tree_map(
             jnp.array, self.net.params[self.frozen_until + 1:])
         tail.states = jax.tree_util.tree_map(
