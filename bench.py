@@ -7,10 +7,14 @@ metric 1: ResNet-50 train-step throughput, images/sec/chip, vs the north-star
 8,000 img/s/chip (BASELINE.json). Falls back to LeNet-5 MNIST throughput if
 the zoo model is unavailable.
 
-Methodology: synthetic data (no input-pipeline noise), one warmup step to
-trigger XLA compilation, then timed steady-state steps with device sync
-(block_until_ready) — measures the whole jitted train step: forward, reverse
-AD, updater, parameter write, on device.
+Methodology: synthetic data (no input-pipeline noise) staged on device ONCE;
+several warmup steps to ride out every XLA compile (committed-vs-uncommitted
+operand shardings cause up to three traces on the first calls); then timed
+steady-state steps, with completion forced by fetching the final scalar loss
+to the host (a device→host dependency — block_until_ready alone does not
+guarantee completion through the remote-chip tunnel). Measures the whole
+jitted train step: forward, reverse AD, updater, parameter write, on device.
+bfloat16 compute (fp32 params/accumulation) — the MXU-native policy.
 """
 
 from __future__ import annotations
@@ -24,11 +28,14 @@ import numpy as np
 NORTH_STAR_IMG_PER_SEC = 8000.0  # BASELINE.json north_star, TPU v5e per chip
 
 
-def _bench_net(net, x, y, steps: int, min_seconds: float = 3.0):
+def _bench_net(net, x, y, steps: int, min_seconds: float = 2.0):
     import jax
 
-    net._fit_batch(x, y)  # warmup: compile
-    jax.block_until_ready(net.params)
+    x = jax.device_put(x)
+    y = jax.device_put(y)
+    for _ in range(4):  # warm past every recompile (sharding commitment)
+        net._fit_batch(x, y)
+    float(net.score_value)  # force completion of the warmup chain
     t0 = time.perf_counter()
     done = 0
     while done < steps or (time.perf_counter() - t0) < min_seconds:
@@ -36,7 +43,7 @@ def _bench_net(net, x, y, steps: int, min_seconds: float = 3.0):
         done += 1
         if done >= steps * 10:
             break
-    jax.block_until_ready(net.params)
+    float(net.score_value)  # host fetch: waits for the full step chain
     dt = time.perf_counter() - t0
     return done * x.shape[0] / dt
 
@@ -44,7 +51,8 @@ def _bench_net(net, x, y, steps: int, min_seconds: float = 3.0):
 def bench_resnet50(batch: int, image: int, steps: int):
     from deeplearning4j_tpu.zoo import ResNet50
 
-    net = ResNet50(num_classes=1000, input_shape=(image, image, 3)).init()
+    net = ResNet50(num_classes=1000, input_shape=(image, image, 3),
+                   compute_dtype="bfloat16").init()
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, image, image, 3)).astype(np.float32)
     labels = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, size=batch)]

@@ -50,6 +50,7 @@ class MultiLayerConfiguration:
     updater: Any = None  # default updater (IUpdater)
     input_shape: Optional[Tuple[int, ...]] = None  # excl. batch
     compute_dtype: str = "float32"  # 'bfloat16' for MXU mixed precision
+    tbptt_length: int = 0  # >0: truncated-BPTT segment length (tBPTTLength)
 
     def to_json(self) -> str:
         return json.dumps(
@@ -58,6 +59,7 @@ class MultiLayerConfiguration:
                 "updater": self.updater.to_dict() if self.updater else None,
                 "input_shape": list(self.input_shape) if self.input_shape else None,
                 "compute_dtype": self.compute_dtype,
+                "tbptt_length": self.tbptt_length,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -82,6 +84,7 @@ class MultiLayerConfiguration:
             updater=upd.updater_from_dict(d["updater"]) if d["updater"] else None,
             input_shape=tuple(d["input_shape"]) if d["input_shape"] else None,
             compute_dtype=d.get("compute_dtype", "float32"),
+            tbptt_length=d.get("tbptt_length", 0),
         )
 
 
@@ -107,6 +110,7 @@ class Builder:
         self._weight_init: Optional[str] = None
         self._activation: Optional[str] = None
         self._compute_dtype = "float32"
+        self._tbptt_length = 0
 
     def seed(self, s: int) -> "Builder":
         self._seed = s
@@ -134,6 +138,13 @@ class Builder:
 
     def compute_dtype(self, dt: str) -> "Builder":
         self._compute_dtype = dt
+        return self
+
+    def tbptt_length(self, k: int) -> "Builder":
+        """Truncated BPTT (backpropType(TruncatedBPTT) + tBPTTLength parity):
+        fit() splits the time axis into length-k segments, carrying recurrent
+        state forward with gradients stopped at segment boundaries."""
+        self._tbptt_length = k
         return self
 
     def list(self) -> "ListBuilder":
@@ -190,4 +201,5 @@ class ListBuilder:
             updater=self._p._updater,
             input_shape=self._input_shape,
             compute_dtype=self._p._compute_dtype,
+            tbptt_length=self._p._tbptt_length,
         )

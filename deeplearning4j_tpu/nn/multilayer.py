@@ -118,27 +118,39 @@ class MultiLayerNetwork:
                 mask = None  # time axis consumed (LastTimeStep/GlobalPooling)
         return h, new_states
 
-    def _loss(self, params, states, x, y, keys, weights=None, mask=None,
-              label_mask=None):
-        """Forward through all but the output layer, then fused loss.
-        ``weights``: optional per-example loss weights (ParallelWrapper uses
-        zeros to mask padded examples exactly). ``mask``/``label_mask``:
-        (B,T) feature/label masks for variable-length sequences."""
+    def _loss_body(self, params, states, carries, x, y, keys, weights, mask,
+                   label_mask, training=True):
+        """The ONE forward+loss body shared by training (_loss), evaluation
+        (_loss_eval), and truncated BPTT (_tbptt_step). ``carries`` is None
+        for whole-sequence paths; a per-layer carry list routes recurrent
+        layers through ``apply_seq`` (TBPTT segments). ``weights``: optional
+        per-example loss weights (ParallelWrapper uses zeros to mask padded
+        examples exactly). ``mask``/``label_mask``: (B,T) masks."""
         h = self._cast(x)
         cparams = self._cast_params(params)
-        new_states = []
+        new_states, new_carries = [], []
         fmask = mask
         for i, lyr in enumerate(self.layers[:-1]):
-            kw = {}
-            if (
-                fmask is not None
-                and self._mask_aware[i]
-                and h.ndim == 3
-                and fmask.shape[:2] == h.shape[:2]
-            ):
-                kw["mask"] = fmask
-            h, ns = lyr.apply(cparams[i], states[i], h, training=True, key=keys[i], **kw)
-            new_states.append(ns)
+            seg_mask = (
+                fmask
+                if (fmask is not None and h.ndim == 3
+                    and fmask.shape[:2] == h.shape[:2])
+                else None
+            )
+            if carries is not None and self._is_recurrent(lyr):
+                h = lyr._maybe_dropout(h, training, keys[i])
+                h, c = lyr.apply_seq(cparams[i], h, carries[i], mask=seg_mask,
+                                     training=training, key=keys[i])
+                new_carries.append(c)
+                new_states.append(states[i])
+            else:
+                kw = {}
+                if seg_mask is not None and self._mask_aware[i]:
+                    kw["mask"] = seg_mask
+                h, ns = lyr.apply(cparams[i], states[i], h, training=training,
+                                  key=keys[i], **kw)
+                new_states.append(ns)
+                new_carries.append(None if carries is None else carries[i])
             if h.ndim < 3:
                 fmask = None
         out = self.layers[-1]
@@ -148,16 +160,25 @@ class MultiLayerNetwork:
         lm = label_mask if label_mask is not None else fmask
         if lm is not None and self._loss_mask_aware:
             loss_kw["mask"] = lm
+        if weights is not None:
+            loss_kw["weights"] = weights
         loss = out.compute_loss(
-            cparams[-1], states[-1], h, y, training=True, key=keys[-1],
-            weights=weights, **loss_kw,
+            cparams[-1], states[-1], h, y, training=training, key=keys[-1],
+            **loss_kw,
         )
         new_states.append(states[-1])
+        new_carries.append(None if carries is None else carries[-1])
         reg = sum(
             (lyr.regularization(params[i]) for i, lyr in enumerate(self.layers)),
             start=jnp.asarray(0.0),
         )
-        return loss.astype(jnp.float32) + reg, new_states
+        return loss.astype(jnp.float32) + reg, (new_states, new_carries)
+
+    def _loss(self, params, states, x, y, keys, weights=None, mask=None,
+              label_mask=None):
+        loss, (new_states, _) = self._loss_body(
+            params, states, None, x, y, keys, weights, mask, label_mask)
+        return loss, new_states
 
     # ------------------------------------------------------------ train step
     def make_step_fn(self, weighted: bool = False):
@@ -229,7 +250,121 @@ class MultiLayerNetwork:
             if hasattr(lst, "on_epoch_end"):
                 lst.on_epoch_end(self)
 
+    # -------------------------------------------------------- truncated BPTT
+    def _is_recurrent(self, lyr) -> bool:
+        return hasattr(lyr, "apply_seq") and hasattr(lyr, "init_carry")
+
+    @functools.cached_property
+    def _tbptt_step(self):
+        """One jitted train step over a TBPTT segment: recurrent layers take
+        carries in and hand carries out; gradients stop at segment boundaries
+        because the incoming carry is a plain (non-differentiated) argument.
+        (MultiLayerNetwork.doTruncatedBPTT parity — SURVEY.md §5.7.)"""
+        updaters = self._updaters
+        n_layers = len(self.layers)
+
+        def seg_loss(params, states, carries, x, y, keys, mask, label_mask):
+            return self._loss_body(params, states, carries, x, y, keys, None,
+                                   mask, label_mask)
+
+        def step(params, states, opt_states, carries, iteration, x, y, key,
+                 mask, label_mask):
+            keys = list(jax.random.split(key, n_layers))
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                seg_loss, has_aux=True
+            )(params, states, carries, x, y, keys, mask, label_mask)
+            new_params, new_opts = [], []
+            for i in range(n_layers):
+                if not grads[i]:
+                    new_params.append(params[i])
+                    new_opts.append(opt_states[i])
+                    continue
+                p, s = upd.apply_updater(
+                    updaters[i], params[i], grads[i], opt_states[i], iteration)
+                new_params.append(p)
+                new_opts.append(s)
+            return new_params, new_states, new_opts, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _init_carries(self, batch_size, dtype):
+        return [
+            lyr.init_carry(batch_size, dtype) if self._is_recurrent(lyr) else None
+            for lyr in self.layers
+        ]
+
+    def _fit_batch_tbptt(self, x, y, mask=None, label_mask=None):
+        """Segment loop: carries flow forward, gradients are truncated at
+        segment boundaries; each segment applies the updater and counts as an
+        iteration (update-per-segment semantics — Adam bias correction and
+        LR schedules advance per update, as in the reference)."""
+        k = self.conf.tbptt_length
+        T = x.shape[1]
+        # carries live in the compute dtype: an fp32 carry would promote the
+        # recurrent matmuls and silently drop the bf16/MXU policy
+        carries = self._init_carries(x.shape[0], self._cast(x).dtype)
+        losses = []
+        for s in range(0, T, k):
+            xs = x[:, s:s + k]
+            ys = y[:, s:s + k] if y.ndim == 3 else y
+            ms = None if mask is None else mask[:, s:s + k]
+            lms = None if label_mask is None else label_mask[:, s:s + k]
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            (self.params, self.states, self.opt_states, carries, loss) = (
+                self._tbptt_step(self.params, self.states, self.opt_states,
+                                 carries, jnp.asarray(self.iteration), xs, ys,
+                                 sub, ms, lms))
+            self.iteration += 1
+            losses.append(loss)
+        self.score_value = float(jnp.mean(jnp.stack(losses)))
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # ------------------------------------------------- stateful rnn inference
+    def rnn_time_step(self, x):
+        """Stateful step-by-step inference (rnnTimeStep parity): carries
+        persist across calls. ``x``: (B, T, F) or (B, F) for one step."""
+        from deeplearning4j_tpu.nn.recurrent import Bidirectional
+
+        for lyr in self.layers:
+            if isinstance(lyr, Bidirectional):
+                # the backward direction needs the FUTURE sequence — stepping
+                # is ill-defined (the reference's rnnTimeStep throws too)
+                raise ValueError("rnn_time_step does not support Bidirectional layers")
+        x = self._cast(jnp.asarray(x))
+        cparams = self._cast_params(self.params)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None]
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is not None:
+            for c in carries:
+                for leaf in jax.tree_util.tree_leaves(c):
+                    if leaf.shape[0] != x.shape[0]:
+                        raise ValueError(
+                            f"rnn_time_step batch size changed ({leaf.shape[0]}"
+                            f" -> {x.shape[0]}); call rnn_clear_previous_state()")
+        else:
+            carries = self._init_carries(x.shape[0], x.dtype)
+        h = x
+        new_carries = []
+        for i, lyr in enumerate(self.layers):
+            if self._is_recurrent(lyr):
+                h, c = lyr.apply_seq(cparams[i], h, carries[i], training=False)
+                new_carries.append(c)
+            else:
+                h, _ = lyr.apply(cparams[i], self.states[i], h, training=False)
+                new_carries.append(None)
+        self._rnn_carries = new_carries
+        return h[:, -1] if (squeeze and h.ndim == 3) else h
+
+    def rnn_clear_previous_state(self):
+        """rnnClearPreviousState parity."""
+        self._rnn_carries = None
+
     def _fit_batch(self, x, y, mask=None, label_mask=None):
+        if self.conf.tbptt_length and x.ndim == 3 and x.shape[1] > self.conf.tbptt_length:
+            return self._fit_batch_tbptt(x, y, mask=mask, label_mask=label_mask)
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._build_train_step()
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -289,29 +424,10 @@ class MultiLayerNetwork:
     @functools.cached_property
     def _loss_eval(self):
         def eval_loss(params, states, x, y, mask, label_mask):
-            h = self._cast(x)
-            cparams = self._cast_params(params)
-            fmask = mask
-            for i, lyr in enumerate(self.layers[:-1]):
-                kw = {}
-                if (
-                    fmask is not None
-                    and self._mask_aware[i]
-                    and h.ndim == 3
-                    and fmask.shape[:2] == h.shape[:2]
-                ):
-                    kw["mask"] = fmask
-                h, _ = lyr.apply(cparams[i], states[i], h, training=False, **kw)
-                if h.ndim < 3:
-                    fmask = None
-            loss_kw = {}
-            lm = label_mask if label_mask is not None else fmask
-            if lm is not None and self._loss_mask_aware:
-                loss_kw["mask"] = lm
-            loss = self.layers[-1].compute_loss(
-                cparams[-1], states[-1], h, y, training=False, **loss_kw
-            )
-            return loss, h
+            keys = [None] * len(self.layers)
+            loss, _ = self._loss_body(params, states, None, x, y, keys, None,
+                                      mask, label_mask, training=False)
+            return loss, None
 
         return jax.jit(eval_loss)
 

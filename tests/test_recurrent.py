@@ -211,3 +211,75 @@ def test_stateful_time_stepping(rng):
         steps.append(out)
     stepped = jnp.concatenate(steps, axis=1)
     np.testing.assert_allclose(np.asarray(full), np.asarray(stepped), rtol=2e-5, atol=1e-6)
+
+
+def test_tbptt_learns_long_sequence(rng):
+    # task: output at t mirrors input at t (identity through time) — learnable
+    # within any segment; TBPTT must train without materializing full-T BPTT
+    from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.02))
+            .tbptt_length(8)
+            .list()
+            .layer(LSTM(n_in=4, n_out=16))
+            .layer(RnnOutputLayer(n_in=16, n_out=4, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(4, 32)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.conf.tbptt_length == 8
+    ids = rng.integers(0, 4, size=(8, 32))
+    x = np.eye(4, dtype=np.float32)[ids]
+    y = x.copy()
+    losses = []
+    for _ in range(30):
+        net._fit_batch(jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(net.score_value))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_tbptt_carries_state_across_segments(rng):
+    # task solvable ONLY with memory across segment boundaries: label at
+    # every t is the input token at t=0 (long-range copy). With carries
+    # flowing across segments the net can solve it; verify loss gets near 0.
+    from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.02))
+            .tbptt_length(4)
+            .list()
+            .layer(LSTM(n_in=2, n_out=16))
+            .layer(RnnOutputLayer(n_in=16, n_out=2, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(2, 16)).build())
+    net = MultiLayerNetwork(conf).init()
+    ids = rng.integers(0, 2, size=(16, 16))
+    x = np.eye(2, dtype=np.float32)[ids]
+    y = np.repeat(x[:, :1], 16, axis=1)  # label = first token, everywhere
+    for _ in range(60):
+        net._fit_batch(jnp.asarray(x), jnp.asarray(y))
+    assert float(net.score_value) < 0.25, float(net.score_value)
+
+
+def test_rnn_time_step_matches_full_forward(rng):
+    from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .list()
+            .layer(LSTM(n_in=3, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(3, 6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((2, 6, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(6)]
+    np.testing.assert_allclose(np.stack(steps, 1), full, atol=1e-5)
+    # clearing state restarts the recurrence
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(again, steps[0], atol=1e-6)
