@@ -28,3 +28,8 @@ the reference mount was empty this round, so line numbers are not available):
 __version__ = "0.1.0"
 
 from deeplearning4j_tpu import dtypes  # noqa: F401
+
+# runtime flag tier (Nd4jEnvironmentVars parity): applied at import
+from deeplearning4j_tpu.config import get_environment  # noqa: F401,E402
+
+get_environment()

@@ -1,0 +1,105 @@
+"""Runtime environment flags (Nd4jEnvironmentVars / ND4JSystemProperties /
+native Environment parity — SURVEY.md §5.6 tiers (b) and (c)).
+
+Three config tiers, mirroring the reference:
+(a) model configs — Jackson-JSON builder DSL → `nn/conf.py` (JSON round-trip);
+(b) runtime flags — environment variables read here at import and mutable at
+    runtime through :class:`Environment` (the reference's
+    ``Nd4j.getEnvironment()`` singleton);
+(c) backend toggles — forwarded to JAX/XLA where an equivalent exists.
+
+Recognized variables (DL4J_TPU_* namespace; reference names in comments):
+
+- ``DL4J_TPU_DEBUG``       — verbose op logging hooks    (SD_DEBUG / debug mode)
+- ``DL4J_TPU_VERBOSE``     — DEBUG-level logging on the 'deeplearning4j_tpu'
+  logger (SD_VERBOSE)
+- ``DL4J_TPU_PROFILING``   — install OpProfiler at import (profiling mode)
+- ``DL4J_TPU_NAN_PANIC``   — raise on NaN/Inf op outputs  (ProfilerConfig.nanPanic)
+- ``DL4J_TPU_COMPUTE_DTYPE`` — default compute dtype for new configs
+  ("float32" | "bfloat16")   (ND4J default dtype)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Environment:
+    """Mutable runtime-flag singleton (Nd4j.getEnvironment() parity)."""
+
+    _instance: Optional["Environment"] = None
+
+    def __init__(self):
+        self.debug = _env_bool("DL4J_TPU_DEBUG")
+        self.verbose = _env_bool("DL4J_TPU_VERBOSE")
+        self.profiling = _env_bool("DL4J_TPU_PROFILING")
+        self.nan_panic = _env_bool("DL4J_TPU_NAN_PANIC")
+        self.default_compute_dtype = os.environ.get(
+            "DL4J_TPU_COMPUTE_DTYPE", "float32")
+        self._profiler = None
+
+    @classmethod
+    def get_instance(cls) -> "Environment":
+        if cls._instance is None:
+            cls._instance = Environment()
+            cls._instance._apply()
+        return cls._instance
+
+    # -- setters mirroring Nd4j.getEnvironment().setDebug/setVerbose ---------
+    def set_debug(self, v: bool) -> "Environment":
+        self.debug = v
+        return self._apply()
+
+    def set_verbose(self, v: bool) -> "Environment":
+        self.verbose = v
+        return self._apply()
+
+    def set_profiling(self, v: bool) -> "Environment":
+        self.profiling = v
+        return self._apply()
+
+    def set_nan_panic(self, v: bool) -> "Environment":
+        self.nan_panic = v
+        return self._apply()
+
+    def _apply(self) -> "Environment":
+        """Install/remove the profiler hook + logger level to match flags."""
+        import logging
+
+        from deeplearning4j_tpu.util.profiler import OpProfiler, ProfilerConfig
+
+        logging.getLogger("deeplearning4j_tpu").setLevel(
+            logging.DEBUG if (self.verbose or self.debug) else logging.WARNING)
+
+        want_hook = self.profiling or self.nan_panic or self.debug
+        if want_hook and self._profiler is None:
+            self._profiler = OpProfiler(ProfilerConfig(
+                profile_ops=self.profiling or self.debug,
+                check_for_nan=self.nan_panic,
+                check_for_inf=self.nan_panic,
+            ))
+            self._profiler.start()
+        elif not want_hook and self._profiler is not None:
+            self._profiler.stop()
+            self._profiler = None
+        elif self._profiler is not None:
+            self._profiler.config.profile_ops = self.profiling or self.debug
+            self._profiler.config.check_for_nan = self.nan_panic
+            self._profiler.config.check_for_inf = self.nan_panic
+        return self
+
+    def profiler(self):
+        return self._profiler
+
+
+def get_environment() -> Environment:
+    """``Nd4j.getEnvironment()`` parity."""
+    return Environment.get_instance()

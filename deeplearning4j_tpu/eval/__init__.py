@@ -7,5 +7,10 @@ happens on the host in numpy (cheap; the expensive part — the forward pass —
 stays on device).
 """
 
-from deeplearning4j_tpu.eval.classification import Evaluation, ROC  # noqa: F401
+from deeplearning4j_tpu.eval.classification import (  # noqa: F401
+    Evaluation,
+    EvaluationCalibration,
+    ROC,
+    ROCMultiClass,
+)
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401

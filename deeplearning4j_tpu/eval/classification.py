@@ -145,3 +145,83 @@ class ROC:
         precision = tps / np.arange(1, len(y) + 1)
         recall = tps / max(tps[-1], 1)
         return float(np.trapezoid(precision, recall))
+
+
+class ROCMultiClass:
+    """org/nd4j/evaluation/classification/ROCMultiClass.java parity:
+    one-vs-all ROC per class over probability outputs."""
+
+    def __init__(self, num_classes: int | None = None):
+        self.num_classes = num_classes
+        self._rocs: list[ROC] | None = None
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        n = labels.shape[-1]
+        if self.num_classes is not None and self.num_classes != n:
+            raise ValueError(
+                f"num_classes={self.num_classes} but labels have {n} columns")
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in range(n)]
+        for c, roc in enumerate(self._rocs):
+            roc.eval(labels[:, c], predictions[:, c])
+        return self
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+
+class EvaluationCalibration:
+    """org/nd4j/evaluation/classification/EvaluationCalibration.java parity:
+    reliability diagram (confidence bins vs empirical accuracy), expected
+    calibration error, and probability histograms."""
+
+    def __init__(self, n_bins: int = 10):
+        self.n_bins = n_bins
+        self._bin_counts = np.zeros(n_bins, np.int64)
+        self._bin_correct = np.zeros(n_bins, np.int64)
+        self._bin_conf_sum = np.zeros(n_bins, np.float64)
+        self._prob_hist = np.zeros(n_bins, np.int64)  # all predicted probs
+
+    def eval(self, labels, predictions):
+        labels = np.asarray(labels)
+        p = np.asarray(predictions, np.float64)
+        conf = p.max(axis=-1)
+        pred_cls = p.argmax(axis=-1)
+        true_cls = labels.argmax(axis=-1)
+        bins = np.clip((conf * self.n_bins).astype(int), 0, self.n_bins - 1)
+        np.add.at(self._bin_counts, bins, 1)
+        np.add.at(self._bin_correct, bins, pred_cls == true_cls)
+        np.add.at(self._bin_conf_sum, bins, conf)
+        all_bins = np.clip((p.ravel() * self.n_bins).astype(int), 0,
+                           self.n_bins - 1)
+        np.add.at(self._prob_hist, all_bins, 1)
+        return self
+
+    def reliability_diagram(self):
+        """→ (bin_centers, empirical_accuracy, mean_confidence, counts)."""
+        centers = (np.arange(self.n_bins) + 0.5) / self.n_bins
+        with np.errstate(invalid="ignore"):
+            acc = np.where(self._bin_counts > 0,
+                           self._bin_correct / np.maximum(self._bin_counts, 1),
+                           np.nan)
+            conf = np.where(self._bin_counts > 0,
+                            self._bin_conf_sum / np.maximum(self._bin_counts, 1),
+                            np.nan)
+        return centers, acc, conf, self._bin_counts.copy()
+
+    def expected_calibration_error(self) -> float:
+        total = self._bin_counts.sum()
+        if total == 0:
+            return float("nan")
+        _, acc, conf, counts = self.reliability_diagram()
+        valid = counts > 0
+        return float(np.sum(counts[valid] / total
+                            * np.abs(acc[valid] - conf[valid])))
+
+    def probability_histogram(self):
+        return self._prob_hist.copy()

@@ -107,9 +107,11 @@ class Builder:
         self._updater = upd.Sgd(0.1)
         self._l1 = 0.0
         self._l2 = 0.0
+        from deeplearning4j_tpu.config import get_environment
+
         self._weight_init: Optional[str] = None
         self._activation: Optional[str] = None
-        self._compute_dtype = "float32"
+        self._compute_dtype = get_environment().default_compute_dtype
         self._tbptt_length = 0
 
     def seed(self, s: int) -> "Builder":

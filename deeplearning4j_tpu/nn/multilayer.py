@@ -363,7 +363,10 @@ class MultiLayerNetwork:
         self._rnn_carries = None
 
     def _fit_batch(self, x, y, mask=None, label_mask=None):
-        if self.conf.tbptt_length and x.ndim == 3 and x.shape[1] > self.conf.tbptt_length:
+        if (self.conf.tbptt_length and x.ndim == 3 and y.ndim == 3
+                and x.shape[1] > self.conf.tbptt_length):
+            # per-sequence (2-D) labels cannot be segmented: fall back to
+            # whole-sequence BPTT, as the reference's doTruncatedBPTT does
             return self._fit_batch_tbptt(x, y, mask=mask, label_mask=label_mask)
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._build_train_step()

@@ -578,6 +578,34 @@ class SameDiff:
     def trainable_names(self) -> List[str]:
         return [n for n, v in self._vars.items() if v.vtype is VariableType.VARIABLE]
 
+    def convert_to_variable(self, *names) -> "SameDiff":
+        """CONSTANT → VARIABLE (SameDiff.convertToVariable parity): makes
+        imported weights trainable — the TF-import fine-tune path (BASELINE
+        config #4: import a frozen graph, convert its weights, fit)."""
+        for name in names:
+            name = name.name if isinstance(name, SDVariable) else name
+            v = self._vars[name]
+            if v.vtype is VariableType.VARIABLE:
+                continue
+            if v.vtype is not VariableType.CONSTANT:
+                raise ValueError(f"{name!r} is {v.vtype.value}, not CONSTANT")
+            self._vars[name] = SDVariable(self, name, VariableType.VARIABLE)
+        self._invalidate()
+        return self
+
+    def convert_to_constant(self, *names) -> "SameDiff":
+        """VARIABLE → CONSTANT (convertToConstant parity: freeze weights)."""
+        for name in names:
+            name = name.name if isinstance(name, SDVariable) else name
+            v = self._vars[name]
+            if v.vtype is VariableType.CONSTANT:
+                continue
+            if v.vtype is not VariableType.VARIABLE:
+                raise ValueError(f"{name!r} is {v.vtype.value}, not VARIABLE")
+            self._vars[name] = SDVariable(self, name, VariableType.CONSTANT)
+        self._invalidate()
+        return self
+
     # -- graph recording ----------------------------------------------------
     def _coerce_input(self, a):
         if isinstance(a, SDVariable):

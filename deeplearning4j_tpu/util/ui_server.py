@@ -1,0 +1,142 @@
+"""UIServer: browser dashboard over a StatsStorage.
+
+Reference parity: deeplearning4j-ui (VertxUIServer + training charts —
+SURVEY.md §2.2 J19) — path-cite, mount empty this round. The reference runs
+a Vert.x web app; here a stdlib http.server thread serves the same content
+model: score/time charts from the attached StatsStorage, rendered with an
+inline-SVG page (no JS dependencies, no egress).
+
+    from deeplearning4j_tpu.util import InMemoryStatsStorage, StatsListener
+    from deeplearning4j_tpu.util.ui_server import UIServer
+
+    storage = InMemoryStatsStorage()
+    net.listeners.append(StatsListener(storage))
+    ui = UIServer.get_instance()
+    ui.attach(storage)              # http://localhost:9000/train
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+class UIServer:
+    """UIServer.getInstance()/attach(storage) parity."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storages: List = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage) -> "UIServer":
+        self.storages.append(storage)
+        if self._httpd is None:
+            self._start()
+        return self
+
+    def detach(self, storage):
+        self.storages.remove(storage)
+
+    def _records(self):
+        out = []
+        for s in self.storages:
+            out.extend(s.records)
+        return sorted(out, key=lambda r: r.get("iteration", 0))
+
+    def _start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, body: bytes, ctype: str):
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/train/data"):
+                    self._send(json.dumps(server._records()).encode(),
+                               "application/json")
+                elif self.path in ("/", "/train", "/train/"):
+                    self._send(server._render().encode(), "text/html")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolves port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    # ------------------------------------------------------------- rendering
+    def _render(self) -> str:
+        recs = self._records()
+        scores = [(r["iteration"], r["score"]) for r in recs if "score" in r]
+        svg = _line_chart(scores, "score")
+        def ms(r):
+            v = r.get("iter_ms")
+            return f"{v:.1f}" if isinstance(v, (int, float)) else ""
+
+        rows = "".join(
+            f"<tr><td>{r.get('iteration', '')}</td><td>{r.get('epoch', '')}</td>"
+            f"<td>{r['score']:.6f}</td><td>{ms(r)}</td></tr>"
+            for r in recs[-25:] if isinstance(r.get("score"), (int, float))
+        )
+        return f"""<!doctype html><html><head><title>Training UI</title></head>
+<body style="font-family:sans-serif">
+<h2>Model score vs iteration</h2>{svg}
+<h3>Recent iterations</h3>
+<table border=1 cellpadding=4>
+<tr><th>iter</th><th>epoch</th><th>score</th><th>ms</th></tr>{rows}</table>
+<p>{len(recs)} records; raw data at <a href="/train/data">/train/data</a></p>
+</body></html>"""
+
+
+def _line_chart(points, label, w=640, h=240, pad=40) -> str:
+    if not points:
+        return "<p>(no data yet)</p>"
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    if y1 == y0:
+        y1 = y0 + 1.0
+    sx = lambda x: pad + (x - x0) / max(x1 - x0, 1) * (w - 2 * pad)
+    sy = lambda y: h - pad - (y - y0) / (y1 - y0) * (h - 2 * pad)
+    pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in points)
+    return (f'<svg width="{w}" height="{h}">'
+            f'<rect width="{w}" height="{h}" fill="#fafafa" stroke="#ccc"/>'
+            f'<polyline fill="none" stroke="#1f77b4" stroke-width="1.5" '
+            f'points="{pts}"/>'
+            f'<text x="{pad}" y="{h - 8}" font-size="11">{x0}</text>'
+            f'<text x="{w - pad}" y="{h - 8}" font-size="11" '
+            f'text-anchor="end">{x1}</text>'
+            f'<text x="4" y="{pad}" font-size="11">{y1:.4g}</text>'
+            f'<text x="4" y="{h - pad}" font-size="11">{y0:.4g}</text>'
+            f'<text x="{w // 2}" y="16" font-size="13" '
+            f'text-anchor="middle">{label}</text></svg>')

@@ -293,3 +293,58 @@ class TestOnnxImport:
         x = np.asarray([-2.0, 0.1, 0.4, 3.0], np.float32)
         out = np.asarray(sd.output({"x": x}, ["y"])["y"])
         np.testing.assert_allclose(out, np.minimum(x, 0.5), atol=1e-6)
+
+
+class TestTFImportFineTune:
+    """BASELINE config #4 path: import a frozen TF transformer graph into
+    SameDiff, convert its weights to variables, and fine-tune."""
+
+    def test_imported_transformer_finetunes(self, rng):
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+        from deeplearning4j_tpu.samediff import TrainingConfig
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        V, H, T, C = 20, 8, 6, 2
+        emb = tf.Variable(rng.normal(size=(V, H)).astype(np.float32) * 0.2)
+        wq = tf.Variable(rng.normal(size=(H, H)).astype(np.float32) * 0.3)
+        wv = tf.Variable(rng.normal(size=(H, H)).astype(np.float32) * 0.3)
+        wo = tf.Variable(rng.normal(size=(H, C)).astype(np.float32) * 0.3)
+
+        def model(ids):
+            h = tf.gather(emb, ids)                      # (B,T,H)
+            q = tf.matmul(h, wq)
+            s = tf.matmul(q, q, transpose_b=True) / np.sqrt(H).astype(np.float32)
+            a = tf.matmul(tf.nn.softmax(s), tf.matmul(h, wv))
+            cls = (h + a)[:, 0]                          # residual, [CLS]
+            return tf.matmul(cls, wo)                    # logits
+
+        conc = tf.function(model).get_concrete_function(
+            tf.TensorSpec((None, T), tf.int32))
+        frozen = convert_variables_to_constants_v2(conc)
+        sd = import_graph_def(frozen.graph.as_graph_def())
+
+        # weights imported as constants → make them trainable
+        weight_names = [n for n, v in sd._arrays.items()
+                        if np.asarray(v).ndim == 2]
+        sd.convert_to_variable(*weight_names)
+        assert set(sd.trainable_names()) == set(weight_names)
+
+        logits_name = sd.tf_name_map[frozen.outputs[0].name]
+        logits = sd.get_variable(logits_name)
+        y = sd.placeholder("y", shape=(-1, C))
+        loss = sd.loss.softmaxCrossEntropy(logits, y)
+        sd.set_loss_variables(loss)
+        in_name = frozen.inputs[0].name.split(":")[0]
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.01),
+            data_set_feature_mapping=[in_name],
+            data_set_label_mapping=["y"]))
+
+        # toy task: class = (first token < V//2)
+        ids = rng.integers(0, V, size=(64, T)).astype(np.int32)
+        labels = np.eye(C, dtype=np.float32)[(ids[:, 0] < V // 2).astype(int)]
+        hist = sd.fit((ids, labels), epochs=40)
+        assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])

@@ -1,0 +1,131 @@
+"""UI server, environment flags, evaluation breadth (calibration/ROC-MC).
+
+Reference test parity: deeplearning4j-ui server tests, Nd4jEnvironment
+flag tests, and nd4j evaluation suites (SURVEY.md §2.2 J5/J19, §5.6)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.config import Environment, get_environment
+from deeplearning4j_tpu.eval import EvaluationCalibration, ROCMultiClass
+from deeplearning4j_tpu.util import InMemoryStatsStorage
+from deeplearning4j_tpu.util.ui_server import UIServer
+
+
+class TestEvaluationBreadth:
+    def test_roc_multiclass(self, rng):
+        n = 400
+        true = rng.integers(0, 3, n)
+        labels = np.eye(3, dtype=np.float32)[true]
+        # informative scores: high prob on the true class most of the time
+        scores = rng.uniform(0.0, 0.4, (n, 3)).astype(np.float32)
+        scores[np.arange(n), true] += 0.6 * (rng.random(n) < 0.8)
+        scores /= scores.sum(1, keepdims=True)
+        roc = ROCMultiClass().eval(labels, scores)
+        assert roc.calculate_average_auc() > 0.7
+        assert 0 <= roc.calculate_auc(1) <= 1
+
+    def test_calibration_perfectly_calibrated(self, rng):
+        # construct predictions whose confidence == empirical accuracy
+        ec = EvaluationCalibration(n_bins=10)
+        n = 4000
+        conf = rng.uniform(0.55, 0.95, n)
+        correct = rng.random(n) < conf
+        preds = np.zeros((n, 2), np.float32)
+        preds[:, 0] = conf
+        preds[:, 1] = 1 - conf
+        labels = np.zeros((n, 2), np.float32)
+        labels[np.arange(n), np.where(correct, 0, 1)] = 1.0
+        ec.eval(labels, preds)
+        assert ec.expected_calibration_error() < 0.06
+        centers, acc, mean_conf, counts = ec.reliability_diagram()
+        assert counts.sum() == n
+
+    def test_calibration_overconfident(self, rng):
+        ec = EvaluationCalibration(n_bins=10)
+        n = 2000
+        preds = np.tile(np.asarray([[0.95, 0.05]], np.float32), (n, 1))
+        correct = rng.random(n) < 0.5  # actual accuracy 50%, confidence 95%
+        labels = np.zeros((n, 2), np.float32)
+        labels[np.arange(n), np.where(correct, 0, 1)] = 1.0
+        ec.eval(labels, preds)
+        assert ec.expected_calibration_error() > 0.3
+
+
+class TestUIServer:
+    def test_serves_charts_and_data(self):
+        storage = InMemoryStatsStorage()
+        for i in range(10):
+            storage.put({"session": "s", "iteration": i, "epoch": 0,
+                         "score": 1.0 / (i + 1), "iter_ms": 12.5})
+        ui = UIServer(port=0)
+        ui.attach(storage)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            html = urllib.request.urlopen(f"{base}/train").read().decode()
+            assert "<svg" in html and "score" in html
+            data = json.loads(urllib.request.urlopen(
+                f"{base}/train/data").read())
+            assert len(data) == 10
+            assert data[0]["iteration"] == 0
+        finally:
+            ui.stop()
+
+
+class TestEnvironment:
+    def test_flags_install_and_remove_hook(self, monkeypatch):
+        from deeplearning4j_tpu.ops import registry
+
+        Environment._instance = None
+        env = get_environment()
+        assert env.profiler() is None
+        env.set_profiling(True)
+        import jax.numpy as jnp
+
+        registry.exec_op("add", jnp.ones(2), jnp.ones(2))
+        assert env.profiler().invocations["add"] == 1
+        env.set_profiling(False)
+        assert env.profiler() is None
+        Environment._instance = None
+
+    def test_nan_panic_flag(self):
+        from deeplearning4j_tpu.ops import registry
+        from deeplearning4j_tpu.util.profiler import NaNPanicError
+        import jax.numpy as jnp
+
+        Environment._instance = None
+        env = get_environment()
+        env.set_nan_panic(True)
+        try:
+            with pytest.raises(NaNPanicError):
+                registry.exec_op("log", jnp.asarray([-1.0]))
+        finally:
+            env.set_nan_panic(False)
+            Environment._instance = None
+
+    def test_env_var_defaults(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_COMPUTE_DTYPE", "bfloat16")
+        monkeypatch.setenv("DL4J_TPU_VERBOSE", "true")
+        env = Environment()
+        assert env.default_compute_dtype == "bfloat16"
+        assert env.verbose is True
+
+
+def test_compute_dtype_env_default(monkeypatch):
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import InputType
+
+    monkeypatch.setenv("DL4J_TPU_COMPUTE_DTYPE", "bfloat16")
+    Environment._instance = None
+    try:
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_in=2, n_out=2))
+                .layer(OutputLayer(n_in=2, n_out=2))
+                .set_input_type(InputType.feed_forward(2)).build())
+        assert conf.compute_dtype == "bfloat16"
+    finally:
+        Environment._instance = None
