@@ -74,26 +74,31 @@ class Environment:
         """Install/remove the profiler hook + logger level to match flags."""
         import logging
 
-        from deeplearning4j_tpu.util.profiler import OpProfiler, ProfilerConfig
+        from deeplearning4j_tpu.util.profiler import OpProfiler
 
-        logging.getLogger("deeplearning4j_tpu").setLevel(
-            logging.DEBUG if (self.verbose or self.debug) else logging.WARNING)
+        # only drive the logger level while a verbosity flag is ON; never
+        # clobber an application's own configuration otherwise
+        logger = logging.getLogger("deeplearning4j_tpu")
+        if self.verbose or self.debug:
+            logger.setLevel(logging.DEBUG)
+            self._set_logger_level = True
+        elif getattr(self, "_set_logger_level", False):
+            logger.setLevel(logging.NOTSET)
+            self._set_logger_level = False
 
+        # share the OpProfiler SINGLETON so flag-driven and user-driven
+        # profiling never install competing exec_op hooks
         want_hook = self.profiling or self.nan_panic or self.debug
-        if want_hook and self._profiler is None:
-            self._profiler = OpProfiler(ProfilerConfig(
-                profile_ops=self.profiling or self.debug,
-                check_for_nan=self.nan_panic,
-                check_for_inf=self.nan_panic,
-            ))
-            self._profiler.start()
-        elif not want_hook and self._profiler is not None:
-            self._profiler.stop()
-            self._profiler = None
+        prof = OpProfiler.get_instance()
+        prof.config.profile_ops = self.profiling or self.debug
+        prof.config.check_for_nan = self.nan_panic
+        prof.config.check_for_inf = self.nan_panic
+        if want_hook:
+            prof.start()
+            self._profiler = prof
         elif self._profiler is not None:
-            self._profiler.config.profile_ops = self.profiling or self.debug
-            self._profiler.config.check_for_nan = self.nan_panic
-            self._profiler.config.check_for_inf = self.nan_panic
+            prof.stop()
+            self._profiler = None
         return self
 
     def profiler(self):

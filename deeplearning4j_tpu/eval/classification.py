@@ -169,9 +169,13 @@ class ROCMultiClass:
         return self
 
     def calculate_auc(self, cls: int) -> float:
+        if self._rocs is None:
+            raise ValueError("no data: call eval() first")
         return self._rocs[cls].calculate_auc()
 
     def calculate_average_auc(self) -> float:
+        if self._rocs is None:
+            raise ValueError("no data: call eval() first")
         return float(np.mean([r.calculate_auc() for r in self._rocs]))
 
 

@@ -1,0 +1,156 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference has no MoE and no expert parallelism (SURVEY.md §2.3: EP —
+"not required"); this is a TPU-native extension in the same spirit as ring
+attention: the strategies large models actually need, expressed as sharding
+over the mesh.
+
+Design: top-k routed expert FFNs (Shazeer et al.; PAPERS.md). Dispatch is
+DENSE — every expert computes every token and the router's gate zeroes
+non-selected contributions:
+
+    y = sum_e gate_e(x) * FFN_e(x)
+
+Dense dispatch is deliberate: no capacity factors, no dynamic shapes, no
+sorting — everything stays jit-compilable with static shapes (XLA
+requirement), and under expert parallelism each device computes only ITS
+experts' partial sum, so compute still splits E-ways; the all-reduce of
+partial sums is the EP collective (the a2a-free formulation). For the
+expert counts the layer API targets (E ≤ ~32) this is the
+compile-friendliest formulation on TPU.
+
+``expert_parallel(...)`` runs the same layer under shard_map with experts
+sharded over a mesh axis — numerically identical to the single-device
+layer (tested), with per-device expert compute 1/m of the total.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn import activations as act
+from deeplearning4j_tpu.nn import weights as winit
+from deeplearning4j_tpu.nn.layers import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MixtureOfExperts(Layer):
+    """Top-k routed MoE FFN over [B, T, H] (or [B, H]) inputs.
+
+    Params: router (H, E); per-expert W1 (E, H, F), b1 (E, F), W2 (E, F, H),
+    b2 (E, H). Output has the input's shape; aux load-balancing loss
+    (Switch-Transformer style) is exposed via ``aux_loss`` on the state.
+    """
+
+    n_in: int = 0
+    n_experts: int = 4
+    ffn_size: int = 0          # default 4*n_in
+    top_k: int = 2
+    activation: str = "gelu"
+    weight_init: str = "xavier"
+    router_noise: float = 0.0  # jitter std during training
+    aux_loss_weight: float = 0.01
+
+    @property
+    def _ffn(self):
+        return self.ffn_size or 4 * self.n_in
+
+    def initialize(self, key, input_shape):
+        kr, k1, k2 = jax.random.split(key, 3)
+        e, h, f = self.n_experts, self.n_in, self._ffn
+        init_each = lambda k, shape: jnp.stack([
+            winit.init(kk, self.weight_init, shape)
+            for kk in jax.random.split(k, e)
+        ])
+        return {
+            "router": winit.init(kr, self.weight_init, (h, e)),
+            "W1": init_each(k1, (h, f)),
+            "b1": jnp.zeros((e, f)),
+            "W2": init_each(k2, (f, h)),
+            "b2": jnp.zeros((e, h)),
+        }, {}
+
+    # -- routing ------------------------------------------------------------
+    def _gates(self, params, x2d, training, key):
+        logits = x2d @ params["router"]  # (N, E)
+        if training and self.router_noise > 0.0 and key is not None:
+            logits = logits + self.router_noise * jax.random.normal(
+                key, logits.shape, logits.dtype)
+        if self.top_k < self.n_experts:
+            kth = jnp.sort(logits, axis=-1)[:, -self.top_k][:, None]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        gates = jax.nn.softmax(logits, axis=-1)  # zero where masked
+        return gates, logits
+
+    def _expert_partial(self, params, x2d, gates, e_offset=0):
+        """Weighted sum over THIS param shard's experts (EP body)."""
+        fn = act.resolve(self.activation)
+        hidden = fn(jnp.einsum("nh,ehf->enf", x2d, params["W1"])
+                    + params["b1"][:, None])
+        out = jnp.einsum("enf,efh->enh", hidden, params["W2"]) \
+            + params["b2"][:, None]
+        local_e = params["W1"].shape[0]
+        g = lax.dynamic_slice_in_dim(gates, e_offset, local_e, axis=1)
+        return jnp.einsum("ne,enh->nh", g.astype(out.dtype), out)
+
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        kd = kr = None
+        if key is not None:
+            kd, kr = jax.random.split(key)  # independent dropout/router noise
+        x = self._maybe_dropout(x, training, kd)
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        gates, _ = self._gates(params, x2d, training, kr)
+        y = self._expert_partial(params, x2d, gates)
+        return y.reshape(shape), state
+
+    def aux_loss(self, params, x, training=False, key=None):
+        """Switch-style load-balancing loss: E * sum_e f_e * p_e, where f_e is
+        the fraction of tokens whose top choice is e and p_e the mean gate."""
+        x2d = x.reshape(-1, x.shape[-1])
+        gates, logits = self._gates(params, x2d, training, key)
+        probs = jax.nn.softmax(x2d @ params["router"], axis=-1)
+        top1 = jax.nn.one_hot(jnp.argmax(logits, -1), self.n_experts)
+        f = jnp.mean(top1, axis=0)
+        p = jnp.mean(probs, axis=0)
+        return self.aux_loss_weight * self.n_experts * jnp.sum(f * p)
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+def expert_parallel(layer: MixtureOfExperts, params, x, mesh: Mesh,
+                    axis_name: str = "model"):
+    """Run the MoE layer with experts sharded over ``axis_name``: each device
+    computes its expert shard's partial sum; one psum combines them. The
+    router is replicated (tiny). Numerically identical to ``layer.apply``."""
+    m = mesh.shape[axis_name]
+    if layer.n_experts % m:
+        raise ValueError(f"n_experts={layer.n_experts} not divisible by "
+                         f"mesh axis {axis_name}={m}")
+
+    def local(params, x):
+        idx = lax.axis_index(axis_name)
+        local_e = layer.n_experts // m
+        x2d = x.reshape(-1, x.shape[-1])
+        gates, _ = layer._gates(params, x2d, False, None)  # router replicated
+        part = layer._expert_partial(params, x2d, gates,
+                                     e_offset=idx * local_e)
+        return lax.psum(part, axis_name).reshape(x.shape)
+
+    espec = P(axis_name)  # expert-stacked leaves sharded on their leading axis
+    pspec = {
+        "router": P(), "W1": espec, "b1": espec, "W2": espec, "b2": espec,
+    }
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(), check_vma=False,
+    )(params, x)

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3: PP — absent);
+this is the TPU-native extension: S identical-signature stages live on S
+devices along a mesh axis, microbatches stream through the ring with
+``ppermute`` hops, and every device runs the SAME program (SPMD) — its own
+stage's params applied to whatever activation just arrived. The schedule is
+the classic GPipe fill-drain: n_micro + S - 1 ticks, bubble fraction
+(S-1)/(n_micro+S-1).
+
+API:
+
+    stacked = stack_stage_params([p0, p1, ...])       # leading stage axis
+    y = pipeline_forward(stage_fn, stacked, x, n_micro=4,
+                         mesh=m.mesh, axis_name="model")
+
+``stage_fn(params_i, x) -> y`` must map activations of a fixed shape to the
+same shape (equal-width stages — the standard PP regime; embed/head layers
+live outside the pipeline). Differentiable: JAX AD reverses the ppermute
+ring, giving the backward pipeline for free.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(params_list: Sequence):
+    """[per-stage pytree] → one pytree with a leading stage axis (shard it
+    over the pipeline mesh axis)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
+                     mesh: Mesh, axis_name: str = "model"):
+    """Run x (batch, ...) through S pipelined stages, microbatched.
+
+    ``stacked_params`` leaves have leading dim S == mesh.shape[axis_name];
+    batch must divide n_micro. Output matches running the stages
+    sequentially (tested), with stage weights resident on separate devices.
+    """
+    s = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    mb = b // n_micro
+    micro = x.reshape(n_micro, mb, *x.shape[1:])
+
+    def local(params, micro):
+        # this device's stage params: shard_map leaves the (length-1) sharded
+        # leading axis in place — strip it
+        params = jax.tree_util.tree_map(lambda v: v[0], params)
+        stage = lax.axis_index(axis_name)
+        n_ticks = n_micro + s - 1
+        # state held between ticks: the activation each device will process
+        carry = jnp.zeros((mb,) + micro.shape[2:], micro.dtype)
+        outs = jnp.zeros((n_micro, mb) + micro.shape[2:], micro.dtype)
+        perm = [(j, (j + 1) % s) for j in range(s)]
+
+        def tick(t, state):
+            carry, outs = state
+            # stage 0 ingests microbatch t (when in range); others use the
+            # activation that arrived from the previous stage
+            feed = lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            inp = jnp.where(stage == 0, feed, carry)
+            out = stage_fn(params, inp)
+            # last stage banks its result at slot t-(s-1)
+            slot = jnp.clip(t - (s - 1), 0, n_micro - 1)
+            bank = (stage == s - 1) & (t >= s - 1)
+            outs = lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(bank, out,
+                          lax.dynamic_index_in_dim(outs, slot, keepdims=False)),
+                slot, axis=0)
+            # rotate activations one hop around the ring
+            carry = lax.ppermute(out, axis_name, perm)
+            return carry, outs
+
+        _, outs = lax.fori_loop(0, n_ticks, tick, (carry, outs))
+        # results live on the last stage; share them (replicated output)
+        outs = lax.psum(jnp.where(stage == s - 1, outs, jnp.zeros_like(outs)),
+                        axis_name)
+        return outs
+
+    out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, micro)
+    return out.reshape(b, *x.shape[1:])
+
+
+def sequential_reference(stage_fn: Callable, params_list: Sequence, x):
+    """The semantics pipeline_forward must match (for tests/docs)."""
+    h = x
+    for p in params_list:
+        h = stage_fn(p, h)
+    return h

@@ -38,6 +38,10 @@ class UIServer:
     def get_instance(cls, port: int = 9000) -> "UIServer":
         if cls._instance is None:
             cls._instance = UIServer(port)
+        elif port != cls._instance.port:
+            raise ValueError(
+                f"UIServer already running on port {cls._instance.port}; "
+                f"stop() it before requesting port {port}")
         return cls._instance
 
     def attach(self, storage) -> "UIServer":
@@ -122,7 +126,7 @@ def _line_chart(points, label, w=640, h=240, pad=40) -> str:
         return "<p>(no data yet)</p>"
     xs = [p[0] for p in points]
     ys = [p[1] for p in points]
-    x0, x1 = min(xs), max(xs) or 1
+    x0, x1 = min(xs), max(xs)
     y0, y1 = min(ys), max(ys)
     if y1 == y0:
         y1 = y0 + 1.0
