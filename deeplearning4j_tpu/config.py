@@ -87,13 +87,15 @@ class Environment:
             self._set_logger_level = False
 
         # share the OpProfiler SINGLETON so flag-driven and user-driven
-        # profiling never install competing exec_op hooks
+        # profiling never install competing exec_op hooks; only touch its
+        # config while the FLAGS own the hook — a user-started profiler's
+        # settings are never clobbered by unrelated setter calls
         want_hook = self.profiling or self.nan_panic or self.debug
         prof = OpProfiler.get_instance()
-        prof.config.profile_ops = self.profiling or self.debug
-        prof.config.check_for_nan = self.nan_panic
-        prof.config.check_for_inf = self.nan_panic
         if want_hook:
+            prof.config.profile_ops = self.profiling or self.debug
+            prof.config.check_for_nan = self.nan_panic
+            prof.config.check_for_inf = self.nan_panic
             prof.start()
             self._profiler = prof
         elif self._profiler is not None:

@@ -1,0 +1,54 @@
+"""WordVectorSerializer: save/load word vectors.
+
+Reference parity: org/deeplearning4j/models/embeddings/loader/
+WordVectorSerializer.java (writeWord2VecModel / readWord2VecModel, the
+word2vec text format: header "V D" then "word v1 ... vD" lines) —
+path-cite, mount empty this round.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import WordVectorsMixin, _VocabCache
+
+
+class _LoadedWordVectors(WordVectorsMixin):
+    def __init__(self, vocab, vectors):
+        self.vocab = vocab
+        self.vectors = vectors
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word_vectors(model: WordVectorsMixin, path: str):
+        """word2vec text format (gzip if path endswith .gz)."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt", encoding="utf-8") as f:
+            v, d = model.vectors.shape
+            f.write(f"{v} {d}\n")
+            for i, w in enumerate(model.vocab.words):
+                vec = " ".join(f"{x:.6f}" for x in model.vectors[i])
+                f.write(f"{w} {vec}\n")
+
+    @staticmethod
+    def read_word_vectors(path: str) -> WordVectorsMixin:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            header = f.readline().split()
+            v, d = int(header[0]), int(header[1])
+            words = []
+            vectors = np.empty((v, d), np.float32)
+            for i in range(v):
+                parts = f.readline().rstrip("\n").split(" ")
+                words.append(parts[0])
+                vectors[i] = [float(x) for x in parts[1:d + 1]]
+        return _LoadedWordVectors(
+            _VocabCache(words, np.ones(len(words))), vectors)
+
+    # reference-name aliases
+    writeWord2VecModel = write_word_vectors
+    readWord2VecModel = read_word_vectors

@@ -412,3 +412,34 @@ class RnnLossLayer(Layer):
 
     def output_shape(self, input_shape):
         return tuple(input_shape)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GravesBidirectionalLSTM(Layer):
+    """conf/layers/GravesBidirectionalLSTM.java parity: a named convenience
+    for Bidirectional(GravesLSTM) with separate forward/backward cells and
+    concat merging (the reference's fixed behavior)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    weight_init: str = "xavier"
+
+    def _inner(self):
+        cell = GravesLSTM(
+            n_in=self.n_in, n_out=self.n_out, activation=self.activation,
+            gate_activation=self.gate_activation, weight_init=self.weight_init,
+            dropout=self.dropout)  # forward the input-dropout rate
+        return Bidirectional(layer=cell, mode="concat")
+
+    def initialize(self, key, input_shape):
+        return self._inner().initialize(key, input_shape)
+
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        return self._inner().apply(params, state, x, training=training,
+                                   key=key, mask=mask)
+
+    def output_shape(self, input_shape):
+        return self._inner().output_shape(input_shape)

@@ -48,6 +48,11 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
+    if leading != {s}:
+        raise ValueError(
+            f"stacked_params leading dim(s) {sorted(leading)} must equal the "
+            f"{axis_name!r} mesh axis size {s} (one stage per device)")
     mb = b // n_micro
     micro = x.reshape(n_micro, mb, *x.shape[1:])
 

@@ -1,6 +1,11 @@
 """Cross-cutting utilities (reference: org/deeplearning4j/util/** and
 nd4j-common — SURVEY.md §2.2 J20)."""
 
+from deeplearning4j_tpu.util.checkpoint import (
+    FaultTolerantTrainer,
+    ShardedCheckpointer,
+    ShardedCheckpointListener,
+)
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 from deeplearning4j_tpu.util.profiler import (
     NaNPanicError,
@@ -19,7 +24,8 @@ from deeplearning4j_tpu.util.stats import (
 )
 
 __all__ = [
-    "ModelSerializer", "OpProfiler", "ProfilerConfig", "StepTimer",
+    "ModelSerializer", "ShardedCheckpointer", "ShardedCheckpointListener",
+    "FaultTolerantTrainer", "OpProfiler", "ProfilerConfig", "StepTimer",
     "NaNPanicError", "check_numerics", "device_trace", "CrashReportingUtil",
     "FileStatsStorage", "InMemoryStatsStorage", "StatsListener", "to_csv",
 ]

@@ -35,10 +35,10 @@ class UIServer:
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
-    def get_instance(cls, port: int = 9000) -> "UIServer":
+    def get_instance(cls, port: "Optional[int]" = None) -> "UIServer":
         if cls._instance is None:
-            cls._instance = UIServer(port)
-        elif port != cls._instance.port:
+            cls._instance = UIServer(9000 if port is None else port)
+        elif port is not None and port != cls._instance.port:
             raise ValueError(
                 f"UIServer already running on port {cls._instance.port}; "
                 f"stop() it before requesting port {port}")

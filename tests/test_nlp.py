@@ -130,3 +130,21 @@ class TestWordVectors:
         v = pv.infer_vector("king queen royal")
         assert v.shape == (16,)
         assert np.isfinite(v).all()
+
+
+def test_word_vector_serializer_roundtrip(tmp_path, toy_corpus):
+    from deeplearning4j_tpu.nlp import WordVectorSerializer, Word2Vec
+
+    w2v = Word2Vec(min_word_frequency=5, layer_size=8, epochs=2,
+                   subsample=0, seed=0).fit(toy_corpus)
+    p = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert loaded.vocab.words == w2v.vocab.words
+    np.testing.assert_allclose(loaded.vectors, w2v.vectors, atol=1e-5)
+    assert loaded.similarity("king", "queen") == pytest.approx(
+        w2v.similarity("king", "queen"), abs=1e-4)
+    # gz variant
+    pz = str(tmp_path / "vectors.txt.gz")
+    WordVectorSerializer.write_word_vectors(w2v, pz)
+    assert WordVectorSerializer.read_word_vectors(pz).vocab.words == w2v.vocab.words

@@ -283,3 +283,19 @@ def test_rnn_time_step_matches_full_forward(rng):
     net.rnn_clear_previous_state()
     again = np.asarray(net.rnn_time_step(x[:, 0]))
     np.testing.assert_allclose(again, steps[0], atol=1e-6)
+
+
+def test_graves_bidirectional_lstm_layer(rng):
+    from deeplearning4j_tpu.nn.recurrent import (
+        Bidirectional, GravesBidirectionalLSTM, GravesLSTM)
+
+    layer = GravesBidirectionalLSTM(n_in=4, n_out=6)
+    params, state = layer.initialize(jax.random.PRNGKey(0), (5, 4))
+    x = jnp.asarray(rng.standard_normal((2, 5, 4)), jnp.float32)
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 5, 12)  # concat of both directions
+    # equals the explicit Bidirectional(GravesLSTM) with the same key
+    ref = Bidirectional(layer=GravesLSTM(n_in=4, n_out=6), mode="concat")
+    rp, rs = ref.initialize(jax.random.PRNGKey(0), (5, 4))
+    ry, _ = ref.apply(rp, rs, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry), atol=1e-6)

@@ -199,3 +199,86 @@ def test_early_stopping_score_improvement_patience(rng):
     # 10.0 improvement per epoch is unattainable → patience trips after 3 epochs
     assert result.total_epochs <= 4
     assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+
+def test_sharded_checkpointer_roundtrip(tmp_path, rng):
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.util import ShardedCheckpointer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    xs = rng.standard_normal((16, 4)).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    net.fit(xs, ys, epochs=3)
+
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"), keep=2)
+    ckpt.save(net.iteration, net)
+    net.fit(xs, ys, epochs=2)
+    ckpt.save(net.iteration, net)
+    assert len(ckpt.all_steps()) == 2
+    assert ckpt.latest_step() == net.iteration
+
+    # restore the earlier step into a fresh net: params + iteration round-trip
+    net2 = MultiLayerNetwork(conf).init()
+    ckpt.restore(net2, step=ckpt.all_steps()[0])
+    assert net2.iteration == ckpt.all_steps()[0]
+    import jax.numpy as jnp
+
+    # Adam moments restored: one more identical fit step matches exactly
+    net3 = MultiLayerNetwork(conf).init()
+    ckpt.restore(net3, step=ckpt.all_steps()[0])
+    net2._fit_batch(jnp.asarray(xs), jnp.asarray(ys))
+    net3._fit_batch(jnp.asarray(xs), jnp.asarray(ys))
+    np.testing.assert_allclose(np.asarray(net2.params[0]["W"]),
+                               np.asarray(net3.params[0]["W"]), atol=1e-7)
+    ckpt.close()
+
+
+def test_fault_tolerant_trainer_recovers(tmp_path, rng):
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.util import FaultTolerantTrainer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    xs = rng.standard_normal((32, 4)).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+
+    class FlakyIterator:
+        """Fails once mid-epoch after a checkpoint exists (simulated device
+        failure), then works."""
+
+        def __init__(self):
+            self.failures = 0
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            from deeplearning4j_tpu.data import DataSet
+
+            for i in range(6):
+                if self.failures == 0 and net.iteration >= 3:
+                    self.failures += 1
+                    raise RuntimeError("simulated device failure")
+                yield DataSet(xs, ys)
+
+    trainer = FaultTolerantTrainer(net, str(tmp_path / "ft"),
+                                   checkpoint_every=2, max_restarts=2)
+    trainer.fit(FlakyIterator(), epochs=2)
+    assert trainer.ckpt.latest_step() is not None
+    assert net.epoch >= 2
+    assert np.isfinite(float(net.score_value))
