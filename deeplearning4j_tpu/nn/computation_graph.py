@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import inspect
 import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -197,6 +198,16 @@ class GraphBuilder:
         )
 
 
+def _first_mask(ds, singular: str, plural: str):
+    """DataSet carries one mask; MultiDataSet a list (the shared-mask case —
+    one sequence mask across inputs — takes the first)."""
+    m = getattr(ds, singular, None)
+    if m is not None:
+        return m
+    ms = getattr(ds, plural, None)
+    return ms[0] if ms else None
+
+
 class ComputationGraph:
     """DAG network runtime (ComputationGraph.java parity). The whole
     forward+backward+updater step is one jitted XLA program."""
@@ -303,7 +314,34 @@ class ComputationGraph:
             return xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=-1)
         return xs
 
-    def _forward(self, params, states, inputs, *, training, keys=None):
+    def _loss_mask_kw(self, node, mask, label_mask, x):
+        """compute_loss mask gate: label mask falls back to the feature mask;
+        same shape/signature rule as :meth:`_mask_kw`."""
+        lm = label_mask if label_mask is not None else mask
+        if (
+            lm is not None
+            and getattr(x, "ndim", 0) == 3
+            and lm.shape[:2] == x.shape[:2]
+            and "mask" in inspect.signature(node.compute_loss).parameters
+        ):
+            return {"mask": lm}
+        return {}
+
+    def _mask_kw(self, node, mask, x):
+        """Mask threading rule (feedForwardMaskArrays parity, same shape gate
+        as MultiLayerNetwork): a (B,T) mask reaches layers that accept one
+        while activations keep a matching (B,T,...) leading shape."""
+        if (
+            mask is not None
+            and getattr(x, "ndim", 0) == 3
+            and mask.shape[:2] == x.shape[:2]
+            and "mask" in inspect.signature(node.apply).parameters
+        ):
+            return {"mask": mask}
+        return {}
+
+    def _forward(self, params, states, inputs, *, training, keys=None,
+                 mask=None):
         """inputs: dict name->array. Returns (dict name->activation, states)."""
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
@@ -311,9 +349,10 @@ class ComputationGraph:
         for n in self.topo:
             if n.is_layer:
                 k = keys[n.name] if keys is not None else None
+                x = self._gather_input(acts, n)
                 h, ns = n.node.apply(
-                    cparams[n.name], states[n.name], self._gather_input(acts, n),
-                    training=training, key=k,
+                    cparams[n.name], states[n.name], x,
+                    training=training, key=k, **self._mask_kw(n.node, mask, x),
                 )
                 acts[n.name] = h
                 new_states[n.name] = ns
@@ -321,9 +360,11 @@ class ComputationGraph:
                 acts[n.name] = n.node.apply(*self._gather_input(acts, n))
         return acts, new_states
 
-    def _loss(self, params, states, inputs, labels, keys, weights=None):
+    def _loss(self, params, states, inputs, labels, keys, weights=None,
+              mask=None, label_mask=None):
         """Sum of output-layer losses + regularization. labels: dict
-        output-name -> labels array."""
+        output-name -> labels array. ``mask``/``label_mask``: (B,T) feature/
+        label masks for sequence graphs (single shared mask, like MLN)."""
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
         new_states = dict(states)
@@ -342,6 +383,7 @@ class ComputationGraph:
                 out_loss = n.node.compute_loss(
                     cparams[n.name], states[n.name], x, labels[n.name],
                     training=True, key=keys[n.name], weights=weights,
+                    **self._loss_mask_kw(n.node, mask, label_mask, x),
                 )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32)
@@ -350,7 +392,7 @@ class ComputationGraph:
             else:
                 h, ns = n.node.apply(
                     cparams[n.name], states[n.name], x, training=True,
-                    key=keys[n.name],
+                    key=keys[n.name], **self._mask_kw(n.node, mask, x),
                 )
                 acts[n.name] = h
                 new_states[n.name] = ns
@@ -371,7 +413,8 @@ class ComputationGraph:
         in_name = self.conf.inputs[0]
         out_name = self.conf.outputs[0]
 
-        def step(params, states, opt_states, iteration, inputs, labels, key, weights=None):
+        def step(params, states, opt_states, iteration, inputs, labels, key,
+                 weights=None, mask=None, label_mask=None):
             # Raw arrays (e.g. from ParallelWrapper) → dict form, for
             # single-input/single-output graphs.
             if not isinstance(inputs, dict):
@@ -381,7 +424,7 @@ class ComputationGraph:
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
             (loss, new_states), grads = jax.value_and_grad(self._loss, has_aux=True)(
-                params, states, inputs, labels, keys, weights
+                params, states, inputs, labels, keys, weights, mask, label_mask
             )
             new_params, new_opts = dict(params), dict(opt_states)
             for name in layer_names:
@@ -397,18 +440,24 @@ class ComputationGraph:
 
         if weighted:
             return step
-        return lambda params, states, opt_states, iteration, inputs, labels, key: step(
-            params, states, opt_states, iteration, inputs, labels, key
+        return lambda params, states, opt_states, iteration, inputs, labels, \
+            key, mask=None, label_mask=None: step(
+            params, states, opt_states, iteration, inputs, labels, key,
+            mask=mask, label_mask=label_mask,
         )
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(x, y) | fit([x1, x2], [y1, ...]) | fit(iterator)."""
+        """fit(x, y) | fit([x1, x2], [y1, ...]) | fit(DataSet) | fit(iterator)."""
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(data, labels)
                 self._end_epoch()
             return self
+        if isinstance(data, (DataSet, MultiDataSet)):  # fit(DataSet) parity
+            data = [data]
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
@@ -416,7 +465,9 @@ class ComputationGraph:
                 feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
                 labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
                 self._fit_batch(
-                    [jnp.asarray(f) for f in feats], [jnp.asarray(l) for l in labs]
+                    [jnp.asarray(f) for f in feats], [jnp.asarray(l) for l in labs],
+                    mask=_first_mask(ds, "features_mask", "features_masks"),
+                    label_mask=_first_mask(ds, "labels_mask", "labels_masks"),
                 )
             self._end_epoch()
         return self
@@ -427,7 +478,7 @@ class ComputationGraph:
             if hasattr(lst, "on_epoch_end"):
                 lst.on_epoch_end(self)
 
-    def _fit_batch(self, features, labels):
+    def _fit_batch(self, features, labels, mask=None, label_mask=None):
         if not isinstance(features, (list, tuple)):
             features = [features]
         if not isinstance(labels, (list, tuple)):
@@ -438,6 +489,8 @@ class ComputationGraph:
         self.params, self.states, self.opt_states, loss = self._train_step(
             self.params, self.states, self.opt_states,
             jnp.asarray(self.iteration), inputs, labs, sub,
+            mask=None if mask is None else jnp.asarray(mask),
+            label_mask=None if label_mask is None else jnp.asarray(label_mask),
         )
         self.score_value = loss
         self.iteration += 1
@@ -457,14 +510,16 @@ class ComputationGraph:
 
         return fwd
 
-    def output(self, *inputs, train: bool = False):
+    def output(self, *inputs, train: bool = False, mask=None):
         """Forward pass; returns a list of output activations (or a single
         array when the graph has one output — DL4J returns INDArray[]).
         ``train=True`` uses training-mode statistics but no dropout (no RNG
-        threaded, matching the reference's output(train))."""
+        threaded, matching the reference's output(train)). ``mask``: (B,T)
+        feature mask for sequence graphs."""
         ins = dict(zip(self.conf.inputs, [jnp.asarray(x) for x in inputs]))
         fwd = self._forward_train_jit if train else self._forward_jit
-        acts, _ = fwd(self.params, self.states, ins)
+        acts, _ = fwd(self.params, self.states, ins,
+                      mask=None if mask is None else jnp.asarray(mask))
         outs = [acts[name] for name in self.conf.outputs]
         return outs[0] if len(outs) == 1 else outs
 
@@ -474,14 +529,22 @@ class ComputationGraph:
         acts, _ = self._forward_jit(self.params, self.states, ins)
         return acts
 
-    def score(self, dataset=None, x=None, y=None) -> float:
+    def score(self, dataset=None, x=None, y=None, mask=None,
+              label_mask=None) -> float:
         if dataset is not None:
             x, y = dataset.features, dataset.labels
+            if mask is None:
+                mask = _first_mask(dataset, "features_mask", "features_masks")
+            if label_mask is None:
+                label_mask = _first_mask(dataset, "labels_mask", "labels_masks")
         feats = x if isinstance(x, (list, tuple)) else [x]
         labs = y if isinstance(y, (list, tuple)) else [y]
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in feats]))
         labels = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labs]))
-        loss = self._loss_eval(self.params, self.states, inputs, labels)
+        loss = self._loss_eval(
+            self.params, self.states, inputs, labels,
+            None if mask is None else jnp.asarray(mask),
+            None if label_mask is None else jnp.asarray(label_mask))
         return float(loss)
 
     @functools.cached_property
@@ -490,7 +553,7 @@ class ComputationGraph:
         MultiLayerNetwork.score parity."""
         out_names = set(self.conf.outputs)
 
-        def eval_loss(params, states, inputs, labels):
+        def eval_loss(params, states, inputs, labels, mask, label_mask):
             acts = {k: self._cast(v) for k, v in inputs.items()}
             cparams = self._cast_params(params)
             loss = 0.0
@@ -503,11 +566,13 @@ class ComputationGraph:
                     loss = loss + n.node.compute_loss(
                         cparams[n.name], states[n.name], x, labels[n.name],
                         training=False,
+                        **self._loss_mask_kw(n.node, mask, label_mask, x),
                     )
                     acts[n.name] = x
                 else:
                     h, _ = n.node.apply(
-                        cparams[n.name], states[n.name], x, training=False
+                        cparams[n.name], states[n.name], x, training=False,
+                        **self._mask_kw(n.node, mask, x)
                     )
                     acts[n.name] = h
             return loss
@@ -523,7 +588,8 @@ class ComputationGraph:
             iterator.reset()
         for ds in iterator:
             feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
-            preds = self.output(*feats)
+            preds = self.output(*feats,
+                                mask=getattr(ds, "features_mask", None))
             p0 = preds[0] if isinstance(preds, list) else preds
             l0 = ds.labels[0] if isinstance(ds.labels, (list, tuple)) else ds.labels
             ev.eval(np.asarray(l0), np.asarray(p0))

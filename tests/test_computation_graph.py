@@ -290,3 +290,37 @@ def test_graph_gradients_match_fd(rng):
 
     res = check_model_gradients(loss_fn, net.params)
     assert res.passed, repr(res)
+
+
+def test_graph_mask_threading_and_fit_dataset(rng):
+    """Sequence graph with attention: (B,T) masks reach mask-aware layers and
+    the per-step loss; fit(DataSet) works (ComputationGraph mask parity)."""
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.nn.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.recurrent import RnnOutputLayer
+
+    gb = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01))
+          .graph_builder().add_inputs("in"))
+    gb.add_layer("attn", SelfAttentionLayer(n_in=4, n_out=6, n_heads=2), "in")
+    gb.add_layer("out", RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                       activation="softmax"), "attn")
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.recurrent(4, 5))
+    net = ComputationGraph(gb.build()).init()
+
+    x = rng.standard_normal((3, 5, 4)).astype(np.float32)
+    mask = np.ones((3, 5), np.float32)
+    mask[0, 3:] = 0
+    # masked keys don't leak into valid positions
+    y1 = np.asarray(net.output(x, mask=mask))
+    x2 = x.copy()
+    x2[0, 3:] += 50.0
+    y2 = np.asarray(net.output(x2, mask=mask))
+    np.testing.assert_allclose(y1[0, :3], y2[0, :3], atol=1e-4)
+
+    ids = rng.integers(0, 3, size=(3, 5))
+    labels = np.eye(3, dtype=np.float32)[ids]
+    ds = DataSet(x, labels, features_mask=mask, labels_mask=mask.copy())
+    s0 = net.score(ds)
+    net.fit(ds, epochs=12)
+    assert net.score(ds) < s0
