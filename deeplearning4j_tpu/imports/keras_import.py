@@ -6,8 +6,10 @@ KerasSequentialModel.java, KerasModel.java, with ~50 KerasLayer subclasses
 under layers/**) — SURVEY.md §2.2 J13 — path-cite, mount empty this round.
 
 Reads the Keras v2 HDF5 format (h5py): ``model_config`` JSON attr +
-``model_weights`` groups. Sequential models map onto MultiLayerNetwork,
-functional single-path models too; the supported layer set mirrors the
+``model_weights`` groups. Sequential (and single-path functional) models map
+onto MultiLayerNetwork; functional DAGs map onto ComputationGraph with
+Add/Subtract/Multiply/Average/Max/Min/Concatenate merge layers becoming
+vertices (KerasModel.java parity). The supported layer set mirrors the
 reference's core coverage (Dense, Conv2D, SeparableConv2D,
 MaxPooling2D/AveragePooling2D, BatchNormalization,
 Dropout, Flatten, Activation, Embedding, LSTM, GRU, SimpleRNN,
@@ -112,17 +114,44 @@ def _read_weights(grp) -> Dict[str, List[np.ndarray]]:
     return out
 
 
+def _inbound_names(layer_cfg):
+    """Source layer names from Keras inbound_nodes (v2 list format or v3
+    __keras_tensor__/keras_history format)."""
+    names = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            hist = obj.get("config", {}).get("keras_history")
+            if obj.get("class_name") == "__keras_tensor__" and hist:
+                names.append(hist[0])
+            else:
+                for v in obj.values():
+                    walk(v)
+        elif isinstance(obj, (list, tuple)):
+            if (len(obj) >= 3 and isinstance(obj[0], str)
+                    and isinstance(obj[1], int)):  # v2 [name, node, tensor, ...]
+                names.append(obj[0])
+            else:
+                for v in obj:
+                    walk(v)
+
+    walk(layer_cfg.get("inbound_nodes", []))
+    return names
+
+
+def _is_dag(layer_cfgs) -> bool:
+    return any(len(_inbound_names(lc)) > 1 or
+               (lc["class_name"] in _MERGE_VERTICES) for lc in layer_cfgs)
+
+
 def _build(config, weights):
     cls = config["class_name"]
     if cls == "Sequential":
         layer_cfgs = config["config"]["layers"]
     elif cls in ("Model", "Functional"):
         layer_cfgs = config["config"]["layers"]
-        # single-path functional models only (DAGs → ComputationGraph later)
-        for lc in layer_cfgs:
-            ib = lc.get("inbound_nodes", [])
-            if ib and isinstance(ib[0], list) and len(ib[0]) > 1:
-                raise KerasImportError("functional DAG models not supported yet")
+        if _is_dag(layer_cfgs):
+            return _build_functional(config, weights)
     else:
         raise KerasImportError(f"unsupported model class {cls}")
 
@@ -168,6 +197,65 @@ def _build(config, weights):
 
 
 # ------------------------------------------------------------ layer builders
+
+
+_MERGE_VERTICES = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
+                   "Average": "avg", "Maximum": "max", "Minimum": "min",
+                   "Concatenate": None}
+
+
+def _build_functional(config, weights):
+    """Functional DAG → ComputationGraph (KerasModel.java parity). Merge
+    layers map to vertices; imports are inference-ready (replace the head
+    with an OutputLayer via TransferLearning-style surgery to train)."""
+    from deeplearning4j_tpu.nn import ComputationGraph
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+
+    cfgd = config["config"]
+    layer_cfgs = cfgd["layers"]
+    gb = NeuralNetConfiguration.builder().seed(0).graph_builder()
+    input_shapes = []
+    param_map = {}
+    state_map = {}
+    for lc in layer_cfgs:
+        kcls = lc["class_name"]
+        cfg = lc.get("config", {})
+        name = cfg.get("name", kcls)
+        inbound = _inbound_names(lc)
+        if kcls == "InputLayer":
+            shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+            gb.add_inputs(name)
+            input_shapes.append(tuple(shape[1:]))
+            continue
+        if kcls in _MERGE_VERTICES:
+            op = _MERGE_VERTICES[kcls]
+            vertex = MergeVertex() if op is None else ElementWiseVertex(op=op)
+            gb.add_vertex(name, vertex, *inbound)
+            continue
+        built = _LAYER_BUILDERS.get(kcls)
+        if built is None:
+            raise KerasImportError(f"unsupported Keras layer {kcls!r} ({name})")
+        out = built(cfg, weights.get(name, []))
+        lyr, p = out[0], out[1]
+        st = out[2] if len(out) > 2 else {}
+        if lyr is None:
+            raise KerasImportError(
+                f"layer {kcls!r} has no graph equivalent here ({name})")
+        gb.add_layer(name, lyr, *inbound)
+        param_map[name] = p
+        state_map[name] = st
+    outs = cfgd.get("output_layers", [])
+    out_names = ([o[0] for o in outs] if outs and isinstance(outs[0], list)
+                 else [outs[0]] if outs else [layer_cfgs[-1]["config"]["name"]])
+    gb.set_outputs(*out_names)
+    gb.set_input_types(*input_shapes)
+    net = ComputationGraph(gb.build()).init()
+    for name, p in param_map.items():
+        for k, v in p.items():
+            net.params[name][k] = np.asarray(v)
+        for k, v in state_map.get(name, {}).items():
+            net.states[name][k] = np.asarray(v)
+    return net
 
 
 def _dense(cfg, w):

@@ -88,3 +88,38 @@ def test_unsupported_layer_reports_name(rng, tmp_path):
     m.save(path)
     with pytest.raises(ValueError, match="ConvLSTM1D"):
         KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_functional_dag_import(rng, tmp_path):
+    inp = tf.keras.Input((8,), name="in0")
+    a = tf.keras.layers.Dense(4, activation="relu", name="d1")(inp)
+    b = tf.keras.layers.Dense(4, activation="relu", name="d2")(inp)
+    m = tf.keras.layers.Add(name="add")([a, b])
+    c = tf.keras.layers.Concatenate(name="cat")([m, a])
+    out = tf.keras.layers.Dense(3, activation="softmax", name="out")(c)
+    model = tf.keras.Model(inp, out)
+    path = str(tmp_path / "f.h5")
+    model.save(path)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    golden = np.asarray(model(x))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, golden, atol=1e-5, rtol=1e-4)
+
+
+def test_functional_cnn_residual_import(rng, tmp_path):
+    inp = tf.keras.Input((8, 8, 3), name="img")
+    h = tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu",
+                               name="c1")(inp)
+    r = tf.keras.layers.Conv2D(4, 3, padding="same", name="c2")(h)
+    s = tf.keras.layers.Add(name="res")([h, r])
+    g = tf.keras.layers.GlobalAveragePooling2D(name="gap")(s)
+    out = tf.keras.layers.Dense(2, activation="softmax", name="head")(g)
+    model = tf.keras.Model(inp, out)
+    path = str(tmp_path / "r.h5")
+    model.save(path)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    golden = np.asarray(model(x))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                               atol=1e-4, rtol=1e-4)
