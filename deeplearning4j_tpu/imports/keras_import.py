@@ -139,9 +139,28 @@ def _inbound_names(layer_cfg):
     return names
 
 
-def _is_dag(layer_cfgs) -> bool:
-    return any(len(_inbound_names(lc)) > 1 or
-               (lc["class_name"] in _MERGE_VERTICES) for lc in layer_cfgs)
+def _n_call_nodes(layer_cfg) -> int:
+    """Number of call nodes (a weight-shared layer is called more than once)."""
+    return len(layer_cfg.get("inbound_nodes", []) or [])
+
+
+def _is_dag(config) -> bool:
+    """True when the functional graph is not a simple chain: merges,
+    multi-inbound layers, multiple outputs, or any edge that skips the
+    immediately preceding layer (fan-out)."""
+    layer_cfgs = config["config"]["layers"]
+    outs = config["config"].get("output_layers") or []
+    if isinstance(outs, list) and outs and isinstance(outs[0], list) and len(outs) > 1:
+        return True
+    prev = None
+    for lc in layer_cfgs:
+        inbound = _inbound_names(lc)
+        if len(inbound) > 1 or lc["class_name"] in _MERGE_VERTICES:
+            return True
+        if inbound and prev is not None and inbound[0] != prev:
+            return True
+        prev = lc.get("config", {}).get("name", lc["class_name"])
+    return False
 
 
 def _build(config, weights):
@@ -150,7 +169,7 @@ def _build(config, weights):
         layer_cfgs = config["config"]["layers"]
     elif cls in ("Model", "Functional"):
         layer_cfgs = config["config"]["layers"]
-        if _is_dag(layer_cfgs):
+        if _is_dag(config):
             return _build_functional(config, weights)
     else:
         raise KerasImportError(f"unsupported model class {cls}")
@@ -217,11 +236,16 @@ def _build_functional(config, weights):
     input_shapes = []
     param_map = {}
     state_map = {}
+    rename = {}  # pass-through layers (Flatten) alias to their inbound
     for lc in layer_cfgs:
         kcls = lc["class_name"]
         cfg = lc.get("config", {})
         name = cfg.get("name", kcls)
-        inbound = _inbound_names(lc)
+        if _n_call_nodes(lc) > 1:
+            raise KerasImportError(
+                f"layer {name!r} is called {_n_call_nodes(lc)} times "
+                "(weight sharing) — not supported")
+        inbound = [rename.get(i, i) for i in _inbound_names(lc)]
         if kcls == "InputLayer":
             shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
             gb.add_inputs(name)
@@ -238,15 +262,16 @@ def _build_functional(config, weights):
         out = built(cfg, weights.get(name, []))
         lyr, p = out[0], out[1]
         st = out[2] if len(out) > 2 else {}
-        if lyr is None:
-            raise KerasImportError(
-                f"layer {kcls!r} has no graph equivalent here ({name})")
+        if lyr is None:  # pass-through (Flatten): downstream reads its input
+            rename[name] = inbound[0]
+            continue
         gb.add_layer(name, lyr, *inbound)
         param_map[name] = p
         state_map[name] = st
     outs = cfgd.get("output_layers", [])
     out_names = ([o[0] for o in outs] if outs and isinstance(outs[0], list)
                  else [outs[0]] if outs else [layer_cfgs[-1]["config"]["name"]])
+    out_names = [rename.get(o, o) for o in out_names]
     gb.set_outputs(*out_names)
     gb.set_input_types(*input_shapes)
     net = ComputationGraph(gb.build()).init()

@@ -123,3 +123,50 @@ def test_functional_cnn_residual_import(rng, tmp_path):
     net = KerasModelImport.import_keras_model_and_weights(path)
     np.testing.assert_allclose(np.asarray(net.output(x)), golden,
                                atol=1e-4, rtol=1e-4)
+
+
+def test_functional_fanout_two_heads(rng, tmp_path):
+    # fan-out without a merge: must route through ComputationGraph, not the
+    # sequential path (regression: chain heuristic misclassified this)
+    inp = tf.keras.Input((6,), name="x")
+    h = tf.keras.layers.Dense(5, activation="relu", name="trunk")(inp)
+    o1 = tf.keras.layers.Dense(5, activation="softmax", name="o1")(h)
+    o2 = tf.keras.layers.Dense(5, activation="softmax", name="o2")(h)
+    model = tf.keras.Model(inp, [o1, o2])
+    path = str(tmp_path / "two.h5")
+    model.save(path)
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    g1, g2 = [np.asarray(t) for t in model(x)]
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    p1, p2 = [np.asarray(p) for p in net.output(x)]
+    np.testing.assert_allclose(p1, g1, atol=1e-5)
+    np.testing.assert_allclose(p2, g2, atol=1e-5)
+
+
+def test_functional_flatten_head(rng, tmp_path):
+    inp = tf.keras.Input((6, 6, 2), name="img")
+    h = tf.keras.layers.Conv2D(3, 3, padding="same", activation="relu",
+                               name="c")(inp)
+    s = tf.keras.layers.Add(name="skip")([h, h])
+    f = tf.keras.layers.Flatten(name="flat")(s)
+    out = tf.keras.layers.Dense(4, activation="softmax", name="head")(f)
+    model = tf.keras.Model(inp, out)
+    path = str(tmp_path / "fl.h5")
+    model.save(path)
+    x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+    golden = np.asarray(model(x))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.output(x)), golden,
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_functional_weight_sharing_rejected(rng, tmp_path):
+    inp1 = tf.keras.Input((4,), name="a")
+    inp2 = tf.keras.Input((4,), name="b")
+    shared = tf.keras.layers.Dense(3, name="shared")
+    m = tf.keras.layers.Concatenate(name="cat")([shared(inp1), shared(inp2)])
+    model = tf.keras.Model([inp1, inp2], tf.keras.layers.Dense(2, name="o")(m))
+    path = str(tmp_path / "sh.h5")
+    model.save(path)
+    with pytest.raises(ValueError, match="shared"):
+        KerasModelImport.import_keras_model_and_weights(path)
