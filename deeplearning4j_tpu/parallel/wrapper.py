@@ -17,7 +17,11 @@ an opt-in for DCN in parallel.compression).
 
 from __future__ import annotations
 
-from typing import Optional
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -107,13 +111,21 @@ class ParallelInference:
     """
 
     def __init__(self, model, mesh: Optional[TrainingMesh] = None,
-                 batch_limit: int = 1024):
+                 batch_limit: int = 1024, batch_timeout_ms: float = 3.0,
+                 queue_limit: int = 256):
         self.model = model
         self.mesh = mesh or TrainingMesh(data=len(jax.devices()))
         self.batch_limit = batch_limit
+        self.batch_timeout_ms = batch_timeout_ms
         self._params = self.mesh.replicate(model.params)
         self._states = self.mesh.replicate(model.states)
         self._fwd = jax.jit(model.make_forward_fn())
+        self._queue: "queue.Queue[Tuple[np.ndarray, Future]]" = queue.Queue(
+            maxsize=queue_limit)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._shut_down = False
 
     def output(self, x):
         x = np.asarray(x)
@@ -133,3 +145,85 @@ class ParallelInference:
         xs = self.mesh.shard_batch(x)
         out = self._fwd(self._params, self._states, xs)
         return np.asarray(out)[:n]
+
+    # ----------------------------------------------------- dynamic batching
+    def output_async(self, x) -> "Future":
+        """Queue a request; a background thread coalesces pending requests
+        into one device batch (the reference's observable-queue batching in
+        ParallelInference.java). Returns a Future of the predictions."""
+        with self._worker_lock:
+            if self._shut_down:
+                raise RuntimeError("ParallelInference shut down")
+            if self._worker is None:
+                self._start_worker()
+            fut: Future = Future()
+            self._queue.put((np.asarray(x), fut))
+        return fut
+
+    @staticmethod
+    def _resolve(fut: Future, value=None, exc=None):
+        """Set a future's outcome, tolerating caller-side cancel()."""
+        if not fut.set_running_or_notify_cancel():
+            return  # cancelled before we got to it
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+
+    def _start_worker(self):
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                batch: List[Tuple[np.ndarray, Future]] = [first]
+                total = len(first[0])
+                deadline = self.batch_timeout_ms / 1e3
+                t0 = time.monotonic()
+                while total < self.batch_limit:
+                    remaining = deadline - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    batch.append(item)
+                    total += len(item[0])
+                # the WHOLE batch body is guarded: a bad request (wrong
+                # rank/width) must fail its batch, never kill the worker
+                try:
+                    xs = np.concatenate([b[0] for b in batch], axis=0)
+                    preds = self.output(xs)
+                    off = 0
+                    for arr, fut in batch:
+                        self._resolve(fut, value=preds[off:off + len(arr)])
+                        off += len(arr)
+                except Exception as e:
+                    for _, fut in batch:
+                        if not fut.done():
+                            self._resolve(fut, exc=e)
+
+        self._worker = threading.Thread(target=run, daemon=True)
+        self._worker.start()
+
+    def shutdown(self):
+        """Stop the batching worker (failing any queued requests); later
+        output_async calls raise instead of hanging."""
+        with self._worker_lock:
+            self._shut_down = True
+            self._stop.set()
+            worker = self._worker
+            self._worker = None
+        if worker is not None:
+            worker.join(timeout=2.0)
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                self._resolve(fut, exc=RuntimeError("ParallelInference shut down"))

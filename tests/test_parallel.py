@@ -150,3 +150,69 @@ def test_fit_array_epochs_honored(rng):
     net = _net()
     net.fit(xs, ys, epochs=5)
     assert net.iteration == 5
+
+
+def test_parallel_inference_dynamic_batching(rng):
+    """output_async coalesces concurrent requests into shared device batches
+    and routes each caller its own slice (ParallelInference queue parity)."""
+    import threading
+
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, batch_timeout_ms=20.0)
+    xs = [rng.standard_normal((n, 4)).astype(np.float32) for n in (1, 3, 2, 5)]
+    expected = [np.asarray(net.output(x)) for x in xs]
+
+    futs = [pi.output_async(x) for x in xs]
+    for f, exp in zip(futs, expected):
+        np.testing.assert_allclose(np.asarray(f.result(timeout=30)), exp,
+                                   atol=1e-5)
+
+    # concurrent submitters
+    results = {}
+
+    def submit(i):
+        results[i] = pi.output_async(xs[i % len(xs)]).result(timeout=30)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, r in results.items():
+        np.testing.assert_allclose(np.asarray(r), expected[i % len(xs)],
+                                   atol=1e-5)
+    pi.shutdown()
+
+
+def test_parallel_inference_bad_request_fails_batch_not_worker(rng):
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import ParallelInference
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, batch_timeout_ms=1.0)
+    bad = pi.output_async(rng.standard_normal((2, 7)).astype(np.float32))
+    with pytest.raises(Exception):
+        bad.result(timeout=30)
+    # the worker survived: a good request still completes
+    good = pi.output_async(rng.standard_normal((2, 4)).astype(np.float32))
+    assert np.asarray(good.result(timeout=30)).shape == (2, 3)
+    pi.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        pi.output_async(rng.standard_normal((1, 4)).astype(np.float32))
