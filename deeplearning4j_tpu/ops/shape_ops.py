@@ -257,7 +257,31 @@ def depth_to_space(x, block_size, data_format="NHWC"):
 
 @op("batch_to_space", "shape")
 def batch_to_space(x, block_shape, crops):
-    raise NotImplementedError("batch_to_space: pending TF-import milestone")
+    """Inverse of space_to_batch (TF batch_to_space_nd semantics): moves
+    block factors from the batch dim back into the spatial dims, then crops."""
+    block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+    crops = [(int(a), int(b)) for a, b in np.atleast_2d(crops)]
+    if any(c0 < 0 or c1 < 0 for c0, c1 in crops):
+        raise ValueError(f"crops must be non-negative, got {crops}")
+    m = len(block_shape)
+    b = x.shape[0]
+    prod = int(np.prod(block_shape))
+    if b % prod:
+        raise ValueError(f"batch {b} not divisible by prod(block_shape)={prod}")
+    spatial = x.shape[1:1 + m]
+    rest = x.shape[1 + m:]
+    # (b0..bm-1, B', s0..sm-1, rest) → interleave block factors into spatial
+    y = x.reshape(tuple(block_shape) + (b // prod,) + spatial + rest)
+    perm = [m]
+    for i in range(m):
+        perm.extend([m + 1 + i, i])
+    perm.extend(range(1 + 2 * m, 1 + 2 * m + len(rest)))
+    y = jnp.transpose(y, perm)
+    y = y.reshape((b // prod,) + tuple(s * bs for s, bs in zip(spatial, block_shape)) + rest)
+    idx = (slice(None),) + tuple(
+        slice(c0, y.shape[1 + i] - c1) for i, (c0, c1) in enumerate(crops)
+    )
+    return y[idx]
 
 
 @op("segment_sum", "segment", differentiable=False)
