@@ -358,12 +358,18 @@ def _perm_gates(arr, order, n):
     return np.concatenate([blocks[i] for i in order], axis=-1)
 
 
+def _recurrent_act(cfg):
+    """recurrent_activation with _act()'s semantics: dict unwrap + raise on
+    unsupported names (no silent sigmoid substitution)."""
+    return _act({"activation": cfg.get("recurrent_activation", "sigmoid")},
+                default="sigmoid")
+
+
 def _lstm(cfg, w):
     units = cfg["units"]
     lyr = R.LSTM(n_in=int(w[0].shape[0]) if w else 0, n_out=units,
                  activation=_act(cfg, "tanh"),
-                 gate_activation=_ACT.get(cfg.get("recurrent_activation",
-                                                  "sigmoid"), "sigmoid"))
+                 gate_activation=_recurrent_act(cfg))
     p = {}
     if w:
         # keras gate order [i,f,c,o] -> ours [i,f,o,g(c)]
@@ -384,7 +390,9 @@ def _gru(cfg, w):
         raise KerasImportError("GRU reset_after=False not supported; "
                                "re-save with reset_after=True (the default)")
     lyr = R.GRU(n_in=int(w[0].shape[0]) if w else 0, n_out=units,
-                activation=_act(cfg, "tanh"), recurrent_bias=True)
+                activation=_act(cfg, "tanh"),
+                gate_activation=_recurrent_act(cfg),
+                recurrent_bias=True)
     p = {}
     if w:
         # keras gate order [z,r,h] -> ours [r,z,n]

@@ -270,7 +270,7 @@ class ComputationGraph:
             for name in self._updaters
         }
         self._shape_of = shape_of
-        self._train_step = jax.jit(self.make_step_fn(), donate_argnums=(0, 1, 2))
+        self._train_step = self._jit_train_step()
         self._forward_jit = jax.jit(functools.partial(self._forward, training=False))
         self._forward_train_jit = jax.jit(functools.partial(self._forward, training=True))
         return self
@@ -407,6 +407,9 @@ class ComputationGraph:
         return loss + reg, new_states
 
     # ------------------------------------------------------------ train step
+    def _jit_train_step(self):
+        return jax.jit(self.make_step_fn(), donate_argnums=(0, 1, 2))
+
     def make_step_fn(self, weighted: bool = False):
         updaters = self._updaters
         layer_names = [n.name for n in self.topo if n.is_layer]
@@ -486,6 +489,8 @@ class ComputationGraph:
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
         labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
         self._rng_key, sub = jax.random.split(self._rng_key)
+        if self._train_step is None:  # cleared by external training masters
+            self._train_step = self._jit_train_step()
         self.params, self.states, self.opt_states, loss = self._train_step(
             self.params, self.states, self.opt_states,
             jnp.asarray(self.iteration), inputs, labs, sub,

@@ -85,8 +85,12 @@ class MixtureOfExperts(Layer):
             logits = logits + self.router_noise * jax.random.normal(
                 key, logits.shape, logits.dtype)
         if self.top_k < self.n_experts:
-            kth = jnp.sort(logits, axis=-1)[:, -self.top_k][:, None]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            # top_k indices + one-hot mask guarantees EXACTLY top_k experts
+            # even under tied logits (e.g. a zero-init router)
+            _, idx = lax.top_k(logits, self.top_k)  # (N, k)
+            keep = jax.nn.one_hot(idx, self.n_experts,
+                                  dtype=jnp.bool_).any(axis=-2)  # (N, E)
+            logits = jnp.where(keep, logits, -jnp.inf)
         gates = jax.nn.softmax(logits, axis=-1)  # zero where masked
         return gates, logits
 

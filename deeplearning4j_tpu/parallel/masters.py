@@ -147,32 +147,50 @@ class SharedTrainingMaster:
 
     def _build(self, model):
         mesh = self.mesh.mesh
-        n_layers = len(model.layers)
         updaters = model._updaters
         acc = self.accumulator
+        # MLN keys layers by integer index; ComputationGraph by node name.
+        is_graph = isinstance(updaters, dict)
+        if is_graph:
+            if len(model.conf.inputs) != 1 or len(model.conf.outputs) != 1:
+                raise ValueError(
+                    "SharedTrainingMaster supports single-input/single-output "
+                    f"ComputationGraphs only (got {len(model.conf.inputs)} "
+                    f"inputs, {len(model.conf.outputs)} outputs)")
+            layer_keys = [n.name for n in model.topo if n.is_layer]
+            in_name = model.conf.inputs[0]
+            out_name = model.conf.outputs[0]
+        else:
+            layer_keys = list(range(len(model.layers)))
 
         def local_step(params, states, opts, residual, threshold, iteration,
                        x, y, keys, w):
             residual = _unstack_first(residual)
             threshold = threshold[0]
             key = keys[0]
-            lkeys = list(jax.random.split(key, n_layers))
-            (loss, new_states), grads = jax.value_and_grad(
-                model._loss, has_aux=True)(params, states, x, y, lkeys, w)
+            subkeys = jax.random.split(key, len(layer_keys))
+            if is_graph:
+                lkeys = dict(zip(layer_keys, subkeys))
+                (loss, new_states), grads = jax.value_and_grad(
+                    model._loss, has_aux=True)(
+                    params, states, {in_name: x}, {out_name: y}, lkeys, w)
+            else:
+                lkeys = list(subkeys)
+                (loss, new_states), grads = jax.value_and_grad(
+                    model._loss, has_aux=True)(params, states, x, y, lkeys, w)
             quant, new_res, new_thr, _ratio = acc.encode(
                 grads, residual, threshold, iteration)
             shared = jax.tree_util.tree_map(
                 lambda q: lax.pmean(q, "data"), quant)
-            new_params, new_opts = [], []
-            for i in range(n_layers):
-                if not grads[i]:
-                    new_params.append(params[i])
-                    new_opts.append(opts[i])
+            new_params = dict(params) if is_graph else list(params)
+            new_opts = dict(opts) if is_graph else list(opts)
+            for k in layer_keys:
+                if not grads[k]:
                     continue
                 p, s = upd.apply_updater(
-                    updaters[i], params[i], shared[i], opts[i], iteration)
-                new_params.append(p)
-                new_opts.append(s)
+                    updaters[k], params[k], shared[k], opts[k], iteration)
+                new_params[k] = p
+                new_opts[k] = s
             # non-trainable state (batchnorm stats) kept consistent by pmean
             new_states = jax.tree_util.tree_map(
                 lambda v: lax.pmean(v, "data") if jnp.issubdtype(

@@ -70,7 +70,19 @@ class ShardedCheckpointer:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        abstract = jax.tree_util.tree_map(np.asarray, self._state(model))
+
+        def _abstract(x):
+            # ShapeDtypeStruct leaves carry each param's sharding so device-
+            # sharded state restores sharded (no gather through one host);
+            # np.asarray here would materialize full host arrays and raise on
+            # non-fully-addressable multi-host arrays.
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=x.sharding)
+            x = np.asarray(x)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        abstract = jax.tree_util.tree_map(_abstract, self._state(model))
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract))
         model.params = restored["params"]

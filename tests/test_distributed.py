@@ -141,6 +141,69 @@ class TestTrainingMasters:
         np.testing.assert_allclose(np.asarray(net.params[0]["W"]), p0, atol=1e-7)
 
 
+def _graph_classifier_and_data(rng, n=256):
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (
+        ComputationGraph,
+        InputType,
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Adam(0.01))
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d1", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                                      activation="softmax"), "d1")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    centers = rng.standard_normal((3, 4)) * 3.0
+    ys = rng.integers(0, 3, n)
+    xs = (centers[ys] + rng.standard_normal((n, 4))).astype(np.float32)
+    yoh = np.eye(3, dtype=np.float32)[ys]
+    return net, ArrayDataSetIterator(xs, yoh, batch=64), xs, yoh
+
+
+@pytest.mark.multichip
+class TestTrainingMastersComputationGraph:
+    """SparkComputationGraph parity: both masters drive a ComputationGraph."""
+
+    def test_parameter_averaging_graph_then_local_fit(self, rng):
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.parallel import SparkComputationGraph
+
+        net, it, xs, ys = _graph_classifier_and_data(rng)
+        master = ParameterAveragingTrainingMaster(
+            averaging_frequency=2, mesh=TrainingMesh(data=8))
+        s0 = net.score(DataSet(xs, ys))
+        SparkComputationGraph(None, net, master).fit(it, epochs=12)
+        assert net.score(DataSet(xs, ys)) < s0 * 0.5
+        acc = (np.argmax(net.output(xs), 1) == np.argmax(ys, 1)).mean()
+        assert acc > 0.85, acc
+        # regression: master clears _train_step; local fit must lazily re-jit
+        net.fit(xs[:64], ys[:64])
+
+    def test_shared_training_graph_learns(self, rng):
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.parallel import SparkComputationGraph
+
+        net, it, xs, ys = _graph_classifier_and_data(rng)
+        master = SharedTrainingMaster(threshold=1e-3, mesh=TrainingMesh(data=8))
+        s0 = net.score(DataSet(xs, ys))
+        SparkComputationGraph(None, net, master).fit(it, epochs=12)
+        assert net.score(DataSet(xs, ys)) < s0 * 0.5
+        acc = (np.argmax(net.output(xs), 1) == np.argmax(ys, 1)).mean()
+        assert acc > 0.85, acc
+
+
 class TestDistributedBootstrap:
     def test_single_process_noop(self):
         distributed.initialize()  # no coordinator, single process: no-op

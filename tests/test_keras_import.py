@@ -68,6 +68,18 @@ def test_gru(rng, tmp_path):
     _roundtrip(m, x, tmp_path, atol=1e-5)
 
 
+def test_gru_nondefault_recurrent_activation(rng, tmp_path):
+    # regression: recurrent_activation must map to gate_activation, not be
+    # silently dropped (→ sigmoid gates, wrong numerics)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.GRU(6, recurrent_activation="tanh",
+                            return_sequences=True),
+    ])
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    _roundtrip(m, x, tmp_path, atol=1e-5)
+
+
 def test_embedding_pooling(rng, tmp_path):
     m = tf.keras.Sequential([
         tf.keras.layers.Input((9,)),

@@ -41,6 +41,16 @@ class TestMoE:
         res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
         assert res.passed, res
 
+    def test_topk_exact_under_ties(self):
+        # zero-init router → all logits tied; exactly top_k must stay active
+        layer = MixtureOfExperts(n_in=4, n_experts=8, top_k=2, ffn_size=8)
+        params, state = layer.initialize(jax.random.PRNGKey(0), (3, 4))
+        params = dict(params, router=jnp.zeros_like(params["router"]))
+        x = jnp.ones((1, 3, 4), jnp.float32)
+        gates, _ = layer._gates(params, x.reshape(-1, 4), False, None)
+        nz = (np.asarray(gates) > 1e-8).sum(axis=1)
+        assert (nz == 2).all(), nz
+
     def test_aux_loss_balances(self, rng):
         layer = MixtureOfExperts(n_in=4, n_experts=4, top_k=1)
         params, _ = layer.initialize(jax.random.PRNGKey(0), (3, 4))
