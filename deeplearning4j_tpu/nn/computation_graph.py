@@ -223,6 +223,8 @@ class ComputationGraph:
         self.listeners: list = []
         self.score_value: float = float("nan")
         self._train_step = None
+        self._it_dev = None   # device-resident iteration counter
+        self._it_sync = -1    # host iteration the device counter mirrors
         self._updaters: Dict[str, Any] = {}
         for n in self.topo:
             if n.is_layer:
@@ -408,7 +410,20 @@ class ComputationGraph:
 
     # ------------------------------------------------------------ train step
     def _jit_train_step(self):
-        return jax.jit(self.make_step_fn(), donate_argnums=(0, 1, 2))
+        """Iteration counter + RNG-key evolution live INSIDE the jitted step
+        (see MultiLayerNetwork._build_train_step: avoids two host round-trips
+        per step through the remote-chip tunnel)."""
+        base = self.make_step_fn()
+
+        def step(params, states, opt_states, iteration, key, inputs, labels,
+                 mask=None, label_mask=None):
+            new_key, sub = jax.random.split(key)
+            p, s, o, loss = base(params, states, opt_states, iteration,
+                                 inputs, labels, sub,
+                                 mask=mask, label_mask=label_mask)
+            return p, s, o, loss, iteration + 1, new_key
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
     def make_step_fn(self, weighted: bool = False):
         updaters = self._updaters
@@ -488,17 +503,20 @@ class ComputationGraph:
             labels = [labels]
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
         labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
-        self._rng_key, sub = jax.random.split(self._rng_key)
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._jit_train_step()
-        self.params, self.states, self.opt_states, loss = self._train_step(
-            self.params, self.states, self.opt_states,
-            jnp.asarray(self.iteration), inputs, labs, sub,
+        if self._it_dev is None or self._it_sync != self.iteration:
+            self._it_dev = jax.device_put(jnp.asarray(self.iteration, jnp.int32))
+        (self.params, self.states, self.opt_states, loss,
+         self._it_dev, self._rng_key) = self._train_step(
+            self.params, self.states, self.opt_states, self._it_dev,
+            self._rng_key, inputs, labs,
             mask=None if mask is None else jnp.asarray(mask),
             label_mask=None if label_mask is None else jnp.asarray(label_mask),
         )
         self.score_value = loss
         self.iteration += 1
+        self._it_sync = self.iteration
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -44,6 +44,8 @@ class MultiLayerNetwork:
         self.listeners: list = []
         self.score_value: float = float("nan")
         self._train_step = None
+        self._it_dev = None   # device-resident iteration counter
+        self._it_sync = -1    # host iteration the device counter mirrors
         self._updaters = [
             (lyr.updater or conf.updater or upd.Sgd(0.1)) for lyr in conf.layers
         ]
@@ -216,7 +218,21 @@ class MultiLayerNetwork:
         )
 
     def _build_train_step(self):
-        return jax.jit(self.make_step_fn(), donate_argnums=(0, 1, 2))
+        """Jit the step with iteration and RNG-key evolution INSIDE the
+        program: per-step host work is then a single enqueue (no scalar
+        host->device transfer for the iteration counter, no tiny device
+        program for jax.random.split — both cost whole round-trips through
+        the remote-chip tunnel)."""
+        base = self.make_step_fn()
+
+        def step(params, states, opt_states, iteration, key, x, y,
+                 mask=None, label_mask=None):
+            new_key, sub = jax.random.split(key)
+            p, s, o, loss = base(params, states, opt_states, iteration, x, y,
+                                 sub, mask=mask, label_mask=label_mask)
+            return p, s, o, loss, iteration + 1, new_key
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
@@ -370,14 +386,16 @@ class MultiLayerNetwork:
             return self._fit_batch_tbptt(x, y, mask=mask, label_mask=label_mask)
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._build_train_step()
-        self._rng_key, sub = jax.random.split(self._rng_key)
-        self.params, self.states, self.opt_states, loss = self._train_step(
-            self.params, self.states, self.opt_states,
-            jnp.asarray(self.iteration), x, y, sub,
-            mask=mask, label_mask=label_mask,
+        if self._it_dev is None or self._it_sync != self.iteration:
+            self._it_dev = jax.device_put(jnp.asarray(self.iteration, jnp.int32))
+        (self.params, self.states, self.opt_states, loss,
+         self._it_dev, self._rng_key) = self._train_step(
+            self.params, self.states, self.opt_states, self._it_dev,
+            self._rng_key, x, y, mask=mask, label_mask=label_mask,
         )
         self.score_value = loss  # fetched lazily; float() forces transfer
         self.iteration += 1
+        self._it_sync = self.iteration
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
