@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.samediff.core import SameDiff, SDVariable
@@ -51,6 +53,12 @@ class TFGraphMapper:
         self.vars: Dict[str, SDVariable] = {}      # "node:slot" -> var
         self.const_vals: Dict[str, np.ndarray] = {}  # import-time constants
         self.nodes = {n.name: n for n in graph_def.node}
+        # FunctionDef library (TF2 functional control flow / calls)
+        self.functions = {f.signature.name: f
+                          for f in graph_def.library.function} \
+            if graph_def.HasField("library") else {}
+        # V1 cond support: tensor key -> (pred SDVariable, is_true_branch)
+        self.branch_tag: Dict[str, tuple] = {}
 
     # ------------------------------------------------------------- plumbing
     @staticmethod
@@ -80,13 +88,7 @@ class TFGraphMapper:
 
     # --------------------------------------------------------------- import
     def build(self) -> SameDiff:
-        for node in self.gd.node:
-            fn = _RULES.get(node.op)
-            if fn is None:
-                raise UnsupportedOpError(
-                    f"no import rule for TF op {node.op!r} (node {node.name!r}); "
-                    f"{len(_RULES)} op types supported")
-            fn(self, node)
+        _import_nodes(self)
         # TF node name → samediff var name (they differ when a rule emits a
         # lowering postamble, e.g. the NCHW→NHWC boundary transposes)
         self.sd.tf_name_map = {
@@ -368,6 +370,10 @@ def _pack(m, node):
     axis = int(node.attr["axis"].i)
     m.set(node.name, m.sd._op("stack_n", vs, attrs=dict(axis=axis),
                               name=node.name))
+    keys = [m._canon(i) for i in m.inputs(node)]
+    if all(k in m.const_vals for k in keys):  # shape tuples stay static
+        m.const_vals[node.name + ":0"] = np.stack(
+            [np.asarray(m.const_vals[k]) for k in keys], axis=axis)
 
 
 @rule("Unpack")
@@ -431,6 +437,11 @@ def _strided_slice(m, node):
             spec.append(("s", b, e, strides[d]))
     m.set(node.name, m.sd._op("getitem", [x], attrs=dict(spec=tuple(spec)),
                               name=node.name))
+    src = m._canon(ins[0])
+    if src in m.const_vals:  # slices of static shapes stay static
+        idx = tuple(s[1] if s[0] == "i" else slice(s[1], s[2], s[3])
+                    for s in spec)
+        m.const_vals[node.name + ":0"] = np.asarray(m.const_vals[src])[idx]
 
 
 @rule("Pad", "PadV2")
@@ -543,3 +554,393 @@ def _shape(m, node):
         raise UnsupportedOpError("Shape of dynamically-shaped tensor")
     arr = np.asarray(shp, np.int32)
     m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+
+
+# ---------------------------------------------------------------------------
+# Control flow — TF1 dataflow frames (Enter/Merge/Switch/NextIteration/Exit/
+# LoopCond) and TF2 functional ops (While/If/PartitionedCall + FunctionDefs).
+#
+# Reference parity: TFGraphMapper.java maps these ops and AbstractSession
+# interprets them op-at-a-time on the JVM (SURVEY.md §3.3). The TPU-native
+# collapse: a whole while-frame becomes ONE lax.while_loop custom node (the
+# body/cond subgraphs are re-imported into scratch SameDiff graphs and traced
+# as array-level functions), and V1 conds lower to predicated selects — both
+# compile into the enclosing XLA program instead of being interpreted.
+# ---------------------------------------------------------------------------
+
+_FRAME_CONTROL = {"Enter", "Merge", "Switch", "NextIteration", "Exit",
+                  "LoopCond"}
+
+
+def _prod(name: str) -> str:
+    return name.lstrip("^").split(":")[0]
+
+
+class _Frame:
+    def __init__(self, name):
+        self.name = name
+        self.enters: list = []       # Enter nodes, graph order
+        self.members: set = set()    # node names (incl. control + Exit)
+        self.merges: list = []       # Merge nodes = loop-carried vars
+        self.enter_of: Dict[str, object] = {}      # merge name -> Enter node
+        self.nextiter_of: Dict[str, object] = {}   # merge name -> NextIteration
+        self.switch_of: Dict[str, object] = {}     # merge name -> Switch node
+        self.exits_of: Dict[str, list] = {}        # merge name -> [Exit nodes]
+        self.loopcond = None
+        self.emitted = False
+
+
+def _detect_frames(m):
+    """Group TF1 while-loop dataflow nodes into frames (single level)."""
+    frames: Dict[str, _Frame] = {}
+    owner: Dict[str, str] = {}
+    for n in m.gd.node:
+        if n.op == "Enter":
+            fname = n.attr["frame_name"].s.decode()
+            fr = frames.setdefault(fname, _Frame(fname))
+            fr.enters.append(n)
+            owner[n.name] = fname
+            fr.members.add(n.name)
+    if not frames:
+        return frames, owner
+    changed = True
+    while changed:  # propagate membership along data edges (stop at Exit)
+        changed = False
+        for n in m.gd.node:
+            if n.name in owner or n.op == "Enter":
+                continue
+            for i in n.input:
+                p = _prod(i)
+                if p in owner and m.nodes[p].op != "Exit":
+                    owner[n.name] = owner[p]
+                    frames[owner[p]].members.add(n.name)
+                    changed = True
+                    break
+    for fr in frames.values():
+        for e in fr.enters:
+            if _prod(e.input[0]) in owner:
+                raise UnsupportedOpError(
+                    "nested tf.while_loop frames are not supported")
+        enter_names = {e.name for e in fr.enters}
+        for n in m.gd.node:
+            if n.name not in fr.members:
+                continue
+            if n.op == "LoopCond":
+                fr.loopcond = n
+            elif n.op == "Merge":
+                ins = [_prod(i) for i in n.input]
+                ent = [i for i in ins if i in enter_names]
+                ni = [i for i in ins if m.nodes[i].op == "NextIteration"]
+                if len(ent) != 1 or len(ni) != 1:
+                    raise UnsupportedOpError(
+                        f"unrecognized Merge {n.name!r} in while frame")
+                fr.merges.append(n)
+                fr.enter_of[n.name] = m.nodes[ent[0]]
+                fr.nextiter_of[n.name] = m.nodes[ni[0]]
+            elif n.op == "Switch":
+                fr.switch_of[_prod(n.input[0])] = n
+        for n in m.gd.node:
+            if n.name in fr.members and n.op == "Exit":
+                sw = _prod(n.input[0])
+                for mg in fr.merges:
+                    s = fr.switch_of.get(mg.name)
+                    if s is not None and s.name == sw:
+                        fr.exits_of.setdefault(mg.name, []).append(n)
+        if fr.loopcond is None:
+            raise UnsupportedOpError(f"while frame {fr.name!r} has no LoopCond")
+    return frames, owner
+
+
+def _subgraph_callable(m, member_names, seeds, targets):
+    """Compile frame member nodes into fn(list-of-arrays)->list-of-arrays.
+
+    ``seeds``: tensor keys pre-bound to the function's array arguments;
+    ``targets``: tensor keys to return. Member nodes are re-imported into a
+    scratch SameDiff via the ordinary rules, then traced array-level (the
+    closure is jax-traceable, so it works inside lax.while_loop/cond)."""
+    sub = TFGraphMapper(type(m.gd)())
+    sub.functions = m.functions
+    ph_names = []
+    for idx, key in enumerate(seeds):
+        ph = sub.sd.placeholder(f"__seed{idx}")
+        sub.vars[m._canon(key)] = ph
+        ph_names.append(ph.name)
+
+    needed, seen = [], set()
+
+    def visit(name):
+        if name in seen:
+            return
+        seen.add(name)
+        node = m.nodes[name]
+        for i in node.input:
+            if i.startswith("^"):
+                continue
+            if m._canon(i) in sub.vars:
+                continue
+            p = _prod(i)
+            pnode = m.nodes.get(p)
+            if pnode is None:
+                raise UnsupportedOpError(f"unknown input {i!r} in while frame")
+            if pnode.op in _FRAME_CONTROL:
+                raise UnsupportedOpError(
+                    f"frame node {name!r} reads unsupported control tensor "
+                    f"{i!r} (only loop vars and invariants are seeded)")
+            if p in member_names or pnode.op == "Const":
+                visit(p)  # outer Consts are pulled into the subgraph
+            else:
+                raise UnsupportedOpError(
+                    f"while-frame node {name!r} captures non-constant outer "
+                    f"tensor {i!r}; only constants and Enter-ed values can "
+                    "cross the frame boundary")
+        needed.append(name)
+
+    for t in targets:
+        if m._canon(t) not in sub.vars:
+            visit(_prod(t))
+    for name in needed:  # post-order append == topological order
+        node = m.nodes[name]
+        fn = _RULES.get(node.op)
+        if fn is None:
+            raise UnsupportedOpError(
+                f"no import rule for TF op {node.op!r} inside while frame")
+        fn(sub, node)
+    sd = sub.sd
+    tnames = [sub.get(t).name for t in targets]
+
+    def run(arrays):
+        vals = dict(sd._arrays)
+        vals.update(zip(ph_names, arrays))
+        return sd._trace(vals, tnames)
+
+    return run
+
+
+def _emit_frame(m, fr):
+    """Lower one TF1 while frame to a lax.while_loop custom node."""
+    init_vars, seeds_cond, seeds_body = [], [], []
+    for mg in fr.merges:
+        sw = fr.switch_of.get(mg.name)
+        if sw is None:
+            raise UnsupportedOpError(
+                f"while frame {fr.name!r}: loop var {mg.name!r} has no Switch")
+        init_vars.append(m.get(fr.enter_of[mg.name].input[0]))
+        seeds_cond.append(mg.name + ":0")
+        seeds_body.append(sw.name + ":1")
+    merge_enters = {fr.enter_of[mg.name].name for mg in fr.merges}
+    for e in fr.enters:  # loop invariants: carried through unchanged
+        if e.name not in merge_enters:
+            init_vars.append(m.get(e.input[0]))
+            seeds_cond.append(e.name + ":0")
+            seeds_body.append(e.name + ":0")
+    n_merge = len(fr.merges)
+    n_carry = len(init_vars)
+    cond_run = _subgraph_callable(m, fr.members, seeds_cond,
+                                  [fr.loopcond.input[0]])
+    body_targets = [fr.nextiter_of[mg.name].input[0] for mg in fr.merges]
+    body_run = _subgraph_callable(m, fr.members, seeds_body, body_targets)
+
+    def while_impl(*vs):
+        def cond(c):
+            return jnp.reshape(cond_run(list(c))[0], ()).astype(bool)
+
+        def body(c):
+            new = body_run(list(c))
+            return tuple(new) + tuple(c[n_merge:])
+
+        out = jax.lax.while_loop(cond, body, tuple(vs))
+        return out[:n_merge] if n_merge > 1 else out[0]
+
+    out = m.sd.custom_op(while_impl, *init_vars, n_out=n_merge,
+                         name=f"while_{fr.name.rsplit('/', 1)[-1]}")
+    outs = (out,) if n_merge == 1 else out
+    for i, mg in enumerate(fr.merges):
+        for ex in fr.exits_of.get(mg.name, ()):
+            m.set(ex.name, outs[i])
+    fr.emitted = True
+
+
+def _import_nodes(m):
+    """Main import loop: frame-aware, branch-tag-propagating."""
+    frames, owner = _detect_frames(m)
+    for node in m.gd.node:
+        if node.name in owner:
+            fr = frames[owner[node.name]]
+            if node.op == "Exit" and not fr.emitted:
+                _emit_frame(m, fr)
+            continue
+        fn = _RULES.get(node.op)
+        if fn is None:
+            raise UnsupportedOpError(
+                f"no import rule for TF op {node.op!r} (node {node.name!r}); "
+                f"{len(_RULES)} op types supported")
+        before = set(m.vars) if m.branch_tag else None
+        fn(m, node)
+        if before is not None and node.op not in ("Switch", "Merge"):
+            # V1 cond: propagate which branch a tensor belongs to
+            tags = {m.branch_tag[k]
+                    for k in (m._canon(i) for i in m.inputs(node))
+                    if k in m.branch_tag}
+            if tags:
+                preds = {id(t[0]) for t in tags}
+                if len(preds) > 1:
+                    raise UnsupportedOpError(
+                        f"node {node.name!r} mixes tensors from two different "
+                        "Switch predicates (unstructured cond)")
+                tag = next(iter(tags))
+                for k in set(m.vars) - before:
+                    m.branch_tag[k] = tag
+
+
+@rule("Enter", "Exit", "NextIteration", "LoopCond")
+def _frame_only(m, node):  # reached only when frame detection missed it
+    raise UnsupportedOpError(
+        f"{node.op} outside a recognized while frame (node {node.name!r})")
+
+
+@rule("Switch")
+def _switch(m, node):
+    """V1 cond lowering: both branches are computed (graphs are pure), the
+    Merge selects — the standard predication of Switch/Merge dataflow."""
+    data = m.get(node.input[0])
+    pred = m.get(node.input[1])
+    m.set(node.name, data, slot=0)
+    m.set(node.name, data, slot=1)
+    m.branch_tag[node.name + ":0"] = (pred, False)
+    m.branch_tag[node.name + ":1"] = (pred, True)
+
+
+@rule("Merge")
+def _merge(m, node):
+    ins = [m._canon(i) for i in m.inputs(node)]
+    if len(ins) != 2:
+        raise UnsupportedOpError(
+            f"Merge {node.name!r} with {len(ins)} inputs outside a while frame")
+    tags = [m.branch_tag.get(k) for k in ins]
+    preds = {id(t[0]) for t in tags if t is not None}
+    if len(preds) != 1:
+        raise UnsupportedOpError(
+            f"cannot determine the predicate of Merge {node.name!r} "
+            "(unstructured cond)")
+    if (tags[0] and tags[0][1]) or (tags[1] and not tags[1][1]):
+        pred, t_key, f_key = (tags[0] or tags[1])[0], ins[0], ins[1]
+    else:
+        pred, t_key, f_key = (tags[0] or tags[1])[0], ins[1], ins[0]
+    out = m.sd._op("where", [pred, m.vars[t_key], m.vars[f_key]],
+                   name=node.name)
+    m.set(node.name, out)
+    # value_index output (slot 1): 0 if true branch produced the value
+    idx = m.sd._op("where", [pred, m.sd.constant(np.int32(0), name="vi0"),
+                             m.sd.constant(np.int32(1), name="vi1")],
+                   name=node.name + "_value_index")
+    m.set(node.name, idx, slot=1)
+
+
+# -- TF2 functional control flow --------------------------------------------
+
+
+def _fdef_graph(m, func_attr):
+    fname = func_attr.func.name
+    fdef = m.functions.get(fname)
+    if fdef is None:
+        raise UnsupportedOpError(f"function {fname!r} not in graph library")
+    from tensorflow.python.framework.function_def_to_graph import (
+        function_def_to_graph_def,
+    )
+    sub_gd, nested_to_flat = function_def_to_graph_def(fdef)
+    return fdef, sub_gd, nested_to_flat
+
+
+def _func_callable(m, func_attr):
+    """FunctionDef -> jax-traceable fn(*arrays) -> list of arrays."""
+    fdef, sub_gd, nested_to_flat = _fdef_graph(m, func_attr)
+    sub = TFGraphMapper(sub_gd)
+    sub.functions = dict(m.functions)
+    sub.functions.update({f.signature.name: f
+                          for f in sub_gd.library.function})
+    sub_sd = sub.build()
+    ph_names = [sub.get(a.name).name for a in fdef.signature.input_arg]
+    rets = [nested_to_flat[fdef.ret[o.name]]
+            for o in fdef.signature.output_arg]
+    tnames = [sub.get(r).name for r in rets]
+
+    def run(*arrays):
+        vals = dict(sub_sd._arrays)
+        vals.update(zip(ph_names, arrays))
+        return sub_sd._trace(vals, tnames)
+
+    return run, len(tnames)
+
+
+def _set_multi(m, node, outs):
+    for i, v in enumerate(outs):
+        m.set(node.name, v, slot=i)
+
+
+@rule("While", "StatelessWhile")
+def _while_v2(m, node):
+    ops = [m.get(i) for i in m.inputs(node)]
+    cond_run, _ = _func_callable(m, node.attr["cond"])
+    body_run, n_body = _func_callable(m, node.attr["body"])
+    if n_body != len(ops):
+        raise UnsupportedOpError(
+            f"While {node.name!r}: body returns {n_body} values for "
+            f"{len(ops)} loop vars")
+    n = len(ops)
+
+    def impl(*vs):
+        out = jax.lax.while_loop(
+            lambda c: jnp.reshape(cond_run(*c)[0], ()).astype(bool),
+            lambda c: tuple(body_run(*c)),
+            tuple(vs))
+        return out if n > 1 else out[0]
+
+    out = m.sd.custom_op(impl, *ops, n_out=n, name=node.name)
+    _set_multi(m, node, (out,) if n == 1 else out)
+
+
+@rule("If", "StatelessIf")
+def _if_v2(m, node):
+    ins = m.inputs(node)
+    pred = m.get(ins[0])
+    ops = [m.get(i) for i in ins[1:]]
+    then_run, n_t = _func_callable(m, node.attr["then_branch"])
+    else_run, n_e = _func_callable(m, node.attr["else_branch"])
+    if n_t != n_e:
+        raise UnsupportedOpError(f"If {node.name!r}: branch arity mismatch")
+
+    def impl(p, *a):
+        out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                           lambda *xs: tuple(then_run(*xs)),
+                           lambda *xs: tuple(else_run(*xs)), *a)
+        return out if n_t > 1 else out[0]
+
+    out = m.sd.custom_op(impl, pred, *ops, n_out=n_t, name=node.name)
+    _set_multi(m, node, (out,) if n_t == 1 else out)
+
+
+@rule("PartitionedCall", "StatefulPartitionedCall")
+def _partitioned_call(m, node):
+    """Function calls are INLINED into the enclosing graph (the reference
+    importer flattens functions too): ops stay visible/serializable and
+    gradients flow."""
+    fdef, sub_gd, nested_to_flat = _fdef_graph(m, node.attr["f"])
+    input_vars = [m.get(i) for i in m.inputs(node)]
+    sub = TFGraphMapper(sub_gd)
+    sub.sd = m.sd  # shared graph: true inlining
+    sub.functions = dict(m.functions)
+    sub.functions.update({f.signature.name: f
+                          for f in sub_gd.library.function})
+    skip = set()
+    for arg, v in zip(fdef.signature.input_arg, input_vars):
+        sub.vars[arg.name + ":0"] = v
+        skip.add(arg.name)
+    # placeholders for the args were materialized by function_def_to_graph_def;
+    # drop them (the call's inputs take their place) and import the rest
+    del_nodes = [n for n in sub_gd.node if n.name in skip]
+    for n in del_nodes:
+        sub_gd.node.remove(n)
+    sub.nodes = {n.name: n for n in sub_gd.node}
+    _import_nodes(sub)
+    for i, o in enumerate(fdef.signature.output_arg):
+        m.set(node.name, sub.get(nested_to_flat[fdef.ret[o.name]]), slot=i)
