@@ -74,6 +74,8 @@ class _Node:
                 self.attrs[aname] = pm.get_floats(af, 7)
             elif atype == 7:  # INTS
                 self.attrs[aname] = pm.get_ints(af, 8)
+            elif atype == 8:  # STRINGS (e.g. RNN `activations`)
+                self.attrs[aname] = pm.get_strs(af, 9)
             else:
                 self.attrs[aname] = None
 
@@ -403,13 +405,15 @@ def _o_conv(m, node):
     dil = tuple(node.attr("dilations", [1, 1]))
     group = node.attr("group", 1)
     auto_pad = node.attr("auto_pad", "NOTSET")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
     if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
         padding = "SAME"
     elif pads[0] == pads[2] and pads[1] == pads[3]:
         padding = (pads[0], pads[1])
-    else:
-        raise NotImplementedError("asymmetric Conv pads")
-    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    else:  # asymmetric: explicit zero-pad then VALID conv
+        xh = m.sd._op("pad", [xh], attrs=dict(
+            paddings=((0, 0), (pads[0], pads[2]), (pads[1], pads[3]), (0, 0))))
+        padding = "VALID"
     wh = m.sd._op("permute", [w], attrs=dict(axes=(2, 3, 1, 0)))  # OIHW→HWIO
     attrs = dict(strides=strides, padding=padding, dilation=dil,
                  feature_group_count=group)
@@ -427,15 +431,25 @@ def _o_pool(m, node):
     k = tuple(node.attr("kernel_shape"))
     strides = tuple(node.attr("strides", list(k)))
     pads = node.attr("pads", [0, 0, 0, 0])
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
     if node.attr("auto_pad", "NOTSET") in ("SAME_UPPER", "SAME_LOWER"):
         padding = "SAME"
     elif all(p == 0 for p in pads):
         padding = "VALID"
     elif pads[0] == pads[2] and pads[1] == pads[3]:
         padding = (pads[0], pads[1])
+    elif node.op_type == "MaxPool":  # asymmetric: -inf pad then VALID
+        xh = m.sd._op("pad", [xh], attrs=dict(
+            paddings=((0, 0), (pads[0], pads[2]), (pads[1], pads[3]), (0, 0)),
+            constant_value=float("-inf")))
+        padding = "VALID"
+    elif node.attr("count_include_pad", 0):  # zero-pad counts toward the mean
+        xh = m.sd._op("pad", [xh], attrs=dict(
+            paddings=((0, 0), (pads[0], pads[2]), (pads[1], pads[3]), (0, 0))))
+        padding = "VALID"
     else:
-        raise NotImplementedError("asymmetric pool pads")
-    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+        raise NotImplementedError(
+            "asymmetric AveragePool pads with count_include_pad=0")
     y = m.sd._op("maxpool2d" if node.op_type == "MaxPool" else "avgpool2d",
                  [xh], attrs=dict(kernel=k, strides=strides, padding=padding))
     m.set(node.outputs[0], m.sd._op("permute", [y], attrs=dict(axes=(0, 3, 1, 2)),
@@ -479,3 +493,84 @@ def _o_shape(m, node):
     arr = np.asarray(shp, np.int64)
     m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
           const_val=arr)
+
+
+# ------------------------------------------------------------ recurrent ops
+# Reference parity: samediff-import-onnx RNN mappings (path-cite, mount empty
+# this round). Lowered onto the ops.rnn whole-sequence scan ops (one lax.scan
+# per direction — the TPU-native replacement for per-step cell kernels).
+
+
+def _o_rnn_common(m, node, n_optional):
+    """Shared input unpack: X, W, R, [B, sequence_lens, initial_h, ...]."""
+    ins = [m.get(node.inputs[0]), m.get(node.inputs[1]), m.get(node.inputs[2])]
+    for i in range(3, 3 + n_optional):
+        ins.append(m.get(node.inputs[i]) if m.has_input(node, i) else None)
+    return ins
+
+
+def _o_rnn_acts(node, n_per_dir):
+    """ONNX `activations` attr → (gate_activation, activation) kwargs."""
+    acts = node.attr("activations")
+    out = {}
+    if acts:
+        acts = [a.lower() for a in acts[:n_per_dir]]  # fwd direction names
+        if n_per_dir >= 2:
+            out["gate_activation"] = acts[0]
+            out["activation"] = acts[1]
+            if n_per_dir == 3 and len(acts) > 2 and acts[2] != acts[1]:
+                raise NotImplementedError(
+                    "LSTM with distinct cell/hidden activations (g != h)")
+        else:
+            out["activation"] = acts[0]
+    return out
+
+
+def _o_rnn_set_outputs(m, node, outs):
+    for name, var in zip(node.outputs, outs):
+        if name:
+            # alias to the ONNX output name (rules lower to internal names)
+            m.set(name, m.sd._op("identity", [var], name=name))
+
+
+@orule("LSTM")
+def _o_lstm(m, node):
+    x, W, R, b, seq_lens, h0, c0 = _o_rnn_common(m, node, 4)
+    attrs = dict(hidden_size=int(node.attr("hidden_size")),
+                 direction=node.attr("direction", "forward"),
+                 layout=int(node.attr("layout", 0)))
+    attrs.update(_o_rnn_acts(node, 3))
+    if node.attr("clip") is not None:
+        raise NotImplementedError("LSTM cell clipping")
+    if node.attr("input_forget", 0):
+        raise NotImplementedError("LSTM input_forget coupling")
+    y, yh, yc = m.sd._op("lstm_layer", [x, W, R, b, seq_lens, h0, c0],
+                         attrs=attrs, n_out=3, name=node.name or "lstm")
+    _o_rnn_set_outputs(m, node, (y, yh, yc))
+
+
+@orule("GRU")
+def _o_gru(m, node):
+    x, W, R, b, seq_lens, h0 = _o_rnn_common(m, node, 3)
+    attrs = dict(hidden_size=int(node.attr("hidden_size")),
+                 direction=node.attr("direction", "forward"),
+                 layout=int(node.attr("layout", 0)),
+                 linear_before_reset=int(node.attr("linear_before_reset", 0)))
+    attrs.update(_o_rnn_acts(node, 2))
+    if node.attr("clip") is not None:
+        raise NotImplementedError("GRU cell clipping")
+    y, yh = m.sd._op("gru_layer", [x, W, R, b, seq_lens, h0],
+                     attrs=attrs, n_out=2, name=node.name or "gru")
+    _o_rnn_set_outputs(m, node, (y, yh))
+
+
+@orule("RNN")
+def _o_simple_rnn(m, node):
+    x, W, R, b, seq_lens, h0 = _o_rnn_common(m, node, 3)
+    attrs = dict(hidden_size=int(node.attr("hidden_size")),
+                 direction=node.attr("direction", "forward"),
+                 layout=int(node.attr("layout", 0)))
+    attrs.update(_o_rnn_acts(node, 1))
+    y, yh = m.sd._op("rnn_layer", [x, W, R, b, seq_lens, h0],
+                     attrs=attrs, n_out=2, name=node.name or "rnn")
+    _o_rnn_set_outputs(m, node, (y, yh))

@@ -33,5 +33,6 @@ from deeplearning4j_tpu.ops import (  # noqa: F401
     nn,
     random,
     reduce,
+    rnn,
     shape_ops,
 )

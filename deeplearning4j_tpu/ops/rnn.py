@@ -1,0 +1,256 @@
+"""Recurrent ops: whole-layer LSTM/GRU/RNN scans (the sd.rnn namespace).
+
+Reference parity: libnd4j declarable ops ops/declarable/generic/recurrent/
+(lstmLayer.cpp, gruCell.cpp, sruCell.cpp …) and the cuDNN lstmLayer platform
+helper — path-cite, mount empty this round. The reference runs cell kernels
+inside a host loop (or hands the whole sequence to cuDNN); the TPU-native
+form is ONE ``lax.scan`` over time per direction — XLA unrolls nothing, the
+MXU sees one fused (x·W + h·R) per step, and the whole layer is a single
+compiled region.
+
+Parameterization follows ONNX (the import path that needs these ops):
+stacked per-direction weights, ONNX gate orders (LSTM ``iofc``, GRU ``zrh``),
+optional initial states, ``layout`` 0 = seq-major (T,B,C) / 1 = batch-major
+(B,T,C). deeplearning4j_tpu.nn.recurrent keeps its own layer classes (DL4J
+layer-API parity); these ops serve SameDiff/import/namespace users.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+from deeplearning4j_tpu.ops import nn as nnops
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": (lambda x: x), "softsign": jax.nn.soft_sign,
+        "softplus": jax.nn.softplus, "hardsigmoid": jax.nn.hard_sigmoid,
+        "elu": jax.nn.elu, "leakyrelu": jax.nn.leaky_relu,
+    }[name.lower()]
+
+
+def _split_b(b, n, h):
+    """ONNX B is (2n*h,): input-bias block then recurrent-bias block."""
+    if b is None:
+        return jnp.zeros((n * h,)), jnp.zeros((n * h,))
+    return b[: n * h], b[n * h:]
+
+
+def _mask_step(new, old, t, seq_lens):
+    """Freeze state for finished sequences (ONNX sequence_lens semantics)."""
+    if seq_lens is None:
+        return new
+    alive = (t < seq_lens)[:, None]
+    return jnp.where(alive, new, old)
+
+
+def _scan_dir(step, x_tbc, carry, seq_lens, reverse):
+    T = x_tbc.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x_tbc = jnp.flip(x_tbc, axis=0)
+        ts = jnp.flip(ts, axis=0)
+    carry, ys = lax.scan(step, carry, (x_tbc, ts))
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return carry, ys
+
+
+def _directions(direction):
+    direction = direction.lower()
+    if direction == "forward":
+        return [False]
+    if direction == "reverse":
+        return [True]
+    if direction == "bidirectional":
+        return [False, True]
+    raise ValueError(f"unknown direction {direction!r}")
+
+
+def _seq_major(x, layout):
+    return x if int(layout) == 0 else jnp.swapaxes(x, 0, 1)
+
+
+@op("lstm_layer", "rnn", aliases=("lstmLayer", "lstm"))
+def lstm_layer(x, W, R, b=None, seq_lens=None, h0=None, c0=None, *,
+               hidden_size, direction="forward", layout=0,
+               gate_activation="sigmoid", activation="tanh"):
+    """ONNX-semantics LSTM over a full sequence.
+
+    x: (T,B,I) [layout 0] or (B,T,I) [layout 1]; W: (D, 4H, I); R: (D, 4H, H);
+    b: (D, 8H); gate order i,o,f,c (ONNX). Returns (Y, Y_h, Y_c) with
+    Y (T,D,B,H) [layout 0] / (B,T,D,H) [layout 1], Y_h/Y_c (D,B,H)."""
+    h = int(hidden_size)
+    x = _seq_major(x, layout)
+    if int(layout) == 1:  # ONNX layout=1 states are (B,D,H)
+        h0 = None if h0 is None else jnp.swapaxes(h0, 0, 1)
+        c0 = None if c0 is None else jnp.swapaxes(c0, 0, 1)
+    T, B = x.shape[0], x.shape[1]
+    f_g = _act(gate_activation)
+    f_c = _act(activation)
+    outs, hs, cs = [], [], []
+    for d, reverse in enumerate(_directions(direction)):
+        Wd, Rd = W[d].T, R[d].T           # (I,4H), (H,4H)
+        bi, br = _split_b(b[d] if b is not None else None, 4, h)
+        bias = (bi + br).astype(x.dtype)
+        hd = jnp.zeros((B, h), x.dtype) if h0 is None else h0[d].astype(x.dtype)
+        cd = jnp.zeros((B, h), x.dtype) if c0 is None else c0[d].astype(x.dtype)
+
+        def step(carry, xt_t, Wd=Wd, Rd=Rd, bias=bias):
+            hp, cp = carry
+            xt, t = xt_t
+            z = xt @ Wd + hp @ Rd + bias
+            i_g, o_g, f_gate, c_in = jnp.split(z, 4, axis=-1)
+            i_g, o_g, f_gate = f_g(i_g), f_g(o_g), f_g(f_gate)
+            c_new = f_gate * cp + i_g * f_c(c_in)
+            h_new = o_g * f_c(c_new)
+            c_new = _mask_step(c_new, cp, t, seq_lens)
+            h_new = _mask_step(h_new, hp, t, seq_lens)
+            return (h_new, c_new), h_new
+
+        (hd, cd), ys = _scan_dir(step, x, (hd, cd), seq_lens, reverse)
+        outs.append(ys)
+        hs.append(hd)
+        cs.append(cd)
+    Y = jnp.stack(outs, axis=1)            # (T, D, B, H)
+    Yh, Yc = jnp.stack(hs, axis=0), jnp.stack(cs, axis=0)  # (D, B, H)
+    if int(layout) == 1:                   # ONNX layout=1: batch-major
+        Y = jnp.transpose(Y, (2, 0, 1, 3))        # (B, T, D, H)
+        Yh = jnp.swapaxes(Yh, 0, 1)               # (B, D, H)
+        Yc = jnp.swapaxes(Yc, 0, 1)
+    return Y, Yh, Yc
+
+
+@op("gru_layer", "rnn", aliases=("gruLayer", "gru"))
+def gru_layer(x, W, R, b=None, seq_lens=None, h0=None, *,
+              hidden_size, direction="forward", layout=0,
+              linear_before_reset=0, gate_activation="sigmoid",
+              activation="tanh"):
+    """ONNX-semantics GRU. W: (D, 3H, I); R: (D, 3H, H); b: (D, 6H); gate
+    order z,r,h (ONNX). ``linear_before_reset=1`` is the CuDNN/Keras
+    reset-after form; 0 multiplies r before the recurrent matmul."""
+    h = int(hidden_size)
+    x = _seq_major(x, layout)
+    if int(layout) == 1:
+        h0 = None if h0 is None else jnp.swapaxes(h0, 0, 1)
+    B = x.shape[1]
+    f_g = _act(gate_activation)
+    f_c = _act(activation)
+    outs, hs = [], []
+    for d, reverse in enumerate(_directions(direction)):
+        Wd, Rd = W[d].T, R[d].T           # (I,3H), (H,3H)
+        bi, br = _split_b(b[d] if b is not None else None, 3, h)
+        bi = bi.astype(x.dtype)
+        br = br.astype(x.dtype)
+        hd = jnp.zeros((B, h), x.dtype) if h0 is None else h0[d].astype(x.dtype)
+
+        def step(carry, xt_t, Wd=Wd, Rd=Rd, bi=bi, br=br):
+            hp = carry
+            xt, t = xt_t
+            xw = xt @ Wd + bi              # (B, 3H): z,r,h blocks
+            xz, xr, xh = jnp.split(xw, 3, axis=-1)
+            if linear_before_reset:
+                hw = hp @ Rd + br
+                hz, hr, hh = jnp.split(hw, 3, axis=-1)
+                z = f_g(xz + hz)
+                r = f_g(xr + hr)
+                n = f_c(xh + r * hh)
+            else:
+                Rz, Rr, Rn = jnp.split(Rd, 3, axis=-1)
+                bz, brr, bn = jnp.split(br, 3, axis=-1)
+                z = f_g(xz + hp @ Rz + bz)
+                r = f_g(xr + hp @ Rr + brr)
+                n = f_c(xh + (r * hp) @ Rn + bn)
+            h_new = (1.0 - z) * n + z * hp
+            h_new = _mask_step(h_new, hp, t, seq_lens)
+            return h_new, h_new
+
+        hd, ys = _scan_dir(step, x, hd, seq_lens, reverse)
+        outs.append(ys)
+        hs.append(hd)
+    Y = jnp.stack(outs, axis=1)
+    Yh = jnp.stack(hs, axis=0)
+    if int(layout) == 1:
+        Y = jnp.transpose(Y, (2, 0, 1, 3))
+        Yh = jnp.swapaxes(Yh, 0, 1)
+    return Y, Yh
+
+
+@op("rnn_layer", "rnn", aliases=("simple_rnn",))
+def rnn_layer(x, W, R, b=None, seq_lens=None, h0=None, *,
+              hidden_size, direction="forward", layout=0, activation="tanh"):
+    """ONNX-semantics vanilla RNN. W: (D, H, I); R: (D, H, H); b: (D, 2H)."""
+    h = int(hidden_size)
+    x = _seq_major(x, layout)
+    if int(layout) == 1:
+        h0 = None if h0 is None else jnp.swapaxes(h0, 0, 1)
+    B = x.shape[1]
+    f_c = _act(activation)
+    outs, hs = [], []
+    for d, reverse in enumerate(_directions(direction)):
+        Wd, Rd = W[d].T, R[d].T
+        bi, br = _split_b(b[d] if b is not None else None, 1, h)
+        bias = (bi + br).astype(x.dtype)
+        hd = jnp.zeros((B, h), x.dtype) if h0 is None else h0[d].astype(x.dtype)
+
+        def step(carry, xt_t, Wd=Wd, Rd=Rd, bias=bias):
+            hp = carry
+            xt, t = xt_t
+            h_new = f_c(xt @ Wd + hp @ Rd + bias)
+            h_new = _mask_step(h_new, hp, t, seq_lens)
+            return h_new, h_new
+
+        hd, ys = _scan_dir(step, x, hd, seq_lens, reverse)
+        outs.append(ys)
+        hs.append(hd)
+    Y = jnp.stack(outs, axis=1)
+    Yh = jnp.stack(hs, axis=0)
+    if int(layout) == 1:
+        Y = jnp.transpose(Y, (2, 0, 1, 3))
+        Yh = jnp.swapaxes(Yh, 0, 1)
+    return Y, Yh
+
+
+@op("lstm_cell", "rnn", aliases=("lstmCell",))
+def lstm_cell(x, h_prev, c_prev, W, R, b=None, *,
+              gate_activation="sigmoid", activation="tanh"):
+    """One LSTM step (gruCell.cpp/lstmCell parity). x: (B,I); W: (4H,I);
+    R: (4H,H); b: (8H,). Gate order i,o,f,c. Returns (h, c)."""
+    h = h_prev.shape[-1]
+    f_g = _act(gate_activation)
+    f_c = _act(activation)
+    bi, br = _split_b(b, 4, h)
+    z = x @ W.T + h_prev @ R.T + (bi + br).astype(x.dtype)
+    i_g, o_g, f_gate, c_in = jnp.split(z, 4, axis=-1)
+    c_new = f_g(f_gate) * c_prev + f_g(i_g) * f_c(c_in)
+    h_new = f_g(o_g) * f_c(c_new)
+    return h_new, c_new
+
+
+@op("gru_cell", "rnn", aliases=("gruCell",))
+def gru_cell(x, h_prev, W, R, b=None, *, linear_before_reset=1,
+             gate_activation="sigmoid", activation="tanh"):
+    """One GRU step. x: (B,I); W: (3H,I); R: (3H,H); b: (6H,). Order z,r,h."""
+    h = h_prev.shape[-1]
+    f_g = _act(gate_activation)
+    f_c = _act(activation)
+    bi, br = _split_b(b, 3, h)
+    xw = x @ W.T + bi.astype(x.dtype)
+    xz, xr, xh = jnp.split(xw, 3, axis=-1)
+    if linear_before_reset:
+        hw = h_prev @ R.T + br.astype(x.dtype)
+        hz, hr, hh = jnp.split(hw, 3, axis=-1)
+        z, r = f_g(xz + hz), f_g(xr + hr)
+        n = f_c(xh + r * hh)
+    else:
+        Rz, Rr, Rn = jnp.split(R, 3, axis=0)
+        bz, brr, bn = jnp.split(br.astype(x.dtype), 3)
+        z = f_g(xz + h_prev @ Rz.T + bz)
+        r = f_g(xr + h_prev @ Rr.T + brr)
+        n = f_c(xh + (r * h_prev) @ Rn.T + bn)
+    return (1.0 - z) * n + z * h_prev

@@ -174,6 +174,17 @@ OVERRIDES = {
     "threshold_decode": lambda f: f(XN),
     "bitmap_encode": lambda f: f(XN, 0.1),
     "bitmap_decode": lambda f: None,  # needs encode output; covered in test_distributed
+    "lstm_layer": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((1, 8, 4)) * 0.1,
+                              jnp.ones((1, 8, 2)) * 0.1, hidden_size=2),
+    "gru_layer": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((1, 6, 4)) * 0.1,
+                             jnp.ones((1, 6, 2)) * 0.1, hidden_size=2),
+    "rnn_layer": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((1, 2, 4)) * 0.1,
+                             jnp.ones((1, 2, 2)) * 0.1, hidden_size=2),
+    "lstm_cell": lambda f: f(jnp.ones((2, 4)), jnp.zeros((2, 3)),
+                             jnp.zeros((2, 3)), jnp.ones((12, 4)) * 0.1,
+                             jnp.ones((12, 3)) * 0.1),
+    "gru_cell": lambda f: f(jnp.ones((2, 4)), jnp.zeros((2, 3)),
+                            jnp.ones((9, 4)) * 0.1, jnp.ones((9, 3)) * 0.1),
 }
 
 # EXACT category match only ("reduce3".startswith("reduce") must not route
