@@ -399,6 +399,61 @@ class MultiLayerNetwork:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1):
+        """MultiLayerNetwork.pretrain(DataSetIterator) parity: layerwise
+        unsupervised training of every pretrain-capable layer (AutoEncoder,
+        VariationalAutoencoder), in order. Labels are ignored."""
+        for i, lyr in enumerate(self.layers):
+            if getattr(lyr, "is_pretrain_layer", lambda: False)():
+                self.pretrain_layer(i, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, i: int, data, epochs: int = 1):
+        """pretrainLayer(int, DataSetIterator) parity: train ONE layer on its
+        unsupervised objective, inputs fed forward (inference mode) through
+        the layers below. One jitted loss+grad+update program per layer."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        lyr = self.layers[i]
+        if not getattr(lyr, "is_pretrain_layer", lambda: False)():
+            raise ValueError(
+                f"layer {i} ({type(lyr).__name__}) is not a pretrain layer")
+        updater = self._updaters[i]
+        opt = updater.init_state(self.params[i])
+        layers = self.layers
+        below_p = [self.params[j] for j in range(i)]
+        below_s = [self.states[j] for j in range(i)]
+
+        @jax.jit
+        def step(p, opt_state, iteration, x, key):
+            for j in range(i):
+                x, _ = layers[j].apply(below_p[j], below_s[j], x,
+                                       training=False)
+            loss, g = jax.value_and_grad(lyr.pretrain_loss)(p, x, key)
+            new_p, new_opt = upd.apply_updater(updater, p, g, opt_state,
+                                               iteration)
+            return new_p, new_opt, loss
+
+        if isinstance(data, (np.ndarray, jnp.ndarray)):
+            data = [DataSet(np.asarray(data), None)]
+        elif isinstance(data, DataSet):
+            data = [data]
+        loss = None
+        it_count = 0
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                x = jnp.asarray(ds.features if hasattr(ds, "features") else ds)
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                self.params[i], opt, loss = step(
+                    self.params[i], opt, jnp.asarray(it_count), x, sub)
+                it_count += 1
+        if loss is not None:
+            self.score_value = loss
+        return self
+
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):
         """fn(params, states, x) -> output activations (serving wrappers)."""

@@ -1,0 +1,155 @@
+"""AutoEncoder + VariationalAutoencoder layers and MLN.pretrain().
+
+Reference test parity: DL4J's variational gradcheck suite
+(deeplearning4j-core gradientcheck/VaeGradientCheckTests.java) and the
+unsupervised-pretraining integration tests (SURVEY.md §4) — path-cite, mount
+empty this round.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import gradcheck
+from deeplearning4j_tpu.data import ArrayDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.nn import (
+    InputType,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.nn.variational import AutoEncoder, VariationalAutoencoder
+
+
+class TestAutoEncoder:
+    def test_pretrain_loss_gradcheck(self, rng):
+        lyr = AutoEncoder(n_in=6, n_out=4, corruption_level=0.0)
+        params, _ = lyr.initialize(jax.random.PRNGKey(0), (6,))
+        x = jnp.asarray(rng.normal(size=(5, 6)))
+
+        def loss(p):
+            return lyr.pretrain_loss(p, x.astype(
+                jax.tree_util.tree_leaves(p)[0].dtype), None)
+
+        res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
+        assert res.passed, res
+
+    def test_denoising_reconstruction_improves(self, rng):
+        # low-rank data: 8-dim features on a 3-dim manifold
+        basis = rng.normal(size=(3, 8)).astype(np.float32)
+        xs = (rng.normal(size=(256, 3)).astype(np.float32) @ basis)
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+                .list()
+                .layer(AutoEncoder(n_in=8, n_out=3, activation="identity",
+                                   corruption_level=0.1))
+                .layer(OutputLayer(n_in=3, n_out=2))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        lyr = net.layers[0]
+
+        def recon_err(params):
+            h = lyr.encode(params, jnp.asarray(xs))
+            return float(jnp.mean(jnp.square(lyr.decode(params, h) - xs)))
+
+        e0 = recon_err(net.params[0])
+        it = ArrayDataSetIterator(xs, np.zeros((256, 2), np.float32), batch=64)
+        net.pretrain_layer(0, it, epochs=30)
+        e1 = recon_err(net.params[0])
+        assert e1 < e0 * 0.5, (e0, e1)
+
+    def test_supervised_apply_is_encoder(self, rng):
+        lyr = AutoEncoder(n_in=6, n_out=4)
+        params, state = lyr.initialize(jax.random.PRNGKey(0), (6,))
+        x = jnp.asarray(rng.normal(size=(3, 6)), jnp.float32)
+        y, _ = lyr.apply(params, state, x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(lyr.encode(params, x)),
+                                   atol=1e-7)
+
+
+class TestVAE:
+    @pytest.mark.parametrize("dist", ["gaussian", "bernoulli"])
+    def test_pretrain_loss_gradcheck(self, rng, dist):
+        lyr = VariationalAutoencoder(
+            n_in=5, n_out=3, encoder_layer_sizes=(8,),
+            decoder_layer_sizes=(8,), activation="tanh",
+            reconstruction_distribution=dist)
+        params, _ = lyr.initialize(jax.random.PRNGKey(0), (5,))
+        raw = rng.normal(size=(4, 5))
+        x = jnp.asarray(raw if dist == "gaussian"
+                        else (raw > 0).astype(np.float64))
+        key = jax.random.PRNGKey(7)
+
+        def loss(p):
+            return lyr.pretrain_loss(
+                p, x.astype(jax.tree_util.tree_leaves(p)[0].dtype), key)
+
+        res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
+        assert res.passed, res
+
+    def test_elbo_drops_on_mnist(self):
+        it = MnistDataSetIterator(batch=128, train=True, n_examples=1024)
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+                .list()
+                .layer(VariationalAutoencoder(
+                    n_in=784, n_out=16, encoder_layer_sizes=(128,),
+                    decoder_layer_sizes=(128,), activation="relu",
+                    reconstruction_distribution="bernoulli"))
+                .layer(OutputLayer(n_in=16, n_out=10))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf).init()
+        lyr = net.layers[0]
+        ds = next(iter(it))
+        x0 = jnp.asarray(ds.features.reshape(len(ds.features), -1))
+        e0 = float(lyr.pretrain_loss(net.params[0], x0,
+                                     jax.random.PRNGKey(0)))
+        net.pretrain(it, epochs=8)
+        e1 = float(lyr.pretrain_loss(net.params[0], x0,
+                                     jax.random.PRNGKey(0)))
+        assert e1 < e0 * 0.7, (e0, e1)
+        # reconstruction of the latent mean resembles the input
+        rec = np.asarray(lyr.reconstruct(net.params[0], x0))
+        base = np.mean((np.asarray(x0) - np.asarray(x0).mean()) ** 2)
+        err = np.mean((rec - np.asarray(x0)) ** 2)
+        assert err < base, (err, base)
+
+    def test_pretrain_then_fit(self, rng):
+        """pretrain() then fit(): the reference's canonical unsupervised →
+        supervised flow."""
+        centers = rng.standard_normal((3, 8)) * 2.5
+        ys = rng.integers(0, 3, 256)
+        xs = (centers[ys] + rng.standard_normal((256, 8))).astype(np.float32)
+        yoh = np.eye(3, dtype=np.float32)[ys]
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(0.01))
+                .list()
+                .layer(VariationalAutoencoder(
+                    n_in=8, n_out=4, encoder_layer_sizes=(16,),
+                    decoder_layer_sizes=(16,), activation="tanh"))
+                .layer(OutputLayer(n_in=4, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = ArrayDataSetIterator(xs, yoh, batch=64)
+        net.pretrain(it, epochs=10)
+        net.fit(it, epochs=15)
+        acc = (np.argmax(net.output(xs), 1) == ys).mean()
+        assert acc > 0.8, acc
+
+    def test_mixed_stack_pretrains_only_pretrain_layers(self, rng):
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01))
+                .list()
+                .layer(AutoEncoder(n_in=6, n_out=4, corruption_level=0.0))
+                .layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        xs = rng.normal(size=(64, 6)).astype(np.float32)
+        dense_before = np.asarray(net.params[1]["W"]).copy()
+        ae_before = np.asarray(net.params[0]["W"]).copy()
+        net.pretrain(ArrayDataSetIterator(
+            xs, np.zeros((64, 2), np.float32), batch=32), epochs=3)
+        assert not np.allclose(np.asarray(net.params[0]["W"]), ae_before)
+        np.testing.assert_array_equal(np.asarray(net.params[1]["W"]),
+                                      dense_before)
