@@ -60,6 +60,7 @@ class ComputationGraphConfiguration:
     updater: Any = None
     input_shapes: Optional[List[Tuple[int, ...]]] = None  # excl. batch, per input
     compute_dtype: str = "float32"
+    tbptt_length: int = 0  # >0: truncated-BPTT segment length (tBPTTLength)
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -73,6 +74,7 @@ class ComputationGraphConfiguration:
                 if self.input_shapes
                 else None,
                 "compute_dtype": self.compute_dtype,
+                "tbptt_length": self.tbptt_length,
                 "nodes": [
                     {
                         "name": n.name,
@@ -109,6 +111,7 @@ class ComputationGraphConfiguration:
             if d["input_shapes"]
             else None,
             compute_dtype=d.get("compute_dtype", "float32"),
+            tbptt_length=d.get("tbptt_length", 0),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -152,6 +155,7 @@ class GraphBuilder:
         self._nodes: List[GraphNode] = []
         self._outputs: List[str] = []
         self._input_shapes: Optional[List[tuple]] = None
+        self._tbptt: Optional[int] = None
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -171,6 +175,12 @@ class GraphBuilder:
 
     def set_input_types(self, *shapes) -> "GraphBuilder":
         self._input_shapes = [tuple(s) for s in shapes]
+        return self
+
+    def tbptt_length(self, k: int) -> "GraphBuilder":
+        """Truncated-BPTT segment length (backpropType(TruncatedBPTT) +
+        tBPTT{Forward,Backward}Length parity; one k, like MLN)."""
+        self._tbptt = k
         return self
 
     def build(self) -> ComputationGraphConfiguration:
@@ -195,6 +205,8 @@ class GraphBuilder:
             updater=self._p._updater if self._p else None,
             input_shapes=self._input_shapes,
             compute_dtype=self._p._compute_dtype if self._p else "float32",
+            tbptt_length=self._tbptt if self._tbptt is not None
+            else (self._p._tbptt_length if self._p else 0),
         )
 
 
@@ -408,6 +420,169 @@ class ComputationGraph:
         )
         return loss + reg, new_states
 
+    # -------------------------------------------------------- truncated BPTT
+    @staticmethod
+    def _is_recurrent(lyr) -> bool:
+        return hasattr(lyr, "apply_seq") and hasattr(lyr, "init_carry")
+
+    def _init_carries(self, batch_size, dtype):
+        """Per-node carry dict for recurrent layer nodes (ComputationGraph's
+        tbpttStateMap parity)."""
+        return {
+            n.name: n.node.init_carry(batch_size, dtype)
+            for n in self.topo
+            if n.is_layer and self._is_recurrent(n.node)
+        }
+
+    def _loss_tbptt(self, params, states, carries, inputs, labels, keys,
+                    mask=None, label_mask=None):
+        """_loss variant for one TBPTT segment: recurrent nodes take carries
+        in and hand carries out; gradients truncate at the segment boundary
+        because the incoming carry is a plain argument."""
+        acts = {k: self._cast(v) for k, v in inputs.items()}
+        cparams = self._cast_params(params)
+        new_states = dict(states)
+        new_carries = dict(carries)
+        out_names = set(self.conf.outputs)
+        loss = 0.0
+        for n in self.topo:
+            if not n.is_layer:
+                acts[n.name] = n.node.apply(*self._gather_input(acts, n))
+                continue
+            x = self._gather_input(acts, n)
+            if n.name in out_names:
+                out_loss = n.node.compute_loss(
+                    cparams[n.name], states[n.name], x, labels[n.name],
+                    training=True, key=keys[n.name],
+                    **self._loss_mask_kw(n.node, mask, label_mask, x),
+                )
+                loss = loss + out_loss.astype(
+                    jnp.promote_types(out_loss.dtype, jnp.float32))
+                acts[n.name] = x
+            elif n.name in carries:
+                xx = n.node._maybe_dropout(x, True, keys[n.name])
+                seg_mask = (mask if (mask is not None and x.ndim == 3
+                                     and mask.shape[:2] == x.shape[:2])
+                            else None)
+                h, c = n.node.apply_seq(
+                    cparams[n.name], xx, carries[n.name], mask=seg_mask,
+                    training=True, key=keys[n.name])
+                acts[n.name] = h
+                new_carries[n.name] = c
+            else:
+                h, ns = n.node.apply(
+                    cparams[n.name], states[n.name], x, training=True,
+                    key=keys[n.name], **self._mask_kw(n.node, mask, x),
+                )
+                acts[n.name] = h
+                new_states[n.name] = ns
+        reg = sum((n.node.regularization(params[n.name])
+                   for n in self.topo if n.is_layer), start=0.0)
+        return loss + reg, (new_states, new_carries)
+
+    @functools.cached_property
+    def _tbptt_step(self):
+        """One jitted train step per TBPTT segment (the reference's
+        doTruncatedBPTT inside ComputationGraph.java)."""
+        updaters = self._updaters
+        layer_names = [n.name for n in self.topo if n.is_layer]
+
+        def step(params, states, opts, carries, iteration, inputs, labels,
+                 key, mask, label_mask):
+            subkeys = jax.random.split(key, len(layer_names))
+            keys = dict(zip(layer_names, subkeys))
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                self._loss_tbptt, has_aux=True
+            )(params, states, carries, inputs, labels, keys, mask, label_mask)
+            new_params, new_opts = dict(params), dict(opts)
+            for name in layer_names:
+                if not grads[name]:
+                    continue
+                p, s = upd.apply_updater(
+                    updaters[name], params[name], grads[name], opts[name],
+                    iteration)
+                new_params[name] = p
+                new_opts[name] = s
+            return new_params, new_states, new_opts, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _fit_batch_tbptt(self, inputs, labs, mask=None, label_mask=None):
+        """Segment loop: carries flow forward across segments, gradients are
+        truncated; every segment is one updater step (update-per-segment, as
+        in the reference)."""
+        k = self.conf.tbptt_length
+        T = next(v.shape[1] for v in inputs.values() if v.ndim == 3)
+        ref = next(iter(inputs.values()))
+        carries = self._init_carries(ref.shape[0], self._cast(ref).dtype)
+        losses = []
+
+        def seg(d, s):
+            return {kk: (v[:, s:s + k] if v.ndim == 3 else v)
+                    for kk, v in d.items()}
+
+        for s in range(0, T, k):
+            ms = None if mask is None else mask[:, s:s + k]
+            lms = None if label_mask is None else label_mask[:, s:s + k]
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            (self.params, self.states, self.opt_states, carries, loss) = (
+                self._tbptt_step(self.params, self.states, self.opt_states,
+                                 carries, jnp.asarray(self.iteration),
+                                 seg(inputs, s), seg(labs, s), sub, ms, lms))
+            self.iteration += 1
+            losses.append(loss)
+        self.score_value = float(jnp.mean(jnp.stack(losses)))
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # ------------------------------------------------ stateful rnn inference
+    def rnn_time_step(self, *inputs):
+        """Stateful step-by-step inference over the DAG (ComputationGraph.
+        rnnTimeStep parity): recurrent-node carries persist across calls."""
+        from deeplearning4j_tpu.nn.recurrent import Bidirectional
+
+        for n in self.topo:
+            if n.is_layer and isinstance(n.node, Bidirectional):
+                raise ValueError(
+                    "rnn_time_step does not support Bidirectional layers")
+        ins = {}
+        squeeze = False
+        for name, x in zip(self.conf.inputs, inputs):
+            x = self._cast(jnp.asarray(x))
+            if x.ndim == 2:
+                squeeze = True
+                x = x[:, None]
+            ins[name] = x
+        B = next(iter(ins.values())).shape[0]
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is None:
+            carries = self._init_carries(B, next(iter(ins.values())).dtype)
+        cparams = self._cast_params(self.params)
+        acts = dict(ins)
+        new_carries = dict(carries)
+        for n in self.topo:
+            if not n.is_layer:
+                acts[n.name] = n.node.apply(*self._gather_input(acts, n))
+                continue
+            x = self._gather_input(acts, n)
+            if n.name in carries:
+                h, c = n.node.apply_seq(cparams[n.name], x, carries[n.name],
+                                        training=False)
+                new_carries[n.name] = c
+            else:
+                h, _ = n.node.apply(cparams[n.name], self.states[n.name], x,
+                                    training=False)
+            acts[n.name] = h
+        self._rnn_carries = new_carries
+        outs = [acts[o] for o in self.conf.outputs]
+        if squeeze:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """rnnClearPreviousState parity."""
+        self._rnn_carries = None
+
     # ------------------------------------------------------------ train step
     def _jit_train_step(self):
         """Iteration counter + RNG-key evolution live INSIDE the jitted step
@@ -503,6 +678,18 @@ class ComputationGraph:
             labels = [labels]
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
         labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
+        if (self.conf.tbptt_length
+                and any(v.ndim == 3 for v in inputs.values())
+                and all(v.ndim == 3 for v in labs.values())
+                and next(v.shape[1] for v in inputs.values()
+                         if v.ndim == 3) > self.conf.tbptt_length):
+            # per-sequence (2-D) labels cannot be segmented: whole-sequence
+            # BPTT instead, as the reference's doTruncatedBPTT does
+            return self._fit_batch_tbptt(
+                inputs, labs,
+                mask=None if mask is None else jnp.asarray(mask),
+                label_mask=None if label_mask is None
+                else jnp.asarray(label_mask))
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._jit_train_step()
         if self._it_dev is None or self._it_sync != self.iteration:

@@ -204,6 +204,136 @@ class TransferLearning:
             return new_net
 
 
+class _TransferGraphBuilder:
+    """TransferLearning.GraphBuilder parity: surgery on a ComputationGraph —
+    freeze a feature extractor (the named vertices and everything upstream),
+    remove vertices, add new layers/vertices, change outputs, replace widths.
+    Params/states copy over wherever the node and its shapes are unchanged."""
+
+    def __init__(self, net):
+        self._net = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._frozen_at: List[str] = []
+        self._removed: set = set()
+        self._added: list = []          # (name, node, inputs)
+        self._nout_replace: dict = {}   # name -> (n_out, weight_init)
+        self._new_outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, *names: str):
+        """Freeze the named layer vertices AND every layer upstream of them
+        (setFeatureExtractor semantics)."""
+        self._frozen_at = list(names)
+        return self
+
+    def remove_vertex_and_connections(self, name: str):
+        """Remove a vertex and everything downstream of it
+        (removeVertexAndConnections parity)."""
+        self._removed.add(name)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str):
+        self._added.append((name, layer, list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        self._added.append((name, vertex, list(inputs)))
+        return self
+
+    def n_out_replace(self, name: str, n_out: int, weight_init: str = "xavier"):
+        self._nout_replace[name] = (n_out, weight_init)
+        return self
+
+    def set_outputs(self, *names: str):
+        self._new_outputs = list(names)
+        return self
+
+    def build(self):
+        from deeplearning4j_tpu.nn.computation_graph import (
+            ComputationGraph,
+            GraphNode,
+        )
+
+        src = self._net
+        by_name = {n.name: n for n in src.conf.nodes}
+        consumers: dict = {}
+        for n in src.conf.nodes:
+            for i in n.inputs:
+                consumers.setdefault(i, []).append(n.name)
+
+        # transitive closure downstream of removed vertices
+        removed = set(self._removed)
+        frontier = list(removed)
+        while frontier:
+            cur = frontier.pop()
+            for c in consumers.get(cur, ()):  # noqa: B905
+                if c not in removed:
+                    removed.add(c)
+                    frontier.append(c)
+
+        # transitive closure upstream of the feature-extractor boundary
+        frozen: set = set()
+        frontier = list(self._frozen_at)
+        while frontier:
+            cur = frontier.pop()
+            if cur in frozen or cur not in by_name:
+                continue
+            frozen.add(cur)
+            frontier.extend(i for i in by_name[cur].inputs if i in by_name)
+
+        reinit: set = set()
+        current = {n.name: n.node for n in src.conf.nodes}
+        for name, (n_out, wi) in self._nout_replace.items():
+            if not isinstance(current.get(name), Layer):
+                raise ValueError(f"n_out_replace target {name!r} is not a layer")
+            current[name] = dataclasses.replace(current[name], n_out=n_out,
+                                                weight_init=wi)
+            reinit.add(name)
+            for c in consumers.get(name, ()):  # ripple n_in downstream
+                if c in current and hasattr(current[c], "n_in"):
+                    current[c] = dataclasses.replace(current[c], n_in=n_out)
+                    reinit.add(c)
+        nodes = []
+        for n in src.conf.nodes:
+            if n.name in removed:
+                continue
+            node = current[n.name]
+            if n.name in frozen and isinstance(node, Layer) \
+                    and not isinstance(node, FrozenLayer):
+                node = FrozenLayer(inner=node)
+            nodes.append(GraphNode(n.name, node, list(n.inputs)))
+        for name, node, inputs in self._added:
+            nodes.append(GraphNode(name, node, inputs))
+
+        ft = self._fine_tune or FineTuneConfiguration()
+        outputs = self._new_outputs or [
+            o for o in self._net.conf.outputs if o not in removed
+        ]
+        conf = dataclasses.replace(
+            src.conf, nodes=nodes, outputs=outputs,
+            updater=ft.updater or src.conf.updater,
+            seed=ft.seed if ft.seed is not None else src.conf.seed,
+        )
+        new_net = ComputationGraph(conf).init()
+
+        def shapes(t):
+            return jax.tree_util.tree_map(lambda v: jnp.shape(v), t)
+
+        for name in new_net.params:
+            if (name in src.params and name not in reinit
+                    and shapes(src.params[name]) == shapes(new_net.params[name])
+                    and shapes(src.states[name]) == shapes(new_net.states[name])):
+                new_net.params[name] = copy.deepcopy(src.params[name])
+                new_net.states[name] = copy.deepcopy(src.states[name])
+        return new_net
+
+
+TransferLearning.GraphBuilder = _TransferGraphBuilder
+
+
 class TransferLearningHelper:
     """TransferLearningHelper.java parity: split at the frozen boundary,
     featurize inputs once, train only the unfrozen tail."""
