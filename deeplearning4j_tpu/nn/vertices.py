@@ -35,7 +35,9 @@ def vertex_from_dict(d: dict) -> "GraphVertex":
     d = dict(d)
     cls = _VERTEX_TYPES[d.pop("@vertex")]
     for k, v in list(d.items()):
-        if isinstance(v, list):
+        if isinstance(v, dict) and "@vertex" in v:  # nested (FrozenVertex)
+            d[k] = vertex_from_dict(v)
+        elif isinstance(v, list):
             d[k] = tuple(tuple(x) if isinstance(x, list) else x for x in v)
     return cls(**d)
 
@@ -248,3 +250,114 @@ class PoolHelperVertex(GraphVertex):
     def output_shape(self, *input_shapes):
         h, w, c = input_shapes[0]
         return (h - 1, w - 1, c)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two activations → (B, 1)
+    (conf/graph/L2Vertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, *inputs):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True) + self.eps)
+
+    def output_shape(self, *input_shapes):
+        return (1,)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """(B, T, C) → (B, C), last step (conf/graph/rnn/LastTimeStepVertex.java;
+    the masked variant lives in the LastTimeStep layer wrapper, which sees
+    the mask through the layer path)."""
+
+    def apply(self, *inputs):
+        (x,) = inputs
+        return x[:, -1]
+
+    def output_shape(self, *input_shapes):
+        t, c = input_shapes[0]
+        return (c,)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """(B, C) broadcast along a reference sequence's time axis → (B, T, C)
+    (conf/graph/rnn/DuplicateToTimeSeriesVertex.java). Inputs: (static,
+    sequence) — T is read from the second input."""
+
+    def apply(self, *inputs):
+        x, seq = inputs
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], seq.shape[1], x.shape[1]))
+
+    def output_shape(self, *input_shapes):
+        (c,), (t, _) = input_shapes[0], input_shapes[1]
+        return (t, c)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class PreprocessorVertex(GraphVertex):
+    """InputPreProcessor-in-a-vertex (conf/graph/PreprocessorVertex.java).
+    mode: "rnn_to_ff" (merge time into batch), "ff_to_rnn" (split it back,
+    needs t), "cnn_to_ff" (flatten), "ff_to_cnn" (reshape to (h, w, c))."""
+
+    mode: str = "cnn_to_ff"
+    shape: tuple = ()  # t for ff_to_rnn; (h, w, c) for ff_to_cnn
+
+    def apply(self, *inputs):
+        (x,) = inputs
+        if self.mode == "cnn_to_ff":
+            return x.reshape(x.shape[0], -1)
+        if self.mode == "ff_to_cnn":
+            return x.reshape((x.shape[0],) + tuple(self.shape))
+        if self.mode == "rnn_to_ff":
+            return x.reshape(-1, x.shape[-1])
+        if self.mode == "ff_to_rnn":
+            (t,) = self.shape
+            return x.reshape(-1, t, x.shape[-1])
+        raise ValueError(f"unknown preprocessor mode {self.mode!r}")
+
+    def output_shape(self, *input_shapes):
+        s = input_shapes[0]
+        if self.mode == "cnn_to_ff":
+            n = 1
+            for d in s:
+                n *= d
+            return (n,)
+        if self.mode == "ff_to_cnn":
+            return tuple(self.shape)
+        if self.mode == "rnn_to_ff":
+            return (s[-1],)
+        if self.mode == "ff_to_rnn":
+            return (self.shape[0], s[-1])
+        raise ValueError(f"unknown preprocessor mode {self.mode!r}")
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class FrozenVertex(GraphVertex):
+    """stop_gradient wrapper (conf/graph/FrozenVertex.java): blocks gradient
+    flow through the wrapped vertex's output."""
+
+    inner: Optional[GraphVertex] = None
+
+    def apply(self, *inputs):
+        import jax
+
+        return jax.lax.stop_gradient(self.inner.apply(*inputs))
+
+    def output_shape(self, *input_shapes):
+        return self.inner.output_shape(*input_shapes)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["inner"] = self.inner.to_dict()
+        return d
