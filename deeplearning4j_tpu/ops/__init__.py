@@ -29,6 +29,7 @@ from deeplearning4j_tpu.ops import (  # noqa: F401
     attention,
     compression,
     elementwise,
+    image,
     linalg,
     nn,
     random,

@@ -241,3 +241,35 @@ op("shift_right", "pairwise_bool", aliases=("right_shift", "rshift_bits"),
    differentiable=False)(
     lambda x, y: jnp.right_shift(jnp.asarray(x), jnp.asarray(y))
 )
+
+
+# ---------------------------------------------------------------------------
+# Special functions (reference: generic/parity_ops/{igamma,igammac,polygamma,
+# zeta,betainc,lgamma,digamma}.cpp — path-cite, mount empty)
+# ---------------------------------------------------------------------------
+
+op("igamma", "pairwise")(
+    lambda a, x: jax.scipy.special.gammainc(a, x))
+op("igammac", "pairwise")(
+    lambda a, x: jax.scipy.special.gammaincc(a, x))
+op("polygamma", "pairwise")(
+    lambda n, x: jax.scipy.special.polygamma(n.astype(jnp.int32)
+                                             if hasattr(n, "astype") else n, x))
+op("zeta", "pairwise")(
+    lambda x, q: jax.scipy.special.zeta(x, q))
+op("betainc", "transform_float")(
+    lambda a, b, x: jax.scipy.special.betainc(a, b, x))
+op("lgamma", "transform_float", aliases=("gammaln",))(
+    lambda x: jax.scipy.special.gammaln(x))
+op("digamma", "transform_float")(
+    lambda x: jax.scipy.special.digamma(x))
+op("erfinv", "transform_float")(
+    lambda x: jax.scipy.special.erfinv(x))
+op("i0", "transform_float")(
+    lambda x: jax.scipy.special.i0(x))
+op("i1", "transform_float")(
+    lambda x: jax.scipy.special.i1(x))
+op("logit", "transform_float")(
+    lambda x: jax.scipy.special.logit(x))
+op("expit", "transform_float")(
+    lambda x: jax.scipy.special.expit(x))

@@ -1,0 +1,251 @@
+"""Image ops: resize, crop_and_resize, NMS, color-space conversions.
+
+Reference parity: libnd4j ops/declarable/generic/images/** and
+ops/declarable/generic/parity_ops/ (resize_bilinear.cpp, resize_nearest.cpp,
+resize_bicubic.cpp, crop_and_resize.cpp, non_max_suppression.cpp,
+extract_image_patches.cpp, adjust_{hue,saturation,contrast}.cpp,
+{rgb,hsv,yuv}_to_*.cpp, image ops in the sd.image namespace) — path-cite,
+mount empty this round.
+
+All ops are NHWC (TPU layout) and XLA-traceable: NMS is a fori_loop with a
+static max_output_size (static shapes are an XLA requirement — the reference
+returns dynamic-length indices; here the index list is padded with -1)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+# ------------------------------------------------------------------- resize
+
+
+def _resize(x, size, method):
+    B, _, _, C = x.shape
+    out = jax.image.resize(x, (B, int(size[0]), int(size[1]), C),
+                           method=method)
+    return out.astype(x.dtype) if method != "nearest" else out
+
+
+@op("image_resize", "image")
+def image_resize(x, size, method="bilinear"):
+    """tf.image.resize parity; method: bilinear | nearest | cubic."""
+    method = {"bicubic": "cubic"}.get(method, method)
+    return _resize(x, size, method)
+
+
+@op("resize_bilinear", "image", aliases=("resizebilinear",))
+def resize_bilinear(x, size=None, height=None, width=None):
+    return _resize(x, size or (height, width), "bilinear")
+
+
+@op("resize_nearest", "image", aliases=("resizenearest", "resize_nearest_neighbor"))
+def resize_nearest(x, size=None, height=None, width=None):
+    return _resize(x, size or (height, width), "nearest")
+
+
+@op("resize_bicubic", "image", aliases=("resizebicubic",))
+def resize_bicubic(x, size=None, height=None, width=None):
+    return _resize(x, size or (height, width), "cubic")
+
+
+@op("crop_and_resize", "image")
+def crop_and_resize(image, boxes, box_indices, crop_size, method="bilinear"):
+    """TF crop_and_resize: normalized [y1,x1,y2,x2] boxes over a batch.
+
+    image (B,H,W,C); boxes (N,4); box_indices (N,) → (N, ch, cw, C)."""
+    H, W = image.shape[1], image.shape[2]
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    order = 1 if method == "bilinear" else 0
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = y1 * (H - 1) + (jnp.arange(ch) / max(ch - 1, 1)) * (y2 - y1) * (H - 1)
+        xs = x1 * (W - 1) + (jnp.arange(cw) / max(cw - 1, 1)) * (x2 - x1) * (W - 1)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        img = image[bi].astype(jnp.float32)
+
+        def chan(c):
+            return jax.scipy.ndimage.map_coordinates(
+                img[:, :, c], [gy, gx], order=order, mode="constant")
+
+        return jnp.stack([chan(c) for c in range(image.shape[3])], axis=-1)
+
+    out = jax.vmap(one)(jnp.asarray(boxes, jnp.float32),
+                        jnp.asarray(box_indices, jnp.int32))
+    return out.astype(image.dtype)
+
+
+@op("extract_image_patches", "image")
+def extract_image_patches(x, ksizes, strides=(1, 1), rates=(1, 1),
+                          padding="VALID"):
+    """TF extract_image_patches: (B,H,W,C) → (B,oh,ow,kh*kw*C)."""
+    kh, kw = ksizes
+    c = x.shape[3]
+    patches = lax.conv_general_dilated_patches(
+        x.transpose(0, 3, 1, 2), (kh, kw), tuple(strides), padding,
+        rhs_dilation=tuple(rates))          # (B, C*kh*kw, oh, ow)
+    B, _, oh, ow = patches.shape
+    # (C,kh,kw) feature order → TF's (kh,kw,C)
+    patches = patches.reshape(B, c, kh * kw, oh, ow).transpose(0, 3, 4, 2, 1)
+    return patches.reshape(B, oh, ow, kh * kw * c)
+
+
+# ---------------------------------------------------------------------- NMS
+
+
+def _iou_matrix(boxes):
+    """boxes (N,4) [y1,x1,y2,x2] → (N,N) IoU."""
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    inter = jnp.maximum(iy2 - iy1, 0) * jnp.maximum(ix2 - ix1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@op("non_max_suppression", "image", aliases=("nms",))
+def non_max_suppression(boxes, scores, max_output_size, iou_threshold=0.5,
+                        score_threshold=-jnp.inf):
+    """Greedy NMS → (max_output_size,) indices padded with -1. Static output
+    size (XLA); the O(N^2) IoU matrix is batched onto the MXU-adjacent
+    vector units rather than the reference's scalar loop."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    iou = _iou_matrix(boxes)
+    m = int(max_output_size)
+
+    def body(_, carry):
+        alive, sel, count = carry
+        s = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(s)
+        ok = jnp.isfinite(s[best])  # any candidate left at all
+        sel = sel.at[count].set(jnp.where(ok, best.astype(jnp.int32), -1))
+        count = count + jnp.where(ok, 1, 0)
+        # suppress overlapping + the selected box itself
+        alive = alive & (iou[best] <= iou_threshold) & ok
+        alive = alive.at[best].set(False)
+        return alive, sel, count
+
+    alive0 = scores >= score_threshold  # -inf default keeps all finite scores
+    sel0 = jnp.full((m,), -1, jnp.int32)
+    _, sel, _ = lax.fori_loop(0, m, body, (alive0, sel0, jnp.int32(0)))
+    return sel
+
+
+# --------------------------------------------------------------- colorspace
+
+
+@op("rgb_to_grayscale", "image", aliases=("rgb_to_grs",))
+def rgb_to_grayscale(x):
+    w = jnp.asarray([0.2989, 0.587, 0.114], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@op("rgb_to_yuv", "image")
+def rgb_to_yuv(x):
+    m = jnp.asarray([[0.299, -0.14714119, 0.61497538],
+                     [0.587, -0.28886916, -0.51496512],
+                     [0.114, 0.43601035, -0.10001026]], jnp.float32)
+    return (x.astype(jnp.float32) @ m).astype(x.dtype)
+
+
+@op("yuv_to_rgb", "image")
+def yuv_to_rgb(x):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.0, -0.394642334, 2.03206185],
+                     [1.13988303, -0.58062185, 0.0]], jnp.float32)
+    return (x.astype(jnp.float32) @ m).astype(x.dtype)
+
+
+@op("rgb_to_hsv", "image")
+def rgb_to_hsv(x):
+    xf = x.astype(jnp.float32)
+    r, g, b = xf[..., 0], xf[..., 1], xf[..., 2]
+    mx = jnp.max(xf, axis=-1)
+    mn = jnp.min(xf, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(d == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1).astype(x.dtype)
+
+
+@op("hsv_to_rgb", "image")
+def hsv_to_rgb(x):
+    xf = x.astype(jnp.float32)
+    h, s, v = xf[..., 0] * 6.0, xf[..., 1], xf[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1).astype(x.dtype)
+
+
+@op("adjust_brightness", "image")
+def adjust_brightness(x, delta):
+    return x + jnp.asarray(delta, x.dtype)
+
+
+@op("adjust_contrast", "image", aliases=("adjust_contrast_v2",))
+def adjust_contrast(x, factor):
+    mean = jnp.mean(x.astype(jnp.float32), axis=(-3, -2), keepdims=True)
+    return (factor * (x.astype(jnp.float32) - mean) + mean).astype(x.dtype)
+
+
+@op("adjust_saturation", "image")
+def adjust_saturation(x, factor):
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@op("adjust_hue", "image")
+def adjust_hue(x, delta):
+    hsv = rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@op("flip_left_right", "image", aliases=("image_flip_left_right",))
+def flip_left_right(x):
+    return jnp.flip(x, axis=-2)
+
+
+@op("flip_up_down", "image", aliases=("image_flip_up_down",))
+def flip_up_down(x):
+    return jnp.flip(x, axis=-3)
+
+
+@op("random_crop", "image")
+def random_crop(key, x, size):
+    """Random spatial crop: x (B,H,W,C) or (H,W,C); size (h, w)."""
+    h, w = int(size[0]), int(size[1])
+    hax, wax = (1, 2) if x.ndim == 4 else (0, 1)
+    kh, kw = jax.random.split(key)
+    oy = jax.random.randint(kh, (), 0, x.shape[hax] - h + 1)
+    ox = jax.random.randint(kw, (), 0, x.shape[wax] - w + 1)
+    start = [0] * x.ndim
+    sizes = list(x.shape)
+    start[hax], start[wax] = oy, ox
+    sizes[hax], sizes[wax] = h, w
+    return lax.dynamic_slice(x, start, sizes)

@@ -136,3 +136,61 @@ def hamming_distance(x, y, axis=None, keepdims=False):
 @op("dot", "reduce3")
 def dot(x, y, axis=None, keepdims=False):
     return jnp.sum(x * y, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# Histogram / order statistics (reference: generic/parity_ops/histogram.cpp,
+# histogram_fixed_width.cpp, percentile.cpp — path-cite, mount empty)
+# ---------------------------------------------------------------------------
+
+
+@op("histogram", "reduce", differentiable=False)
+def histogram(x, nbins=10, range=None):
+    """Counts per bin over min..max (or the given static range)."""
+    xf = jnp.ravel(x).astype(jnp.float32)
+    if range is not None:
+        lo, hi = float(range[0]), float(range[1])
+    else:
+        lo, hi = jnp.min(xf), jnp.max(xf)
+    width = (hi - lo) / nbins
+    idx = jnp.clip(((xf - lo) / jnp.where(width == 0, 1.0, width))
+                   .astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[idx].add(1)
+
+
+@op("histogram_fixed_width", "reduce", differentiable=False)
+def histogram_fixed_width(x, value_range, nbins=100):
+    """TF histogram_fixed_width: out-of-range values clamp to edge bins."""
+    return histogram(x, nbins=int(nbins),
+                     range=(float(value_range[0]), float(value_range[1])))
+
+
+@op("bincount", "reduce", differentiable=False)
+def bincount(x, weights=None, minlength=0, maxlength=None):
+    """Counts of each integer value; static length = max of minlength and
+    (maxlength or minlength) — XLA needs a static output shape, so callers
+    must pass minlength/maxlength (the reference sizes output dynamically)."""
+    length = int(maxlength or minlength)
+    if length <= 0:
+        raise ValueError("bincount needs a static minlength/maxlength under XLA")
+    idx = jnp.clip(jnp.ravel(x).astype(jnp.int32), 0, length - 1)
+    if weights is not None:
+        w = jnp.ravel(weights)
+        return jnp.zeros((length,), w.dtype).at[idx].add(w)
+    return jnp.zeros((length,), jnp.int32).at[idx].add(1)
+
+
+@op("median", "reduce")
+def median(x, axis=None, keepdims=False):
+    return jnp.median(x, axis=axis, keepdims=keepdims)
+
+
+@op("percentile", "reduce")
+def percentile(x, q, axis=None, keepdims=False, interpolation="linear"):
+    return jnp.percentile(x, q, axis=axis, keepdims=keepdims,
+                          method=interpolation)
+
+
+@op("quantile", "reduce")
+def quantile(x, q, axis=None, keepdims=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdims)

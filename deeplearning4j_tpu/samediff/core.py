@@ -74,6 +74,10 @@ _MULTI_OUT: Dict[str, Any] = {
     "split_v": lambda sd, args, attrs: len(tuple(attrs["sizes"])),
     "unstack": _unstack_arity,
     "dynamic_partition": lambda sd, args, attrs: attrs["num_partitions"],
+    "lstm_layer": 3,
+    "gru_layer": 2,
+    "rnn_layer": 2,
+    "lstm_cell": 2,
 }
 
 
@@ -382,6 +386,44 @@ class SDBitwise(_OpNamespace):
               "and_": "and", "or_": "or", "xor_": "xor"}
 
 
+class SDRNN(_OpNamespace):
+    """sd.rnn() parity (SDRNN.java): whole-sequence scan ops + cells."""
+
+    _ALIAS = {"lstmLayer": "lstm_layer", "gruLayer": "gru_layer",
+              "lstmCell": "lstm_cell", "gruCell": "gru_cell",
+              "simpleRnn": "rnn_layer"}
+
+
+class SDCNN(_OpNamespace):
+    """sd.cnn() parity (SDCNN.java)."""
+
+    _ALIAS = {"conv2d": "conv2d", "conv1d": "conv1d", "conv3d": "conv3d",
+              "depthWiseConv2d": "depthwise_conv2d",
+              "separableConv2d": "separable_conv2d",
+              "deconv2d": "deconv2d", "maxPooling2d": "maxpool2d",
+              "avgPooling2d": "avgpool2d", "maxPooling3d": "maxpool3d",
+              "avgPooling3d": "avgpool3d", "upsampling2d": "upsampling2d",
+              "im2Col": "im2col", "spaceToDepth": "space_to_depth",
+              "depthToSpace": "depth_to_space", "batchToSpace": "batch_to_space",
+              "localResponseNormalization": "lrn"}
+
+
+class SDImage(_OpNamespace):
+    """sd.image() parity (SDImage.java)."""
+
+    _ALIAS = {"resizeBiLinear": "resize_bilinear",
+              "resizeNearestNeighbor": "resize_nearest",
+              "resizeBiCubic": "resize_bicubic",
+              "cropAndResize": "crop_and_resize",
+              "nonMaxSuppression": "non_max_suppression",
+              "extractImagePatches": "extract_image_patches",
+              "adjustContrast": "adjust_contrast",
+              "adjustSaturation": "adjust_saturation",
+              "adjustHue": "adjust_hue", "randomCrop": "random_crop",
+              "rgbToHsv": "rgb_to_hsv", "hsvToRgb": "hsv_to_rgb",
+              "rgbToYuv": "rgb_to_yuv", "yuvToRgb": "yuv_to_rgb"}
+
+
 # ---------------------------------------------------------------------------
 # TrainingConfig
 # ---------------------------------------------------------------------------
@@ -510,6 +552,18 @@ class SameDiff:
     @property
     def bitwise(self):
         return SDBitwise(self)
+
+    @property
+    def rnn(self):
+        return SDRNN(self)
+
+    @property
+    def cnn(self):
+        return SDCNN(self)
+
+    @property
+    def image(self):
+        return SDImage(self)
 
     # -- variable creation --------------------------------------------------
     def _unique(self, base: str) -> str:

@@ -185,6 +185,42 @@ OVERRIDES = {
                              jnp.ones((12, 3)) * 0.1),
     "gru_cell": lambda f: f(jnp.ones((2, 4)), jnp.zeros((2, 3)),
                             jnp.ones((9, 4)) * 0.1, jnp.ones((9, 3)) * 0.1),
+    # image ops
+    "image_resize": lambda f: f(IMG, (2, 2)),
+    "resize_bilinear": lambda f: f(IMG, (2, 2)),
+    "resize_nearest": lambda f: f(IMG, (2, 2)),
+    "resize_bicubic": lambda f: f(IMG, (8, 8)),
+    "crop_and_resize": lambda f: f(IMG, jnp.asarray([[0.0, 0.0, 1.0, 1.0]]),
+                                   jnp.asarray([0]), (2, 2)),
+    "extract_image_patches": lambda f: f(IMG, (2, 2)),
+    "non_max_suppression": lambda f: f(
+        jnp.asarray([[0, 0, 1, 1], [0.5, 0.5, 1, 1]]),
+        jnp.asarray([0.9, 0.8]), 2),
+    "adjust_brightness": lambda f: f(IMG, 0.1),
+    "adjust_contrast": lambda f: f(IMG, 1.5),
+    "adjust_saturation": lambda f: f(IMG[..., :3] / 2 + 0.2, 1.2),
+    "adjust_hue": lambda f: f(IMG[..., :3] / 2 + 0.2, 0.1),
+    "rgb_to_hsv": lambda f: f(IMG[..., :3] / 2 + 0.2),
+    "hsv_to_rgb": lambda f: f(IMG[..., :3] / 2 + 0.2),
+    "rgb_to_grayscale": lambda f: f(IMG[..., :3] / 2),
+    "rgb_to_yuv": lambda f: f(IMG[..., :3] / 2),
+    "yuv_to_rgb": lambda f: f(IMG[..., :3] / 2),
+    "flip_left_right": lambda f: f(IMG),
+    "flip_up_down": lambda f: f(IMG),
+    "random_crop": lambda f: f(KEY, IMG, (2, 2)),
+    # order stats / histograms
+    "histogram": lambda f: f(XN, 4),
+    "histogram_fixed_width": lambda f: f(XN, (-1.0, 1.0), 4),
+    "bincount": lambda f: f(jnp.asarray([0, 1, 1, 2]), minlength=3),
+    "percentile": lambda f: f(XN, 50.0),
+    "quantile": lambda f: f(XN, 0.5),
+    # special functions
+    "igamma": lambda f: f(X + 0.5, X + 0.5),
+    "igammac": lambda f: f(X + 0.5, X + 0.5),
+    "polygamma": lambda f: f(jnp.ones_like(X), X + 0.5),
+    "zeta": lambda f: f(X + 1.5, X + 0.5),
+    "betainc": lambda f: f(X + 0.5, X + 0.5, X * 0.5 + 0.2),
+    "logit": lambda f: f(X * 0.5 + 0.2),
 }
 
 # EXACT category match only ("reduce3".startswith("reduce") must not route

@@ -254,3 +254,58 @@ def test_fit_after_adding_trainable_keeps_moments():
     sd.set_loss_variables(loss2)
     hist = sd.fit((xv, yv), epochs=40)  # must not raise
     assert hist[-1] < hist[0] and hist[-1] < 0.2, hist[-5:]
+
+
+class TestRound2Namespaces:
+    """sd.rnn / sd.cnn / sd.image namespaces (SDRNN/SDCNN/SDImage parity)."""
+
+    def test_rnn_namespace_lstm_layer(self, rng):
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(4, 2, 3))
+        W = sd.constant((rng.standard_normal((1, 16, 3)) * 0.2)
+                        .astype(np.float32), name="W")
+        R = sd.constant((rng.standard_normal((1, 16, 4)) * 0.2)
+                        .astype(np.float32), name="R")
+        y, yh, yc = sd.rnn.lstmLayer(x, W, R, hidden_size=4)
+        xs = rng.standard_normal((4, 2, 3)).astype(np.float32)
+        res = sd.output({"x": xs}, [y.name, yh.name, yc.name])
+        assert res[y.name].shape == (4, 1, 2, 4)
+        assert res[yh.name].shape == (1, 2, 4)
+
+    def test_cnn_namespace(self, rng):
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(2, 8, 8, 3))
+        w = sd.constant((rng.standard_normal((3, 3, 3, 4)) * 0.2)
+                        .astype(np.float32), name="w")
+        y = sd.cnn.conv2d(x, w)
+        p = sd.cnn.maxPooling2d(y, kernel=(2, 2))
+        xs = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+        res = sd.output({"x": xs}, [p.name])
+        assert res[p.name].shape == (2, 4, 4, 4)
+
+    def test_image_namespace(self, rng):
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(2, 8, 8, 3))
+        y = sd.image.resizeBiLinear(x, size=(4, 4))
+        xs = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+        res = sd.output({"x": xs}, [y.name])
+        assert res[y.name].shape == (2, 4, 4, 3)
+
+    def test_image_nms(self):
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        sd = SameDiff()
+        boxes = sd.constant(np.asarray(
+            [[0, 0, 1, 1], [0, 0, 0.95, 0.95], [0.6, 0.6, 1, 1]], np.float32),
+            name="boxes")
+        scores = sd.constant(np.asarray([0.9, 0.8, 0.7], np.float32),
+                             name="scores")
+        idx = sd.image.nonMaxSuppression(boxes, scores, 3, iou_threshold=0.5)
+        res = sd.output({}, [idx.name])
+        np.testing.assert_array_equal(res[idx.name], [0, 2, -1])
