@@ -1,0 +1,75 @@
+"""Worker process for the multi-process DCN-bootstrap test.
+
+Usage: python _dist_worker.py <coordinator> <num_processes> <process_id>
+
+Each process runs the SAME SPMD program over the GLOBAL mesh (the TPU-native
+shape of SharedTrainingMaster workers — SURVEY.md §3.4): the gradient
+all-reduce is emitted by the partitioner and rides the cross-process
+collective channel the coordinator bootstrapped."""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+
+
+def main():
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    distributed.initialize(coordinator=coordinator, num_processes=nproc,
+                           process_id=pid)
+    assert distributed.process_count() == nproc
+    assert distributed.process_index() == pid
+    assert distributed.is_coordinator() == (pid == 0)
+
+    tmesh = distributed.global_mesh()
+    mesh = tmesh.mesh
+    n_dev = len(jax.devices())
+
+    D = 8
+    rng = np.random.default_rng(0)  # same data recipe on every process
+    w_true = rng.normal(size=(D,)).astype(np.float32)
+    # deterministic global batch; each process materializes its local rows
+    B = 4 * n_dev
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    Y = X @ w_true
+
+    xsh = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    n_local = B // nproc
+    lo = pid * n_local
+    x = jax.make_array_from_process_local_data(xsh, X[lo: lo + n_local])
+    y = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), Y[lo: lo + n_local])
+    w = jax.make_array_from_process_local_data(
+        rep, np.zeros((D,), np.float32))
+
+    @jax.jit
+    def step(w, x, y):
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        g = jax.grad(loss)(w)  # partitioner inserts the cross-host allreduce
+        return w - 0.2 * g
+
+    for _ in range(30):
+        w = step(w, x, y)
+    w_final = np.asarray(jax.device_get(w))
+    print(json.dumps({
+        "pid": pid,
+        "n_devices_global": n_dev,
+        "w": [round(float(v), 6) for v in w_final],
+        "err": round(float(np.abs(w_final - w_true).max()), 6),
+    }), flush=True)
+    distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
