@@ -8,6 +8,9 @@ utils/etl milestone.
 """
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.data.image_iterator import (  # noqa: F401
+    AsyncImageDataSetIterator,
+)
 from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     ArrayDataSetIterator,
     DataSetIterator,

@@ -203,6 +203,14 @@ class ImageRecordReader(RecordReader):
             self.labels = sorted({l for _, l in self.items})
 
     def _load(self, path: str) -> np.ndarray:
+        from deeplearning4j_tpu import native
+
+        if native.image_available():  # NativeImageLoader path (C++ decode)
+            try:
+                return native.decode_image_file(
+                    path, self.height, self.width, self.channels)
+            except ValueError:
+                pass  # non-JPEG/PNG format: PIL fallback below
         from PIL import Image
 
         img = Image.open(path)
