@@ -79,12 +79,16 @@ class WordVectorsMixin:
 
 
 class Word2Vec(WordVectorsMixin):
-    """Skip-gram with negative sampling (Word2Vec.Builder parity args)."""
+    """Skip-gram with negative sampling OR hierarchical softmax
+    (Word2Vec.Builder parity args; useHierarchicSoftmax — the reference's
+    other learning impl, models/embeddings/learning/impl/elements/
+    HierarchicSoftmax.java). Like word2vec.c, ``negative=0`` implies HS."""
 
     def __init__(self, min_word_frequency: int = 5, layer_size: int = 100,
                  window_size: int = 5, negative: int = 5, epochs: int = 1,
                  learning_rate: float = 0.025, subsample: float = 1e-3,
-                 batch_size: int = 1024, seed: int = 0):
+                 batch_size: int = 1024, seed: int = 0,
+                 use_hierarchic_softmax: bool = False):
         self.min_word_frequency = min_word_frequency
         self.layer_size = layer_size
         self.window_size = window_size
@@ -94,6 +98,7 @@ class Word2Vec(WordVectorsMixin):
         self.subsample = subsample
         self.batch_size = batch_size
         self.seed = seed
+        self.use_hierarchic_softmax = use_hierarchic_softmax or negative == 0
         self.vocab: Optional[_VocabCache] = None
         self.vectors: Optional[np.ndarray] = None
         self._tok = DefaultTokenizer()
@@ -107,26 +112,42 @@ class Word2Vec(WordVectorsMixin):
             raise ValueError("empty vocabulary (check min_word_frequency)")
         rng = np.random.default_rng(self.seed)
         centers, contexts = self._pairs(token_lines, rng)
-        # unigram^0.75 negative-sampling table (reference's sampling dist)
-        p = self.vocab.counts ** 0.75
-        p /= p.sum()
-
         w_in = jnp.asarray(rng.normal(0, 1.0 / np.sqrt(D), (V, D)), jnp.float32)
-        w_out = jnp.zeros((V, D), jnp.float32)
-        step = _sgns_step(self.negative)
         key = jax.random.PRNGKey(self.seed)
-        probs = jnp.asarray(p, jnp.float32)
         lr = self.learning_rate
-        for _ in range(self.epochs):
-            order = rng.permutation(len(centers))
-            for s in range(0, len(order), self.batch_size):
-                idx = order[s:s + self.batch_size]
-                key, sub = jax.random.split(key)
-                w_in, w_out = step(
-                    w_in, w_out, jnp.asarray(centers[idx]),
-                    jnp.asarray(contexts[idx]), probs, sub, lr)
+        if self.use_hierarchic_softmax:
+            codes, points, mask = _build_huffman(self.vocab.counts)
+            syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+            codes_j = jnp.asarray(codes)
+            points_j = jnp.asarray(points)
+            mask_j = jnp.asarray(mask)
+            step = _hs_step()
+            for _ in range(self.epochs):
+                order = rng.permutation(len(centers))
+                for s in range(0, len(order), self.batch_size):
+                    idx = order[s:s + self.batch_size]
+                    ctx = jnp.asarray(contexts[idx])
+                    w_in, syn1 = step(
+                        w_in, syn1, jnp.asarray(centers[idx]),
+                        codes_j[ctx], points_j[ctx], mask_j[ctx], lr)
+            self.syn1 = np.asarray(syn1)
+        else:
+            # unigram^0.75 negative-sampling table (reference's sampling dist)
+            p = self.vocab.counts ** 0.75
+            p /= p.sum()
+            w_out = jnp.zeros((V, D), jnp.float32)
+            step = _sgns_step(self.negative)
+            probs = jnp.asarray(p, jnp.float32)
+            for _ in range(self.epochs):
+                order = rng.permutation(len(centers))
+                for s in range(0, len(order), self.batch_size):
+                    idx = order[s:s + self.batch_size]
+                    key, sub = jax.random.split(key)
+                    w_in, w_out = step(
+                        w_in, w_out, jnp.asarray(centers[idx]),
+                        jnp.asarray(contexts[idx]), probs, sub, lr)
+            self.syn1 = np.asarray(w_out)
         self.vectors = np.asarray(w_in)
-        self.syn1 = np.asarray(w_out)
         return self
 
     def _pairs(self, token_lines, rng):
@@ -151,6 +172,90 @@ class Word2Vec(WordVectorsMixin):
         if not cs:
             raise ValueError("no training pairs (corpus too small)")
         return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+
+
+def _build_huffman(counts, max_code: int = 40):
+    """Huffman coding over word counts (word2vec.c CreateBinaryTree /
+    the reference's Huffman.java). counts MUST be sorted descending (the
+    vocab builder guarantees it). Returns (codes, points, mask) arrays of
+    shape (V, L): per word, the branch bits along its root→leaf path, the
+    internal-node ids taking syn1 rows, and a validity mask."""
+    V = len(counts)
+    if V < 2:
+        return (np.zeros((V, 1), np.float32), np.zeros((V, 1), np.int32),
+                np.zeros((V, 1), np.float32))
+    count = np.concatenate([np.asarray(counts, np.float64),
+                            np.full(V - 1, 1e18)])
+    parent = np.zeros(2 * V - 2, np.int64)
+    binary = np.zeros(2 * V - 2, np.int8)
+    pos1, pos2 = V - 1, V
+    for a in range(V - 1):
+        mins = []
+        for _ in range(2):
+            if pos1 >= 0 and count[pos1] < count[pos2]:
+                mins.append(pos1)
+                pos1 -= 1
+            else:
+                mins.append(pos2)
+                pos2 += 1
+        m1, m2 = mins
+        count[V + a] = count[m1] + count[m2]
+        if m1 < 2 * V - 2:
+            parent[m1] = V + a
+        if m2 < 2 * V - 2:
+            parent[m2] = V + a
+            binary[m2] = 1
+    root = 2 * V - 2
+    codes_l, points_l = [], []
+    L = 1
+    for a in range(V):
+        # walk leaf→root: each step records the branch bit of the child and
+        # the internal node (parent) whose output vector decides that branch
+        code, parents = [], []
+        b = a
+        while b != root:
+            code.append(int(binary[b]))
+            parents.append(int(parent[b]) - V)
+            b = parent[b]
+        code = code[::-1][:max_code]      # root-side first (word2vec.c order)
+        parents = parents[::-1][:max_code]
+        codes_l.append(code)
+        points_l.append(parents)
+        L = max(L, len(code))
+    L = min(L, max_code)
+    codes = np.zeros((V, L), np.float32)
+    points = np.zeros((V, L), np.int32)
+    mask = np.zeros((V, L), np.float32)
+    for a in range(V):
+        n = min(len(codes_l[a]), L)
+        codes[a, :n] = codes_l[a][:n]
+        points[a, :n] = points_l[a][:n]
+        mask[a, :n] = 1.0
+    return codes, points, mask
+
+
+def _hs_step():
+    """One jitted hierarchical-softmax SGD step: for each (center, context)
+    pair, walk the CONTEXT word's Huffman path with the center's input
+    vector — a batched (B,L) sigmoid instead of the reference's per-node
+    host loop."""
+
+    @jax.jit
+    def step(w_in, syn1, centers, codes, points, mask, lr):
+        v = w_in[centers]                         # (B, D)
+        nodes = syn1[points]                      # (B, L, D)
+        logits = jnp.einsum("bd,bld->bl", v, nodes)
+        # label for each branch is 1 - code (word2vec.c convention); mean
+        # over the batch (matches the SGNS step's mean-loss gradient scale)
+        g = ((1.0 - codes) - jax.nn.sigmoid(logits)) * mask / centers.shape[0]
+        dv = jnp.einsum("bl,bld->bd", g, nodes)
+        dnodes = g[:, :, None] * v[:, None, :]    # (B, L, D)
+        w_in = w_in.at[centers].add(lr * dv)
+        syn1 = syn1.at[points.reshape(-1)].add(
+            lr * dnodes.reshape(-1, v.shape[-1]))
+        return w_in, syn1
+
+    return step
 
 
 def _sgns_step(n_neg: int):

@@ -999,7 +999,13 @@ class SameDiff:
     def save(self, path: str, save_updater_state: bool = False):
         """sd.save(file) parity — zip{graph.json, arrays.npz[, updater.npz]}
         (content model of the reference's FlatBuffers .fb: structure + values
-        + optional updater state)."""
+        + optional updater state).
+
+        DECLARED NON-GOAL: byte-level .fb interop. The reference's FlatBuffers
+        schema serializes its op enum/DeclarableOp identities, which do not
+        exist here (ops lower to XLA); a faithful .fb reader would need the
+        whole libnd4j op-id table for zero capability gain. Models cross the
+        boundary via the TF/ONNX/Keras importers instead."""
         for node in self._nodes:
             if node.op.startswith("__custom__"):
                 raise ValueError(
