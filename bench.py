@@ -187,7 +187,9 @@ def main():
         result = bench_lenet(batch=512 if on_tpu else 64, steps=steps)
     extra = []
     try:
-        extra.append(bench_bert(batch=32 if on_tpu else 4,
+        # batch 128: measured sweep (BASELINE.md) — 32 underutilizes the MXU
+        # (877 samples/s vs 1,166 at 128); flash attention loses at seq 128
+        extra.append(bench_bert(batch=128 if on_tpu else 4,
                                 seq=128 if on_tpu else 32,
                                 steps=steps, tiny=not on_tpu))
     except Exception as e:
