@@ -116,29 +116,41 @@ def _read_weights(grp) -> Dict[str, List[np.ndarray]]:
     return out
 
 
+def _walk_refs(obj, refs):
+    """Collect (layer_name, node_index) producer refs from an inbound spec
+    (v2 list format or v3 __keras_tensor__/keras_history format)."""
+    if isinstance(obj, dict):
+        hist = obj.get("config", {}).get("keras_history")
+        if obj.get("class_name") == "__keras_tensor__" and hist:
+            refs.append((hist[0], int(hist[1]) if len(hist) > 1 else 0))
+        else:
+            for v in obj.values():
+                _walk_refs(v, refs)
+    elif isinstance(obj, (list, tuple)):
+        if (len(obj) >= 3 and isinstance(obj[0], str)
+                and isinstance(obj[1], int)):  # v2 [name, node, tensor, ...]
+            refs.append((obj[0], int(obj[1])))
+        else:
+            for v in obj:
+                _walk_refs(v, refs)
+
+
 def _inbound_names(layer_cfg):
-    """Source layer names from Keras inbound_nodes (v2 list format or v3
-    __keras_tensor__/keras_history format)."""
-    names = []
+    """Source layer names from Keras inbound_nodes (all call nodes)."""
+    refs: list = []
+    _walk_refs(layer_cfg.get("inbound_nodes", []), refs)
+    return [r[0] for r in refs]
 
-    def walk(obj):
-        if isinstance(obj, dict):
-            hist = obj.get("config", {}).get("keras_history")
-            if obj.get("class_name") == "__keras_tensor__" and hist:
-                names.append(hist[0])
-            else:
-                for v in obj.values():
-                    walk(v)
-        elif isinstance(obj, (list, tuple)):
-            if (len(obj) >= 3 and isinstance(obj[0], str)
-                    and isinstance(obj[1], int)):  # v2 [name, node, tensor, ...]
-                names.append(obj[0])
-            else:
-                for v in obj:
-                    walk(v)
 
-    walk(layer_cfg.get("inbound_nodes", []))
-    return names
+def _inbound_refs_per_call(layer_cfg):
+    """Per call node: [(producer_name, producer_node_index), ...] — the
+    node_index distinguishes calls of weight-shared layers."""
+    out = []
+    for entry in layer_cfg.get("inbound_nodes", []) or []:
+        refs: list = []
+        _walk_refs(entry, refs)
+        out.append(refs)
+    return out
 
 
 def _n_call_nodes(layer_cfg) -> int:
@@ -156,6 +168,8 @@ def _is_dag(config) -> bool:
         return True
     prev = None
     for lc in layer_cfgs:
+        if _n_call_nodes(lc) > 1:  # weight sharing → SharedLayer nodes
+            return True
         inbound = _inbound_names(lc)
         if len(inbound) > 1 or lc["class_name"] in _MERGE_VERTICES:
             return True
@@ -223,6 +237,7 @@ def _build(config, weights):
 _MERGE_VERTICES = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
                    "Average": "avg", "Maximum": "max", "Minimum": "min",
                    "Concatenate": None}
+_MERGE_VERTICES.update({"Subtract": "subtract", "Multiply": "product"})
 
 
 def _build_functional(config, weights):
@@ -238,25 +253,32 @@ def _build_functional(config, weights):
     input_shapes = []
     param_map = {}
     state_map = {}
-    rename = {}  # pass-through layers (Flatten) alias to their inbound
+    # (keras layer name, call node idx) -> CG node name; pass-through layers
+    # (Flatten) alias to their inbound
+    node_name = {}
+
+    def cg_name(ref):
+        return node_name.get(ref, ref[0])
+
     for lc in layer_cfgs:
         kcls = lc["class_name"]
         cfg = lc.get("config", {})
         name = cfg.get("name", kcls)
-        if _n_call_nodes(lc) > 1:
-            raise KerasImportError(
-                f"layer {name!r} is called {_n_call_nodes(lc)} times "
-                "(weight sharing) — not supported")
-        inbound = [rename.get(i, i) for i in _inbound_names(lc)]
+        calls = _inbound_refs_per_call(lc)
         if kcls == "InputLayer":
             shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
             gb.add_inputs(name)
+            node_name[(name, 0)] = name
             input_shapes.append(tuple(shape[1:]))
             continue
         if kcls in _MERGE_VERTICES:
             op = _MERGE_VERTICES[kcls]
-            vertex = MergeVertex() if op is None else ElementWiseVertex(op=op)
-            gb.add_vertex(name, vertex, *inbound)
+            for k, refs in enumerate(calls):
+                nm = name if k == 0 else f"{name}@{k}"
+                vertex = (MergeVertex() if op is None
+                          else ElementWiseVertex(op=op))
+                gb.add_vertex(nm, vertex, *[cg_name(r) for r in refs])
+                node_name[(name, k)] = nm
             continue
         built = _LAYER_BUILDERS.get(kcls)
         if built is None:
@@ -265,15 +287,27 @@ def _build_functional(config, weights):
         lyr, p = out[0], out[1]
         st = out[2] if len(out) > 2 else {}
         if lyr is None:  # pass-through (Flatten): downstream reads its input
-            rename[name] = inbound[0]
+            for k, refs in enumerate(calls):
+                node_name[(name, k)] = cg_name(refs[0])
             continue
-        gb.add_layer(name, lyr, *inbound)
+        for k, refs in enumerate(calls):
+            inbound = [cg_name(r) for r in refs]
+            if k == 0:
+                gb.add_layer(name, lyr, *inbound)
+                node_name[(name, 0)] = name
+            else:  # weight sharing: computation repeats over call 0's params
+                nm = f"{name}@{k}"
+                gb.add_layer(nm, L.SharedLayer(source=name, layer=lyr),
+                             *inbound)
+                node_name[(name, k)] = nm
         param_map[name] = p
         state_map[name] = st
     outs = cfgd.get("output_layers", [])
-    out_names = ([o[0] for o in outs] if outs and isinstance(outs[0], list)
-                 else [outs[0]] if outs else [layer_cfgs[-1]["config"]["name"]])
-    out_names = [rename.get(o, o) for o in out_names]
+    out_refs = ([(o[0], int(o[1]) if len(o) > 1 else 0) for o in outs]
+                if outs and isinstance(outs[0], list)
+                else [(outs[0], 0)] if outs
+                else [(layer_cfgs[-1]["config"]["name"], 0)])
+    out_names = [cg_name(r) for r in out_refs]
     gb.set_outputs(*out_names)
     gb.set_input_types(*input_shapes)
     net = ComputationGraph(gb.build()).init()

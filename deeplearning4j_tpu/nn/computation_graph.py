@@ -255,6 +255,13 @@ class ComputationGraph:
                     f"output {name!r} is consumed by another node — outputs "
                     "must be terminal (IOutputLayer semantics)"
                 )
+        layer_names = {n.name for n in self.topo if n.is_layer}
+        for n in self.topo:
+            if isinstance(n.node, L.SharedLayer) \
+                    and n.node.source not in layer_names:
+                raise ValueError(
+                    f"SharedLayer {n.name!r} references unknown source "
+                    f"{n.node.source!r}")
 
     # ------------------------------------------------------------------ init
     def init(self, input_shapes=None) -> "ComputationGraph":
@@ -354,6 +361,14 @@ class ComputationGraph:
             return {"mask": mask}
         return {}
 
+    @staticmethod
+    def _resolve_shared(node, name):
+        """(layer-to-apply, params/state key): SharedLayer nodes compute with
+        their source node's params (weight sharing)."""
+        if isinstance(node, L.SharedLayer):
+            return node.layer, node.source
+        return node, name
+
     def _forward(self, params, states, inputs, *, training, keys=None,
                  mask=None):
         """inputs: dict name->array. Returns (dict name->activation, states)."""
@@ -364,12 +379,13 @@ class ComputationGraph:
             if n.is_layer:
                 k = keys[n.name] if keys is not None else None
                 x = self._gather_input(acts, n)
-                h, ns = n.node.apply(
-                    cparams[n.name], states[n.name], x,
-                    training=training, key=k, **self._mask_kw(n.node, mask, x),
+                lyr, pkey = self._resolve_shared(n.node, n.name)
+                h, ns = lyr.apply(
+                    cparams[pkey], states[pkey], x,
+                    training=training, key=k, **self._mask_kw(lyr, mask, x),
                 )
                 acts[n.name] = h
-                new_states[n.name] = ns
+                new_states[pkey] = ns
             else:
                 acts[n.name] = n.node.apply(*self._gather_input(acts, n))
         return acts, new_states
@@ -404,12 +420,13 @@ class ComputationGraph:
                 )
                 acts[n.name] = x  # terminal; activation unused downstream
             else:
-                h, ns = n.node.apply(
-                    cparams[n.name], states[n.name], x, training=True,
-                    key=keys[n.name], **self._mask_kw(n.node, mask, x),
+                lyr, pkey = self._resolve_shared(n.node, n.name)
+                h, ns = lyr.apply(
+                    cparams[pkey], states[pkey], x, training=True,
+                    key=keys[n.name], **self._mask_kw(lyr, mask, x),
                 )
                 acts[n.name] = h
-                new_states[n.name] = ns
+                new_states[pkey] = ns
         reg = sum(
             (
                 n.node.regularization(params[n.name])
@@ -470,12 +487,13 @@ class ComputationGraph:
                 acts[n.name] = h
                 new_carries[n.name] = c
             else:
-                h, ns = n.node.apply(
-                    cparams[n.name], states[n.name], x, training=True,
-                    key=keys[n.name], **self._mask_kw(n.node, mask, x),
+                lyr, pkey = self._resolve_shared(n.node, n.name)
+                h, ns = lyr.apply(
+                    cparams[pkey], states[pkey], x, training=True,
+                    key=keys[n.name], **self._mask_kw(lyr, mask, x),
                 )
                 acts[n.name] = h
-                new_states[n.name] = ns
+                new_states[pkey] = ns
         reg = sum((n.node.regularization(params[n.name])
                    for n in self.topo if n.is_layer), start=0.0)
         return loss + reg, (new_states, new_carries)

@@ -607,3 +607,36 @@ class LayerNormalization(Layer):
 
     def apply(self, params, state, x, *, training=False, key=None):
         return nnops.layernorm(x, params["gamma"], params["beta"]), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SharedLayer(Layer):
+    """Weight-sharing reference: applies ``layer``'s computation with the
+    params of the graph node named ``source`` (Keras multi-call layers; the
+    reference models these as repeated KerasLayer instances over one weight
+    set). Owns NO params — ComputationGraph resolves the source's params at
+    apply time, and autodiff accumulates both call sites' gradients into the
+    source automatically."""
+
+    source: str = ""
+    layer: Optional[Layer] = None
+
+    def initialize(self, key, input_shape):
+        return {}, {}
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape):
+        return self.layer.output_shape(input_shape)
+
+    def apply(self, params, state, x, *, training=False, key=None):
+        raise RuntimeError(
+            "SharedLayer is resolved by ComputationGraph (needs the source "
+            "node's params); it cannot be applied standalone")
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["layer"] = self.layer.to_dict()
+        return d

@@ -118,6 +118,11 @@ class ElementWiseVertex(GraphVertex):
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
             return out
+        if o == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
         raise ValueError(f"unknown ElementWiseVertex op {self.op}")
 
     def output_shape(self, *input_shapes):

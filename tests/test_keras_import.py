@@ -172,16 +172,59 @@ def test_functional_flatten_head(rng, tmp_path):
                                atol=1e-4, rtol=1e-4)
 
 
-def test_functional_weight_sharing_rejected(rng, tmp_path):
+def test_functional_weight_sharing(rng, tmp_path):
+    """A layer called twice imports as one param set + a SharedLayer node
+    (KerasModel.java models this as repeated layers over one weight set)."""
     inp1 = tf.keras.Input((4,), name="a")
     inp2 = tf.keras.Input((4,), name="b")
-    shared = tf.keras.layers.Dense(3, name="shared")
+    shared = tf.keras.layers.Dense(3, name="shared", activation="tanh")
     m = tf.keras.layers.Concatenate(name="cat")([shared(inp1), shared(inp2)])
     model = tf.keras.Model([inp1, inp2], tf.keras.layers.Dense(2, name="o")(m))
     path = str(tmp_path / "sh.h5")
     model.save(path)
-    with pytest.raises(ValueError, match="shared"):
-        KerasModelImport.import_keras_model_and_weights(path)
+    x1 = rng.normal(size=(5, 4)).astype(np.float32)
+    x2 = rng.normal(size=(5, 4)).astype(np.float32)
+    golden = np.asarray(model([x1, x2]))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(net.output(x1, x2))
+    np.testing.assert_allclose(got, golden, atol=1e-5, rtol=1e-4)
+    # exactly ONE param set for the shared layer
+    assert "shared" in net.params and net.params["shared"]
+    assert not net.params.get("shared@1")
+    # gradients from BOTH call sites accumulate into the source when training
+    from deeplearning4j_tpu.nn.transfer import TransferLearning
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    trainable = (TransferLearning.GraphBuilder(net)
+                 .remove_vertex_and_connections("o")
+                 .add_layer("head", OutputLayer(n_in=6, n_out=2), "cat")
+                 .set_outputs("head")
+                 .build())
+    w_before = np.asarray(trainable.params["shared"]["W"]).copy()
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)]
+    trainable.fit([x1, x2], [ys], epochs=3)
+    assert not np.allclose(np.asarray(trainable.params["shared"]["W"]),
+                           w_before)
+
+
+def test_functional_shared_embedding_siamese(rng, tmp_path):
+    """Siamese-style shared embedding over two inputs (the classic
+    weight-sharing shape)."""
+    inp1 = tf.keras.Input((6,), name="l")
+    inp2 = tf.keras.Input((6,), name="r")
+    tower = tf.keras.layers.Dense(5, activation="relu", name="tower")
+    d = tf.keras.layers.Subtract(name="diff")([tower(inp1), tower(inp2)])
+    out = tf.keras.layers.Dense(1, activation="sigmoid", name="score")(d)
+    model = tf.keras.Model([inp1, inp2], out)
+    path = str(tmp_path / "siam.h5")
+    model.save(path)
+    x1 = rng.normal(size=(3, 6)).astype(np.float32)
+    x2 = rng.normal(size=(3, 6)).astype(np.float32)
+    golden = np.asarray(model([x1, x2]))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.output(x1, x2)), golden,
+                               atol=1e-5, rtol=1e-4)
 
 
 # -- round-2 breadth builders (VERDICT r1 missing #6) ------------------------
