@@ -147,7 +147,12 @@ def _to_nhwc(m, node, x):
 def _placeholder(m, node):
     import tensorflow as tf
 
-    dt = _tf_dtype(node.attr["dtype"].type)
+    try:
+        dt = _tf_dtype(node.attr["dtype"].type)
+    except (KeyError, TypeError):
+        # variant/resource placeholders (e.g. the lowered graphs' unused
+        # control-flow inputs): register with a dummy dtype — never fed
+        dt = np.float32
     shape = None
     if "shape" in node.attr and not node.attr["shape"].shape.unknown_rank:
         shape = tuple(
@@ -286,6 +291,9 @@ def _register_reduce_rules():
             x = m.get(m.inputs(node)[0])
             axes = m.const(m.inputs(node)[1])
             axis = tuple(int(a) for a in np.atleast_1d(axes))
+            if not axis:  # reduce over no axes == identity
+                m.set(node.name, m.sd._op("identity", [x], name=node.name))
+                return
             m.set(node.name, m.sd._op(opname, [x], attrs=dict(
                 axis=axis if len(axis) > 1 else axis[0],
                 keepdims=bool(node.attr["keep_dims"].b)), name=node.name))
@@ -748,6 +756,7 @@ def _emit_frame(m, fr):
             new = body_run(list(c))
             return tuple(new) + tuple(c[n_merge:])
 
+        vs, _ = _fix_list_carries(lambda *c: body(c), vs)
         out = jax.lax.while_loop(cond, body, tuple(vs))
         return out[:n_merge] if n_merge > 1 else out[0]
 
@@ -889,6 +898,7 @@ def _while_v2(m, node):
     n = len(ops)
 
     def impl(*vs):
+        vs, _ = _fix_list_carries(body_run, vs)
         out = jax.lax.while_loop(
             lambda c: jnp.reshape(cond_run(*c)[0], ()).astype(bool),
             lambda c: tuple(body_run(*c)),
@@ -944,3 +954,82 @@ def _partitioned_call(m, node):
     _import_nodes(sub)
     for i, o in enumerate(fdef.signature.output_arg):
         m.set(node.name, sub.get(nested_to_flat[fdef.ret[o.name]]), slot=i)
+
+
+# -- TensorList ops (TF2 loop-carried accumulators; Keras RNN exports) -------
+
+
+@rule("TensorListReserve", "EmptyTensorList")
+def _tensorlist_reserve(m, node):
+    import tensorflow as tf
+
+    num = int(np.asarray(m.const(m.inputs(node)[1])))
+    dt = _tf_dtype(node.attr["element_dtype"].type)
+    m.set(node.name, m.sd._op(
+        "tensorlist_reserve", [],
+        attrs=dict(num_elements=num, dtype=np.dtype(dt).name),
+        name=node.name))
+
+
+@rule("TensorListFromTensor")
+def _tensorlist_from_tensor(m, node):
+    m.set(node.name, m.sd._op("tensorlist_from_tensor",
+                              [m.get(m.inputs(node)[0])], name=node.name))
+
+
+@rule("TensorListGetItem")
+def _tensorlist_get_item(m, node):
+    ins = m.inputs(node)
+    m.set(node.name, m.sd._op("tensorlist_get_item",
+                              [m.get(ins[0]), m.get(ins[1])], name=node.name))
+
+
+@rule("TensorListSetItem")
+def _tensorlist_set_item(m, node):
+    ins = m.inputs(node)
+    m.set(node.name, m.sd._op(
+        "tensorlist_set_item",
+        [m.get(ins[0]), m.get(ins[1]), m.get(ins[2])], name=node.name))
+
+
+@rule("TensorListStack")
+def _tensorlist_stack(m, node):
+    m.set(node.name, m.sd._op("tensorlist_stack", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("TensorListLength")
+def _tensorlist_length(m, node):
+    m.set(node.name, m.sd._op("tensorlist_length", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+def _fix_list_carries(body, init):
+    """Freshly reserved TensorLists enter the loop as (N, 0) placeholders;
+    the body's first set_item materializes the real element shape at trace
+    time. lax.while_loop needs shape-invariant carries, so re-seed any such
+    init with zeros of the body's OUTPUT shape (one abstract evaluation)."""
+    out_shapes = jax.eval_shape(lambda *a: tuple(body(*a)), *init)
+    fixed = []
+    changed = False
+    for v, s in zip(init, out_shapes):
+        if tuple(v.shape) != tuple(s.shape) and 0 in v.shape:
+            fixed.append(jnp.zeros(s.shape, s.dtype))
+            changed = True
+        else:
+            fixed.append(v)
+    return tuple(fixed), changed
+
+
+@rule("Range")
+def _range(m, node):
+    ins = m.inputs(node)
+    try:  # static limits → constant (shape math stays static)
+        start, limit, delta = (int(np.asarray(m.const(i))) for i in ins)
+        arr = np.arange(start, limit, delta,
+                        dtype=_tf_dtype(node.attr["Tidx"].type))
+        m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+    except UnsupportedOpError:
+        raise UnsupportedOpError(
+            f"Range {node.name!r} with non-constant bounds (dynamic shapes "
+            "are not XLA-traceable)")

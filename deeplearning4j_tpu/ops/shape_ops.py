@@ -324,3 +324,46 @@ def confusion_matrix(labels, predictions, num_classes, weights=None):
          else jnp.asarray(weights).reshape(-1))
     flat = jnp.zeros((num_classes * num_classes,), w.dtype)
     return flat.at[li * num_classes + pi].add(w).reshape(num_classes, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# TensorList ops (TF2 loop state: Keras RNN exports carry their outputs in
+# TensorLists). A list IS a stacked array (N, *element). Reference: the
+# samediff TF import maps TensorArray*/TensorList* onto its list ops
+# (path-cite, mount empty). A freshly reserved list materializes as
+# (N, 0) until the first set_item reveals the element shape AT TRACE TIME —
+# the while-loop importer then fixes the carry via eval_shape.
+# ---------------------------------------------------------------------------
+
+
+@op("tensorlist_reserve", "tensorlist")
+def tensorlist_reserve(num_elements, dtype="float32"):
+    return jnp.zeros((int(num_elements), 0), jnp.dtype(dtype))
+
+
+@op("tensorlist_from_tensor", "tensorlist")
+def tensorlist_from_tensor(tensor):
+    return tensor
+
+
+@op("tensorlist_get_item", "tensorlist")
+def tensorlist_get_item(lst, index):
+    return lax.dynamic_index_in_dim(lst, index, axis=0, keepdims=False)
+
+
+@op("tensorlist_set_item", "tensorlist")
+def tensorlist_set_item(lst, index, item):
+    if tuple(lst.shape[1:]) != tuple(item.shape):  # trace-time materialization
+        lst = jnp.zeros((lst.shape[0],) + tuple(item.shape), item.dtype)
+    return lax.dynamic_update_index_in_dim(
+        lst, item.astype(lst.dtype), index, axis=0)
+
+
+@op("tensorlist_stack", "tensorlist")
+def tensorlist_stack(lst):
+    return lst
+
+
+@op("tensorlist_length", "tensorlist")
+def tensorlist_length(lst):
+    return jnp.asarray(lst.shape[0], jnp.int32)

@@ -273,6 +273,30 @@ class TestTFControlFlow:
         else:  # TF already inlined it; still a valid golden check
             _golden_match(gd, golden, in_names, out_names, [x])
 
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["v1-frames", "v2-functional"])
+    def test_real_keras_lstm_graph(self, rng, lower):
+        """A REAL tf.keras LSTM frozen graph (TensorList accumulators inside
+        the while loop — the exact shape TF-import previously rejected)."""
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((7, 5)),
+            tf.keras.layers.LSTM(6, return_sequences=True),
+        ])
+        conc = tf.function(lambda x: m(x)).get_concrete_function(
+            tf.TensorSpec((3, 7, 5), tf.float32))
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        frozen = convert_variables_to_constants_v2(
+            conc, lower_control_flow=lower)
+        gd = frozen.graph.as_graph_def()
+        x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+        golden = [np.asarray(t) for t in frozen(tf.constant(x))]
+        in_names = [i.name.split(":")[0] for i in frozen.inputs]
+        out_names = [o.name for o in frozen.outputs]
+        _golden_match(gd, golden, in_names, out_names, [x])
+
     def test_nested_while_rejected(self, rng):
         from deeplearning4j_tpu.imports.tf_import import UnsupportedOpError
 

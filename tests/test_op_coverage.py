@@ -214,6 +214,13 @@ OVERRIDES = {
     "bincount": lambda f: f(jnp.asarray([0, 1, 1, 2]), minlength=3),
     "percentile": lambda f: f(XN, 50.0),
     "quantile": lambda f: f(XN, 0.5),
+    # tensorlist (TF2 loop accumulators)
+    "tensorlist_reserve": lambda f: f(4),
+    "tensorlist_from_tensor": lambda f: f(XN),
+    "tensorlist_get_item": lambda f: f(XN, 1),
+    "tensorlist_set_item": lambda f: f(jnp.zeros((4, 0)), 1, XN[0]),
+    "tensorlist_stack": lambda f: f(XN),
+    "tensorlist_length": lambda f: f(XN),
     # special functions
     "igamma": lambda f: f(X + 0.5, X + 0.5),
     "igammac": lambda f: f(X + 0.5, X + 0.5),
