@@ -22,9 +22,13 @@ from deeplearning4j_tpu.datavec.records import (  # noqa: F401
     RegexLineRecordReader,
     SVMLightRecordReader,
     TransformProcessRecordReader,
+    WavFileRecordReader,
+    ArrowRecordReader,
+    write_arrow,
 )
 from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
     ColumnType,
+    Join,
     Schema,
     TransformProcess,
 )

@@ -242,3 +242,60 @@ class TransformProcessRecordReader(RecordReader):
             out = self.tp.execute_record(rec)
             if out is not None:  # filtered rows are dropped
                 yield out
+
+
+class WavFileRecordReader(RecordReader):
+    """Audio reader (datavec-data-audio WavFileRecordReader.java parity):
+    one record per file = [waveform (n_frames, channels) float32 in [-1,1],
+    sample_rate]. Pure-stdlib WAV parse (the reference wraps FFmpeg via
+    JavaCPP; WAV covers the tested surface offline)."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = [os.fspath(p) for p in paths]
+
+    def _gen(self):
+        import wave
+
+        for path in self.paths:
+            with wave.open(path, "rb") as w:
+                n = w.getnframes()
+                raw = w.readframes(n)
+                width = w.getsampwidth()
+                ch = w.getnchannels()
+                if width == 2:
+                    arr = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+                elif width == 1:
+                    arr = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+                elif width == 4:
+                    arr = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+                else:
+                    raise ValueError(f"unsupported WAV sample width {width}")
+                yield [arr.reshape(-1, ch), w.getframerate()]
+
+
+class ArrowRecordReader(RecordReader):
+    """Arrow IPC/Feather serde (datavec-arrow ArrowRecordReader.java parity
+    via pyarrow): one record per row, columns in schema order."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+
+    def _gen(self):
+        import pyarrow.feather as feather
+
+        table = feather.read_table(self.path)
+        cols = [c.to_pylist() for c in table.columns]
+        for row in zip(*cols):
+            yield list(row)
+
+
+def write_arrow(path: str, records: Sequence[Sequence], column_names: Sequence[str]):
+    """Write records (rows) to an Arrow/Feather file (ArrowRecordWriter
+    parity)."""
+    import pyarrow as pa
+    import pyarrow.feather as feather
+
+    cols = list(zip(*records)) if records else [[] for _ in column_names]
+    table = pa.table({n: list(c) for n, c in zip(column_names, cols)})
+    feather.write_feather(table, os.fspath(path))
+    return path

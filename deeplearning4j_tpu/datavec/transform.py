@@ -368,3 +368,87 @@ class TransformProcess:
     @staticmethod
     def builder(schema: Schema) -> "TransformProcess.Builder":
         return TransformProcess.Builder(schema)
+
+
+class Join:
+    """Record-collection join (org/datavec/api/transform/join/Join.java
+    parity: Inner / LeftOuter / RightOuter / FullOuter on key columns; the
+    reference executes these on Spark — here locally over record lists).
+
+        join = (Join.Builder("inner")
+                .set_join_columns("id")
+                .set_schemas(left_schema, right_schema).build())
+        rows = join.execute(left_records, right_records)
+    """
+
+    TYPES = ("inner", "leftouter", "rightouter", "fullouter")
+
+    def __init__(self, join_type: str, keys: List[str],
+                 left_schema: Schema, right_schema: Schema):
+        jt = join_type.lower().replace("_", "")
+        if jt not in self.TYPES:
+            raise ValueError(f"join_type must be one of {self.TYPES}")
+        self.join_type = jt
+        self.keys = list(keys)
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self._l_idx = [left_schema.column_names().index(k) for k in self.keys]
+        self._r_idx = [right_schema.column_names().index(k) for k in self.keys]
+        # output: all left columns + right columns minus the keys
+        self._r_keep = [i for i, n in enumerate(right_schema.column_names())
+                        if n not in self.keys]
+
+    class Builder:
+        def __init__(self, join_type: str = "inner"):
+            self._type = join_type
+            self._keys: List[str] = []
+            self._left = self._right = None
+
+        def set_join_columns(self, *names: str):
+            self._keys = list(names)
+            return self
+
+        def set_schemas(self, left: Schema, right: Schema):
+            self._left, self._right = left, right
+            return self
+
+        def build(self) -> "Join":
+            return Join(self._type, self._keys, self._left, self._right)
+
+    def output_schema(self) -> Schema:
+        cols = list(self.left_schema.columns)
+        cols += [self.right_schema.columns[i] for i in self._r_keep]
+        return Schema(cols)
+
+    def _null_row(self, schema, keep=None):
+        n = len(schema.columns) if keep is None else len(keep)
+        return [None] * n
+
+    def execute(self, left_records, right_records) -> List[list]:
+        right_by_key: dict = {}
+        for r in right_records:
+            right_by_key.setdefault(
+                tuple(r[i] for i in self._r_idx), []).append(r)
+        out = []
+        matched_right = set()
+        for l in left_records:
+            k = tuple(l[i] for i in self._l_idx)
+            matches = right_by_key.get(k, [])
+            if matches:
+                matched_right.add(k)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in self._r_keep])
+            elif self.join_type in ("leftouter", "fullouter"):
+                out.append(list(l) + self._null_row(self.right_schema,
+                                                    self._r_keep))
+        if self.join_type in ("rightouter", "fullouter"):
+            ln = len(self.left_schema.columns)
+            for k, rs in right_by_key.items():
+                if k in matched_right:
+                    continue
+                for r in rs:
+                    row = [None] * ln
+                    for ki, li in zip(k, self._l_idx):
+                        row[li] = ki  # key values survive on the left side
+                    out.append(row + [r[i] for i in self._r_keep])
+        return out

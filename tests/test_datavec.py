@@ -201,3 +201,62 @@ def test_sequence_iterator_align_end(tmp_path):
     np.testing.assert_array_equal(ds.features_mask[1], [1, 1, 1, 1, 1])
     np.testing.assert_array_equal(ds.features[0, :2, 0], [0.0, 0.0])
     np.testing.assert_array_equal(ds.features[0, 2:, 0], [0.0, 1.0, 2.0])
+
+
+class TestRound2DataVec:
+    """Audio reader, Arrow serde, joins (J12 gaps from VERDICT r1)."""
+
+    def test_wav_record_reader(self, tmp_path):
+        import wave
+
+        from deeplearning4j_tpu.datavec.records import WavFileRecordReader
+
+        path = str(tmp_path / "tone.wav")
+        sr = 8000
+        t = np.arange(sr // 4) / sr
+        samples = (np.sin(2 * np.pi * 440 * t) * 32000).astype(np.int16)
+        with wave.open(path, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(sr)
+            w.writeframes(samples.tobytes())
+        rec = next(iter(WavFileRecordReader([path])))
+        wavef, rate = rec
+        assert rate == sr
+        assert wavef.shape == (len(samples), 1)
+        np.testing.assert_allclose(
+            wavef[:, 0], samples.astype(np.float32) / 32768.0, atol=1e-6)
+
+    def test_arrow_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.datavec.records import (
+            ArrowRecordReader,
+            write_arrow,
+        )
+
+        path = str(tmp_path / "t.feather")
+        records = [[1, "a", 0.5], [2, "b", 1.5], [3, "c", 2.5]]
+        write_arrow(path, records, ["id", "name", "x"])
+        back = list(ArrowRecordReader(path))
+        assert back == records
+
+    def test_join_inner_and_outer(self):
+        from deeplearning4j_tpu.datavec.transform import Join, Schema
+
+        left = (Schema.Builder().add_column_integer("id")
+                .add_column_string("name").build())
+        right = (Schema.Builder().add_column_integer("id")
+                 .add_column_string("city").build())
+        L = [[1, "ann"], [2, "bob"], [3, "cyd"]]
+        R = [[1, "oslo"], [1, "pune"], [4, "rome"]]
+        inner = (Join.Builder("inner").set_join_columns("id")
+                 .set_schemas(left, right).build())
+        rows = inner.execute(L, R)
+        assert rows == [[1, "ann", "oslo"], [1, "ann", "pune"]]
+        assert inner.output_schema().column_names() == ["id", "name", "city"]
+        louter = Join("LeftOuter", ["id"], left, right)
+        rows = louter.execute(L, R)
+        assert [1, "ann", "oslo"] in rows and [2, "bob", None] in rows
+        fouter = Join("FullOuter", ["id"], left, right)
+        rows = fouter.execute(L, R)
+        assert [4, None, "rome"] in rows
+        assert len(rows) == 5
