@@ -99,9 +99,46 @@ class UIServer:
 
     # ------------------------------------------------------------- rendering
     def _render(self) -> str:
+        """DL4J overview-page parity: score chart, update:param-ratio chart
+        (the reference's signature training-health plot), per-layer param
+        stddevs, iteration timing — all inline SVG, zero JS dependencies."""
+        import math
+
         recs = self._records()
         scores = [(r["iteration"], r["score"]) for r in recs if "score" in r]
-        svg = _line_chart(scores, "score")
+        charts = [_line_chart(scores, "model score vs iteration")]
+
+        # log10 mean(|update| l2 / |param| l2) — DL4J's "update:parameter
+        # ratio" chart; healthy training sits near 1e-3
+        ratios = []
+        for r in recs:
+            ps, us = r.get("params"), r.get("updates")
+            if not ps or not us:
+                continue
+            vals = [us[k]["l2"] / ps[k]["l2"]
+                    for k in us if ps.get(k, {}).get("l2", 0) > 0]
+            if vals:
+                ratios.append((r["iteration"],
+                               math.log10(sum(vals) / len(vals) + 1e-12)))
+        if ratios:
+            charts.append(_line_chart(
+                ratios, "log10 update:parameter ratio (mean over params)"))
+
+        # per-layer parameter stddev over time (multi-series)
+        series: dict = {}
+        for r in recs:
+            for k, s in (r.get("params") or {}).items():
+                if k.endswith(".W") or k.endswith(".gamma"):
+                    series.setdefault(k, []).append((r["iteration"], s["std"]))
+        if series:
+            charts.append(_multi_line_chart(series,
+                                            "parameter stddev by layer"))
+
+        times = [(r["iteration"], r["iter_ms"]) for r in recs
+                 if isinstance(r.get("iter_ms"), (int, float))]
+        if times:
+            charts.append(_line_chart(times, "iteration time (ms)"))
+
         def ms(r):
             v = r.get("iter_ms")
             return f"{v:.1f}" if isinstance(v, (int, float)) else ""
@@ -111,14 +148,49 @@ class UIServer:
             f"<td>{r['score']:.6f}</td><td>{ms(r)}</td></tr>"
             for r in recs[-25:] if isinstance(r.get("score"), (int, float))
         )
-        return f"""<!doctype html><html><head><title>Training UI</title></head>
+        charts_html = "".join(f"<div>{c}</div>" for c in charts)
+        return f"""<!doctype html><html><head><title>Training UI</title>
+<meta http-equiv="refresh" content="5"></head>
 <body style="font-family:sans-serif">
-<h2>Model score vs iteration</h2>{svg}
+<h2>Training overview</h2>{charts_html}
 <h3>Recent iterations</h3>
 <table border=1 cellpadding=4>
 <tr><th>iter</th><th>epoch</th><th>score</th><th>ms</th></tr>{rows}</table>
 <p>{len(recs)} records; raw data at <a href="/train/data">/train/data</a></p>
 </body></html>"""
+
+
+_PALETTE = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+            "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf"]
+
+
+def _multi_line_chart(series, label, w=640, h=240, pad=40) -> str:
+    """Named series → one SVG with a legend (DL4J per-layer charts)."""
+    allpts = [p for pts in series.values() for p in pts]
+    if not allpts:
+        return "<p>(no data yet)</p>"
+    x0, x1 = min(p[0] for p in allpts), max(p[0] for p in allpts)
+    y0, y1 = min(p[1] for p in allpts), max(p[1] for p in allpts)
+    if y1 == y0:
+        y1 = y0 + 1.0
+    sx = lambda x: pad + (x - x0) / max(x1 - x0, 1) * (w - 2 * pad)
+    sy = lambda y: h - pad - (y - y0) / (y1 - y0) * (h - 2 * pad)
+    lines, legend = [], []
+    for i, (name, pts) in enumerate(sorted(series.items())):
+        color = _PALETTE[i % len(_PALETTE)]
+        coords = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts)
+        lines.append(f'<polyline fill="none" stroke="{color}" '
+                     f'stroke-width="1.2" points="{coords}"/>')
+        legend.append(f'<tspan x="{w - pad + 4}" dy="14" '
+                      f'fill="{color}">{name}</tspan>')
+    return (f'<svg width="{w + 160}" height="{h}">'
+            f'<rect width="{w}" height="{h}" fill="#fafafa" stroke="#ccc"/>'
+            + "".join(lines)
+            + f'<text x="{w // 2}" y="16" font-size="13" '
+              f'text-anchor="middle">{label}</text>'
+            + f'<text x="{w - pad + 4}" y="24" font-size="10">{"".join(legend)}</text>'
+            + f'<text x="4" y="{pad}" font-size="11">{y1:.4g}</text>'
+            + f'<text x="4" y="{h - pad}" font-size="11">{y0:.4g}</text></svg>')
 
 
 def _line_chart(points, label, w=640, h=240, pad=40) -> str:
