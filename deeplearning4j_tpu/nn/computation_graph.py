@@ -553,6 +553,72 @@ class ComputationGraph:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1):
+        """ComputationGraph.pretrain(DataSetIterator) parity: layerwise
+        unsupervised training of every pretrain-capable layer node, in
+        topological order."""
+        for n in self.topo:
+            if n.is_layer and getattr(n.node, "is_pretrain_layer",
+                                      lambda: False)():
+                self.pretrain_layer(n.name, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, name: str, data, epochs: int = 1):
+        """pretrainLayer(String, DataSetIterator) parity: one node trained on
+        its unsupervised objective; its input comes from an inference-mode
+        forward pass (XLA dead-code-eliminates the rest of the graph)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        node = next(n for n in self.topo if n.name == name)
+        if not getattr(node.node, "is_pretrain_layer", lambda: False)():
+            raise ValueError(
+                f"node {name!r} ({type(node.node).__name__}) is not a "
+                "pretrain layer")
+        updater = self._updaters[name]
+        opt = updater.init_state(self.params[name])
+        base_params = dict(self.params)
+        states = self.states
+
+        @jax.jit
+        def step(p, opt_state, iteration, inputs, key):
+            params = dict(base_params)
+            params[name] = p
+
+            def loss_fn(p_):
+                params[name] = p_
+                acts, _ = self._forward(params, states, inputs,
+                                        training=False)
+                x = self._gather_input(acts, node)
+                return node.node.pretrain_loss(p_, x, key)
+
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            new_p, new_opt = upd.apply_updater(updater, p, g, opt_state,
+                                               iteration)
+            return new_p, new_opt, loss
+
+        if isinstance(data, (np.ndarray, jnp.ndarray)):
+            data = [DataSet(np.asarray(data), None)]
+        elif isinstance(data, (DataSet,)):
+            data = [data]
+        loss = None
+        it_count = 0
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                feats = ds.features if hasattr(ds, "features") else ds
+                feats = feats if isinstance(feats, (list, tuple)) else [feats]
+                inputs = dict(zip(self.conf.inputs,
+                                  [jnp.asarray(f) for f in feats]))
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                self.params[name], opt, loss = step(
+                    self.params[name], opt, jnp.asarray(it_count), inputs, sub)
+                it_count += 1
+        if loss is not None:
+            self.score_value = loss
+        return self
+
     # ------------------------------------------------ stateful rnn inference
     def rnn_time_step(self, *inputs):
         """Stateful step-by-step inference over the DAG (ComputationGraph.

@@ -153,3 +153,39 @@ class TestVAE:
         assert not np.allclose(np.asarray(net.params[0]["W"]), ae_before)
         np.testing.assert_array_equal(np.asarray(net.params[1]["W"]),
                                       dense_before)
+
+
+class TestGraphPretrain:
+    """ComputationGraph.pretrain parity (the reference pretrains CG layer
+    vertices too)."""
+
+    def test_cg_vae_pretrain_then_fit(self, rng):
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        centers = rng.standard_normal((3, 8)) * 2.5
+        ys = rng.integers(0, 3, 192)
+        xs = (centers[ys] + rng.standard_normal((192, 8))).astype(np.float32)
+        yoh = np.eye(3, dtype=np.float32)[ys]
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("vae", VariationalAutoencoder(
+                    n_in=8, n_out=4, encoder_layer_sizes=(16,),
+                    decoder_layer_sizes=(16,), activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=3, loss="mcxent",
+                                              activation="softmax"), "vae")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        net = ComputationGraph(conf).init()
+        vae = next(n.node for n in net.topo if n.name == "vae")
+        e0 = float(vae.pretrain_loss(net.params["vae"], jnp.asarray(xs),
+                                     jax.random.PRNGKey(0)))
+        it = ArrayDataSetIterator(xs, yoh, batch=64)
+        net.pretrain(it, epochs=10)
+        e1 = float(vae.pretrain_loss(net.params["vae"], jnp.asarray(xs),
+                                     jax.random.PRNGKey(0)))
+        assert e1 < e0, (e0, e1)
+        net.fit(xs, yoh, epochs=30)
+        acc = (np.argmax(np.asarray(net.output(xs)), 1) == ys).mean()
+        assert acc > 0.8, acc
