@@ -14,6 +14,7 @@ gather/scatter HLOs which TPU executes natively. All shapes here are static
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -367,3 +368,35 @@ def tensorlist_stack(lst):
 @op("tensorlist_length", "tensorlist")
 def tensorlist_length(lst):
     return jnp.asarray(lst.shape[0], jnp.int32)
+
+
+@op("reverse_sequence", "shape")
+def reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    """Per-example sequence reversal up to seq_lengths (reference:
+    generic/transforms/reverse_sequence.cpp; TF ReverseSequence)."""
+    T = x.shape[seq_axis]
+    idx = jnp.arange(T)
+    lens = jnp.asarray(seq_lengths)
+
+    def one(row, n):
+        rev = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(row, rev, axis=seq_axis - 1 if seq_axis > batch_axis
+                        else seq_axis)
+
+    xb = jnp.moveaxis(x, batch_axis, 0)
+    out = jax.vmap(one)(xb, lens)
+    return jnp.moveaxis(out, 0, batch_axis)
+
+
+@op("matrix_band_part", "shape")
+def matrix_band_part(x, num_lower, num_upper):
+    """Keep the band (reference: parity_ops/matrix_band_part.cpp)."""
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep = keep & (i - j <= num_lower)
+    if num_upper >= 0:
+        keep = keep & (j - i <= num_upper)
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))

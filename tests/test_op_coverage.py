@@ -221,6 +221,8 @@ OVERRIDES = {
     "tensorlist_set_item": lambda f: f(jnp.zeros((4, 0)), 1, XN[0]),
     "tensorlist_stack": lambda f: f(XN),
     "tensorlist_length": lambda f: f(XN),
+    "reverse_sequence": lambda f: f(XN, jnp.asarray([2, 4, 6, 1])),
+    "matrix_band_part": lambda f: f(SQ, 0, 0),
     # special functions
     "igamma": lambda f: f(X + 0.5, X + 0.5),
     "igammac": lambda f: f(X + 0.5, X + 0.5),
