@@ -433,21 +433,30 @@ def _strided_slice(m, node):
     masks = {k: int(node.attr[k].i) for k in
              ("begin_mask", "end_mask", "ellipsis_mask", "new_axis_mask",
               "shrink_axis_mask")}
-    if masks["ellipsis_mask"] or masks["new_axis_mask"]:
-        raise UnsupportedOpError("StridedSlice ellipsis/new_axis masks")
+    # One spec entry per position of the begin/end/strides vectors; ellipsis
+    # and new_axis positions consume a vector slot but no input axis (TF
+    # guarantees at most one ellipsis). Maps 1:1 onto getitem's ("e",)/("n",)
+    # spec entries — pure index arithmetic, no dynamic shapes.
     spec = []
     for d in range(len(begin)):
-        b = None if masks["begin_mask"] & (1 << d) else begin[d]
-        e = None if masks["end_mask"] & (1 << d) else end[d]
-        if masks["shrink_axis_mask"] & (1 << d):
+        if masks["ellipsis_mask"] & (1 << d):
+            spec.append(("e",))
+        elif masks["new_axis_mask"] & (1 << d):
+            spec.append(("n",))
+        elif masks["shrink_axis_mask"] & (1 << d):
             spec.append(("i", begin[d]))
         else:
+            b = None if masks["begin_mask"] & (1 << d) else begin[d]
+            e = None if masks["end_mask"] & (1 << d) else end[d]
             spec.append(("s", b, e, strides[d]))
     m.set(node.name, m.sd._op("getitem", [x], attrs=dict(spec=tuple(spec)),
                               name=node.name))
     src = m._canon(ins[0])
     if src in m.const_vals:  # slices of static shapes stay static
-        idx = tuple(s[1] if s[0] == "i" else slice(s[1], s[2], s[3])
+        idx = tuple(s[1] if s[0] == "i"
+                    else None if s[0] == "n"
+                    else Ellipsis if s[0] == "e"
+                    else slice(s[1], s[2], s[3])
                     for s in spec)
         m.const_vals[node.name + ":0"] = np.asarray(m.const_vals[src])[idx]
 
@@ -540,13 +549,36 @@ def _pool(m, node):
 
 @rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
 def _fused_bn(m, node):
-    if node.attr["is_training"].b:
-        raise UnsupportedOpError("FusedBatchNorm training mode (import frozen "
-                                 "inference graphs)")
     ins = m.inputs(node)
     x, gamma, beta, mean, var = (m.get(i) for i in ins[:5])
     x, back = _to_nhwc(m, node, x)
     eps = float(node.attr["epsilon"].f)
+    if node.attr["is_training"].b:
+        # Training mode (samediff-import FusedBatchNormV3 rule parity,
+        # path-cite — mount empty): normalize with biased batch variance,
+        # output batch_mean + UNBIASED batch variance (verified vs installed
+        # TF), optionally blended with the incoming running stats by
+        # exponential_avg_factor f: out_stat = (1-f)*old + f*batch. That is
+        # exactly the registry's fused-VJP batchnorm_train with momentum=1-f,
+        # so imported conv nets fine-tune through BN with the same single-pass
+        # fwd/bwd kernel the native layers use.
+        # attr absent (V1/V2 nodes) means 1.0; an explicit 0.0 is meaningful
+        # (TF returns the incoming running stats unchanged)
+        f = (float(node.attr["exponential_avg_factor"].f)
+             if "exponential_avg_factor" in node.attr else 1.0)
+        y, new_mean, new_var = m.sd._op(
+            "batchnorm_train", [x, gamma, beta, mean, var],
+            attrs=dict(momentum=1.0 - f, eps=eps), n_out=3, name=node.name)
+        m.set(node.name, back(y), slot=0)
+        m.set(node.name, new_mean, slot=1)
+        m.set(node.name, new_var, slot=2)
+        # reserve_space_{1,2,3} feed only FusedBatchNormGrad, which a
+        # forward training graph re-differentiated here never contains;
+        # alias them to the stats so consumers resolve.
+        m.set(node.name, new_mean, slot=3)
+        m.set(node.name, new_var, slot=4)
+        m.set(node.name, new_var, slot=5)
+        return
     y = m.sd._op("batchnorm", [x, mean, var, gamma, beta],
                  attrs=dict(eps=eps), name=node.name)
     m.set(node.name, back(y))
@@ -595,11 +627,16 @@ class _Frame:
         self.switch_of: Dict[str, object] = {}     # merge name -> Switch node
         self.exits_of: Dict[str, list] = {}        # merge name -> [Exit nodes]
         self.loopcond = None
+        self.parent: Optional[str] = None          # enclosing frame name
         self.emitted = False
 
 
 def _detect_frames(m):
-    """Group TF1 while-loop dataflow nodes into frames (single level)."""
+    """Group TF1 while-loop dataflow nodes into frames (arbitrarily nested).
+
+    Each node lands in its innermost frame (cross-frame data edges always
+    pass an Enter on the way in and an Exit on the way out — the TF1 frame
+    invariant); ``parent`` links record nesting so emission can recurse."""
     frames: Dict[str, _Frame] = {}
     owner: Dict[str, str] = {}
     for n in m.gd.node:
@@ -611,9 +648,35 @@ def _detect_frames(m):
             fr.members.add(n.name)
     if not frames:
         return frames, owner
+    # Nesting: an Enter input produced inside frame P means this frame is
+    # nested in P. A producer that is itself an Exit of frame G lives in G's
+    # PARENT context (the value has left G) — resolved recursively so
+    # sequential sibling loops are not mistaken for nesting.
+    def _context_of(p, _seen=frozenset()):
+        if p not in owner:
+            return None
+        f = owner[p]
+        if m.nodes[p].op == "Exit" and f not in _seen:
+            return _parent_of(f, _seen | {f})
+        return f
+
+    def _parent_of(fname, _seen=frozenset(), strict=False):
+        fr = frames[fname]
+        parents = {_context_of(_prod(e.input[0]), _seen) for e in fr.enters}
+        parents.discard(None)
+        if len(parents) > 1:
+            if strict:
+                raise UnsupportedOpError(
+                    f"while frame {fname!r} enters from two different frames "
+                    f"{sorted(parents)} (unstructured nesting)")
+            return None
+        return parents.pop() if parents else None
+
     changed = True
-    while changed:  # propagate membership along data edges (stop at Exit)
+    while changed:  # fixpoint over membership + nesting
         changed = False
+        # (a) propagate along ordinary data/control edges (stop at Exit:
+        # an Exit output lives OUTSIDE the frame that produced it)
         for n in m.gd.node:
             if n.name in owner or n.op == "Enter":
                 continue
@@ -624,11 +687,26 @@ def _detect_frames(m):
                     frames[owner[p]].members.add(n.name)
                     changed = True
                     break
+        for fname, fr in frames.items():
+            fr.parent = _parent_of(fname)
+        # (b) a node reading frame G's Exit belongs to G's parent frame
+        # (for a top-level G the consumer is frameless, which (a) encodes
+        # by never crossing the Exit)
+        for n in m.gd.node:
+            if n.name in owner or n.op == "Enter":
+                continue
+            for i in n.input:
+                p = _prod(i)
+                if p in owner and m.nodes[p].op == "Exit":
+                    parent = frames[owner[p]].parent
+                    if parent is not None:
+                        owner[n.name] = parent
+                        frames[parent].members.add(n.name)
+                        changed = True
+                        break
+    for fname, fr in frames.items():
+        fr.parent = _parent_of(fname, strict=True)
     for fr in frames.values():
-        for e in fr.enters:
-            if _prod(e.input[0]) in owner:
-                raise UnsupportedOpError(
-                    "nested tf.while_loop frames are not supported")
         enter_names = {e.name for e in fr.enters}
         for n in m.gd.node:
             if n.name not in fr.members:
@@ -659,13 +737,16 @@ def _detect_frames(m):
     return frames, owner
 
 
-def _subgraph_callable(m, member_names, seeds, targets):
+def _subgraph_callable(m, member_names, seeds, targets, frame_name=None):
     """Compile frame member nodes into fn(list-of-arrays)->list-of-arrays.
 
     ``seeds``: tensor keys pre-bound to the function's array arguments;
     ``targets``: tensor keys to return. Member nodes are re-imported into a
     scratch SameDiff via the ordinary rules, then traced array-level (the
-    closure is jax-traceable, so it works inside lax.while_loop/cond)."""
+    closure is jax-traceable, so it works inside lax.while_loop/cond).
+    ``frame_name``: the frame whose body/cond this is — frames nested
+    directly inside it are recursively emitted as lax.while_loop nodes of
+    the scratch graph when a member reads one of their Exit tensors."""
     sub = TFGraphMapper(type(m.gd)())
     sub.functions = m.functions
     ph_names = []
@@ -674,7 +755,40 @@ def _subgraph_callable(m, member_names, seeds, targets):
         sub.vars[m._canon(key)] = ph
         ph_names.append(ph.name)
 
-    needed, seen = [], set()
+    frames = getattr(m, "frames", {})
+    owner = getattr(m, "owner", {})
+    needed, seen, scheduled_frames = [], set(), set()
+
+    def visit_tensor(i, consumer):
+        if m._canon(i) in sub.vars:
+            return
+        p = _prod(i)
+        pnode = m.nodes.get(p)
+        if pnode is None:
+            raise UnsupportedOpError(f"unknown input {i!r} in while frame")
+        if pnode.op == "Exit" and owner.get(p) is not None \
+                and frames[owner[p]].parent == frame_name:
+            schedule_frame(frames[owner[p]])
+            return
+        if pnode.op in _FRAME_CONTROL:
+            raise UnsupportedOpError(
+                f"frame node {consumer!r} reads unsupported control tensor "
+                f"{i!r} (only loop vars and invariants are seeded)")
+        if p in member_names or pnode.op == "Const":
+            visit(p)  # outer Consts are pulled into the subgraph
+        else:
+            raise UnsupportedOpError(
+                f"while-frame node {consumer!r} captures non-constant outer "
+                f"tensor {i!r}; only constants and Enter-ed values can "
+                "cross the frame boundary")
+
+    def schedule_frame(g):
+        if g.name in scheduled_frames:
+            return
+        scheduled_frames.add(g.name)
+        for e in g.enters:  # init values live in THIS subgraph's context
+            visit_tensor(e.input[0], g.name)
+        needed.append(("__frame__", g.name))
 
     def visit(name):
         if name in seen:
@@ -684,30 +798,16 @@ def _subgraph_callable(m, member_names, seeds, targets):
         for i in node.input:
             if i.startswith("^"):
                 continue
-            if m._canon(i) in sub.vars:
-                continue
-            p = _prod(i)
-            pnode = m.nodes.get(p)
-            if pnode is None:
-                raise UnsupportedOpError(f"unknown input {i!r} in while frame")
-            if pnode.op in _FRAME_CONTROL:
-                raise UnsupportedOpError(
-                    f"frame node {name!r} reads unsupported control tensor "
-                    f"{i!r} (only loop vars and invariants are seeded)")
-            if p in member_names or pnode.op == "Const":
-                visit(p)  # outer Consts are pulled into the subgraph
-            else:
-                raise UnsupportedOpError(
-                    f"while-frame node {name!r} captures non-constant outer "
-                    f"tensor {i!r}; only constants and Enter-ed values can "
-                    "cross the frame boundary")
+            visit_tensor(i, name)
         needed.append(name)
 
     for t in targets:
-        if m._canon(t) not in sub.vars:
-            visit(_prod(t))
-    for name in needed:  # post-order append == topological order
-        node = m.nodes[name]
+        visit_tensor(t, "<target>")
+    for item in needed:  # post-order append == topological order
+        if isinstance(item, tuple):
+            _emit_frame(m, sub, frames[item[1]])
+            continue
+        node = m.nodes[item]
         fn = _RULES.get(node.op)
         if fn is None:
             raise UnsupportedOpError(
@@ -724,29 +824,34 @@ def _subgraph_callable(m, member_names, seeds, targets):
     return run
 
 
-def _emit_frame(m, fr):
-    """Lower one TF1 while frame to a lax.while_loop custom node."""
+def _emit_frame(defs, ctx, fr):
+    """Lower one TF1 while frame to a lax.while_loop custom node.
+
+    ``defs`` is the original graph mapper (node definitions, frame table);
+    ``ctx`` is where values are read and the loop node is emitted — the
+    top-level mapper, or the parent frame's scratch mapper when nested."""
     init_vars, seeds_cond, seeds_body = [], [], []
     for mg in fr.merges:
         sw = fr.switch_of.get(mg.name)
         if sw is None:
             raise UnsupportedOpError(
                 f"while frame {fr.name!r}: loop var {mg.name!r} has no Switch")
-        init_vars.append(m.get(fr.enter_of[mg.name].input[0]))
+        init_vars.append(ctx.get(fr.enter_of[mg.name].input[0]))
         seeds_cond.append(mg.name + ":0")
         seeds_body.append(sw.name + ":1")
     merge_enters = {fr.enter_of[mg.name].name for mg in fr.merges}
     for e in fr.enters:  # loop invariants: carried through unchanged
         if e.name not in merge_enters:
-            init_vars.append(m.get(e.input[0]))
+            init_vars.append(ctx.get(e.input[0]))
             seeds_cond.append(e.name + ":0")
             seeds_body.append(e.name + ":0")
     n_merge = len(fr.merges)
     n_carry = len(init_vars)
-    cond_run = _subgraph_callable(m, fr.members, seeds_cond,
-                                  [fr.loopcond.input[0]])
+    cond_run = _subgraph_callable(defs, fr.members, seeds_cond,
+                                  [fr.loopcond.input[0]], frame_name=fr.name)
     body_targets = [fr.nextiter_of[mg.name].input[0] for mg in fr.merges]
-    body_run = _subgraph_callable(m, fr.members, seeds_body, body_targets)
+    body_run = _subgraph_callable(defs, fr.members, seeds_body, body_targets,
+                                  frame_name=fr.name)
 
     def while_impl(*vs):
         def cond(c):
@@ -760,23 +865,26 @@ def _emit_frame(m, fr):
         out = jax.lax.while_loop(cond, body, tuple(vs))
         return out[:n_merge] if n_merge > 1 else out[0]
 
-    out = m.sd.custom_op(while_impl, *init_vars, n_out=n_merge,
-                         name=f"while_{fr.name.rsplit('/', 1)[-1]}")
+    out = ctx.sd.custom_op(while_impl, *init_vars, n_out=n_merge,
+                           name=f"while_{fr.name.rsplit('/', 1)[-1]}")
     outs = (out,) if n_merge == 1 else out
     for i, mg in enumerate(fr.merges):
         for ex in fr.exits_of.get(mg.name, ()):
-            m.set(ex.name, outs[i])
+            ctx.set(ex.name, outs[i])
     fr.emitted = True
 
 
 def _import_nodes(m):
     """Main import loop: frame-aware, branch-tag-propagating."""
     frames, owner = _detect_frames(m)
+    m.frames, m.owner = frames, owner
     for node in m.gd.node:
         if node.name in owner:
             fr = frames[owner[node.name]]
-            if node.op == "Exit" and not fr.emitted:
-                _emit_frame(m, fr)
+            # only top-level frames are emitted here; nested ones are emitted
+            # recursively inside their parent frame's body subgraph
+            if node.op == "Exit" and fr.parent is None and not fr.emitted:
+                _emit_frame(m, m, fr)
             continue
         fn = _RULES.get(node.op)
         if fn is None:
