@@ -220,6 +220,29 @@ def _first_mask(ds, singular: str, plural: str):
     return ms[0] if ms else None
 
 
+def _as_mask(m):
+    """Coerce a mask argument (array | dict name->array | None) to jnp."""
+    if m is None:
+        return None
+    if isinstance(m, dict):
+        return {k: (None if v is None else jnp.asarray(v))
+                for k, v in m.items()}
+    return jnp.asarray(m)
+
+
+def _mask_dict(ds, names, singular: str, plural: str):
+    """Masks for a CG batch: a DataSet's single mask stays a shared array;
+    a MultiDataSet's mask LIST becomes a dict keyed by input/output name so
+    each stream keeps its own mask (per-input TBPTT masks, VERDICT r2 #3)."""
+    m = getattr(ds, singular, None)
+    if m is not None:
+        return m
+    ms = getattr(ds, plural, None)
+    if not ms:
+        return None
+    return dict(zip(names, ms))
+
+
 class ComputationGraph:
     """DAG network runtime (ComputationGraph.java parity). The whole
     forward+backward+updater step is one jitted XLA program."""
@@ -335,6 +358,17 @@ class ComputationGraph:
             return xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=-1)
         return xs
 
+    @staticmethod
+    def _arriving_mask(produced, n, mask):
+        """Mask arriving at node ``n``: per-input dict masks propagate
+        through the DAG (feedForwardMaskArrays parity — each node inherits
+        the first non-None mask among its inputs, pass-through vertices keep
+        it); a single shared mask applies everywhere, as before."""
+        if produced is None:
+            return mask
+        return next((produced.get(i) for i in n.inputs
+                     if produced.get(i) is not None), None)
+
     def _loss_mask_kw(self, node, mask, label_mask, x):
         """compute_loss mask gate: label mask falls back to the feature mask;
         same shape/signature rule as :meth:`_mask_kw`."""
@@ -399,8 +433,12 @@ class ComputationGraph:
         cparams = self._cast_params(params)
         new_states = dict(states)
         out_names = set(self.conf.outputs)
+        produced = dict(mask) if isinstance(mask, dict) else None
         loss = 0.0  # weak-typed: stays fp64 under the gradcheck's enable_x64
         for n in self.topo:
+            mk = self._arriving_mask(produced, n, mask)
+            if produced is not None:
+                produced[n.name] = mk
             if not n.is_layer:
                 acts[n.name] = n.node.apply(*self._gather_input(acts, n))
                 continue
@@ -410,10 +448,12 @@ class ComputationGraph:
                     raise ValueError(
                         f"output {n.name!r} must be an OutputLayer/LossLayer"
                     )
+                lm = (label_mask.get(n.name)
+                      if isinstance(label_mask, dict) else label_mask)
                 out_loss = n.node.compute_loss(
                     cparams[n.name], states[n.name], x, labels[n.name],
                     training=True, key=keys[n.name], weights=weights,
-                    **self._loss_mask_kw(n.node, mask, label_mask, x),
+                    **self._loss_mask_kw(n.node, mk, lm, x),
                 )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32)
@@ -423,7 +463,7 @@ class ComputationGraph:
                 lyr, pkey = self._resolve_shared(n.node, n.name)
                 h, ns = lyr.apply(
                     cparams[pkey], states[pkey], x, training=True,
-                    key=keys[n.name], **self._mask_kw(lyr, mask, x),
+                    key=keys[n.name], **self._mask_kw(lyr, mk, x),
                 )
                 acts[n.name] = h
                 new_states[pkey] = ns
@@ -461,25 +501,31 @@ class ComputationGraph:
         new_states = dict(states)
         new_carries = dict(carries)
         out_names = set(self.conf.outputs)
+        produced = dict(mask) if isinstance(mask, dict) else None
         loss = 0.0
         for n in self.topo:
+            mk = self._arriving_mask(produced, n, mask)
+            if produced is not None:
+                produced[n.name] = mk
             if not n.is_layer:
                 acts[n.name] = n.node.apply(*self._gather_input(acts, n))
                 continue
             x = self._gather_input(acts, n)
             if n.name in out_names:
+                lm = (label_mask.get(n.name)
+                      if isinstance(label_mask, dict) else label_mask)
                 out_loss = n.node.compute_loss(
                     cparams[n.name], states[n.name], x, labels[n.name],
                     training=True, key=keys[n.name],
-                    **self._loss_mask_kw(n.node, mask, label_mask, x),
+                    **self._loss_mask_kw(n.node, mk, lm, x),
                 )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32))
                 acts[n.name] = x
             elif n.name in carries:
                 xx = n.node._maybe_dropout(x, True, keys[n.name])
-                seg_mask = (mask if (mask is not None and x.ndim == 3
-                                     and mask.shape[:2] == x.shape[:2])
+                seg_mask = (mk if (mk is not None and x.ndim == 3
+                                   and mk.shape[:2] == x.shape[:2])
                             else None)
                 h, c = n.node.apply_seq(
                     cparams[n.name], xx, carries[n.name], mask=seg_mask,
@@ -490,7 +536,7 @@ class ComputationGraph:
                 lyr, pkey = self._resolve_shared(n.node, n.name)
                 h, ns = lyr.apply(
                     cparams[pkey], states[pkey], x, training=True,
-                    key=keys[n.name], **self._mask_kw(lyr, mask, x),
+                    key=keys[n.name], **self._mask_kw(lyr, mk, x),
                 )
                 acts[n.name] = h
                 new_states[pkey] = ns
@@ -539,9 +585,17 @@ class ComputationGraph:
             return {kk: (v[:, s:s + k] if v.ndim == 3 else v)
                     for kk, v in d.items()}
 
+        def seg_mask(mm, s):
+            if mm is None:
+                return None
+            if isinstance(mm, dict):  # per-input masks sliced independently
+                return {kk: (None if v is None else v[:, s:s + k])
+                        for kk, v in mm.items()}
+            return mm[:, s:s + k]
+
         for s in range(0, T, k):
-            ms = None if mask is None else mask[:, s:s + k]
-            lms = None if label_mask is None else label_mask[:, s:s + k]
+            ms = seg_mask(mask, s)
+            lms = seg_mask(label_mask, s)
             self._rng_key, sub = jax.random.split(self._rng_key)
             (self.params, self.states, self.opt_states, carries, loss) = (
                 self._tbptt_step(self.params, self.states, self.opt_states,
@@ -692,12 +746,17 @@ class ComputationGraph:
 
         def step(params, states, opt_states, iteration, inputs, labels, key,
                  weights=None, mask=None, label_mask=None):
-            # Raw arrays (e.g. from ParallelWrapper) → dict form, for
-            # single-input/single-output graphs.
+            # Raw arrays (e.g. from ParallelWrapper) → dict form: a bare
+            # array feeds the single input; a list/tuple zips with the
+            # graph's input/output order (multi-input graphs).
             if not isinstance(inputs, dict):
-                inputs = {in_name: inputs}
+                inputs = (dict(zip(self.conf.inputs, inputs))
+                          if isinstance(inputs, (list, tuple))
+                          else {in_name: inputs})
             if not isinstance(labels, dict):
-                labels = {out_name: labels}
+                labels = (dict(zip(self.conf.outputs, labels))
+                          if isinstance(labels, (list, tuple))
+                          else {out_name: labels})
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
             (loss, new_states), grads = jax.value_and_grad(self._loss, has_aux=True)(
@@ -743,8 +802,10 @@ class ComputationGraph:
                 labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
                 self._fit_batch(
                     [jnp.asarray(f) for f in feats], [jnp.asarray(l) for l in labs],
-                    mask=_first_mask(ds, "features_mask", "features_masks"),
-                    label_mask=_first_mask(ds, "labels_mask", "labels_masks"),
+                    mask=_mask_dict(ds, self.conf.inputs,
+                                    "features_mask", "features_masks"),
+                    label_mask=_mask_dict(ds, self.conf.outputs,
+                                          "labels_mask", "labels_masks"),
                 )
             self._end_epoch()
         return self
@@ -770,10 +831,8 @@ class ComputationGraph:
             # per-sequence (2-D) labels cannot be segmented: whole-sequence
             # BPTT instead, as the reference's doTruncatedBPTT does
             return self._fit_batch_tbptt(
-                inputs, labs,
-                mask=None if mask is None else jnp.asarray(mask),
-                label_mask=None if label_mask is None
-                else jnp.asarray(label_mask))
+                inputs, labs, mask=_as_mask(mask),
+                label_mask=_as_mask(label_mask))
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._jit_train_step()
         if self._it_dev is None or self._it_sync != self.iteration:
@@ -782,8 +841,7 @@ class ComputationGraph:
          self._it_dev, self._rng_key) = self._train_step(
             self.params, self.states, self.opt_states, self._it_dev,
             self._rng_key, inputs, labs,
-            mask=None if mask is None else jnp.asarray(mask),
-            label_mask=None if label_mask is None else jnp.asarray(label_mask),
+            mask=_as_mask(mask), label_mask=_as_mask(label_mask),
         )
         self.score_value = loss
         self.iteration += 1
@@ -828,17 +886,18 @@ class ComputationGraph:
         if dataset is not None:
             x, y = dataset.features, dataset.labels
             if mask is None:
-                mask = _first_mask(dataset, "features_mask", "features_masks")
+                mask = _mask_dict(dataset, self.conf.inputs,
+                                  "features_mask", "features_masks")
             if label_mask is None:
-                label_mask = _first_mask(dataset, "labels_mask", "labels_masks")
+                label_mask = _mask_dict(dataset, self.conf.outputs,
+                                        "labels_mask", "labels_masks")
         feats = x if isinstance(x, (list, tuple)) else [x]
         labs = y if isinstance(y, (list, tuple)) else [y]
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in feats]))
         labels = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labs]))
         loss = self._loss_eval(
             self.params, self.states, inputs, labels,
-            None if mask is None else jnp.asarray(mask),
-            None if label_mask is None else jnp.asarray(label_mask))
+            _as_mask(mask), _as_mask(label_mask))
         return float(loss)
 
     @functools.cached_property
@@ -850,23 +909,29 @@ class ComputationGraph:
         def eval_loss(params, states, inputs, labels, mask, label_mask):
             acts = {k: self._cast(v) for k, v in inputs.items()}
             cparams = self._cast_params(params)
+            produced = dict(mask) if isinstance(mask, dict) else None
             loss = 0.0
             for n in self.topo:
+                mk = self._arriving_mask(produced, n, mask)
+                if produced is not None:
+                    produced[n.name] = mk
                 if not n.is_layer:
                     acts[n.name] = n.node.apply(*self._gather_input(acts, n))
                     continue
                 x = self._gather_input(acts, n)
                 if n.name in out_names:
+                    lm = (label_mask.get(n.name)
+                          if isinstance(label_mask, dict) else label_mask)
                     loss = loss + n.node.compute_loss(
                         cparams[n.name], states[n.name], x, labels[n.name],
                         training=False,
-                        **self._loss_mask_kw(n.node, mask, label_mask, x),
+                        **self._loss_mask_kw(n.node, mk, lm, x),
                     )
                     acts[n.name] = x
                 else:
                     h, _ = n.node.apply(
                         cparams[n.name], states[n.name], x, training=False,
-                        **self._mask_kw(n.node, mask, x)
+                        **self._mask_kw(n.node, mk, x)
                     )
                     acts[n.name] = h
             return loss

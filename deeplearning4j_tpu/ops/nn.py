@@ -462,8 +462,16 @@ def softmax_derivative(x, grad, axis=-1):
 
 def _weighted_mean(per_example, weights):
     if weights is not None:
-        per_example = per_example * weights
-        return jnp.sum(per_example) / jnp.maximum(jnp.sum(weights), 1e-12)
+        # weights align on LEADING axes (numpy broadcasting is trailing):
+        # per-example (B,) weights gate a (B,T) sequence loss by broadcasting
+        # over time, and the normalizer counts the broadcast weights so the
+        # result stays a true weighted mean.
+        if weights.ndim < per_example.ndim:
+            weights = weights.reshape(
+                weights.shape + (1,) * (per_example.ndim - weights.ndim))
+        wfull = jnp.broadcast_to(weights, per_example.shape)
+        return (jnp.sum(per_example * wfull)
+                / jnp.maximum(jnp.sum(wfull), 1e-12))
     return jnp.mean(per_example)
 
 

@@ -43,6 +43,22 @@ def _stack_tree(tree, n):
         lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
 
 
+def _batch_masks(ds, model):
+    """Sequence masks for one batch, in the shape the model's _loss expects:
+    dict name->mask for ComputationGraphs (per-input/per-output), a single
+    array for MultiLayerNetworks. The masters shard these alongside the
+    batch so masked training matches local fit exactly."""
+    from deeplearning4j_tpu.nn.computation_graph import _first_mask, _mask_dict
+
+    if isinstance(model._updaters, dict):  # ComputationGraph
+        return (_mask_dict(ds, model.conf.inputs,
+                           "features_mask", "features_masks"),
+                _mask_dict(ds, model.conf.outputs,
+                           "labels_mask", "labels_masks"))
+    return (_first_mask(ds, "features_mask", "features_masks"),
+            _first_mask(ds, "labels_mask", "labels_masks"))
+
+
 def _unstack_first(tree):
     return jax.tree_util.tree_map(lambda x: x[0], tree)
 
@@ -61,11 +77,11 @@ class ParameterAveragingTrainingMaster:
         mesh = self.mesh.mesh
         step_fn = model.make_step_fn(weighted=True)
 
-        def local_step(params, states, opts, iteration, x, y, keys, w):
+        def local_step(params, states, opts, iteration, x, y, keys, w, fm, lm):
             params, states, opts = map(_unstack_first, (params, states, opts))
             key = keys[0]
             new_p, new_s, new_o, loss = step_fn(
-                params, states, opts, iteration, x, y, key, w)
+                params, states, opts, iteration, x, y, key, w, fm, lm)
             one = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
             return one(new_p), one(new_s), one(new_o), loss[None]
 
@@ -79,7 +95,7 @@ class ParameterAveragingTrainingMaster:
             jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(stacked, stacked, stacked, P(), stacked, stacked,
-                          stacked, stacked),
+                          stacked, stacked, stacked, stacked),
                 out_specs=(stacked, stacked, stacked, stacked),
                 check_vma=False,
             ),
@@ -110,13 +126,14 @@ class ParameterAveragingTrainingMaster:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x, y, w = self.mesh.pad_shard_batch(ds.features, ds.labels)
+                x, y, w, (fm, lm) = self.mesh.pad_shard_batch(
+                    ds.features, ds.labels, extras=_batch_masks(ds, model))
                 model._rng_key, sub = jax.random.split(model._rng_key)
                 keys = jax.device_put(
                     jax.random.split(sub, n), shard)
                 params, states, opts, loss = self._step(
                     params, states, opts, jnp.asarray(model.iteration),
-                    x, y, keys, w)
+                    x, y, keys, w, fm, lm)
                 model.iteration += 1
                 model.score_value = float(jnp.mean(loss))
                 since_avg += 1
@@ -152,32 +169,34 @@ class SharedTrainingMaster:
         # MLN keys layers by integer index; ComputationGraph by node name.
         is_graph = isinstance(updaters, dict)
         if is_graph:
-            if len(model.conf.inputs) != 1 or len(model.conf.outputs) != 1:
-                raise ValueError(
-                    "SharedTrainingMaster supports single-input/single-output "
-                    f"ComputationGraphs only (got {len(model.conf.inputs)} "
-                    f"inputs, {len(model.conf.outputs)} outputs)")
+            # arbitrary DAGs, any number of inputs/outputs
+            # (SharedTrainingWrapper.java wraps arbitrary ComputationGraphs)
             layer_keys = [n.name for n in model.topo if n.is_layer]
-            in_name = model.conf.inputs[0]
-            out_name = model.conf.outputs[0]
+            in_names = list(model.conf.inputs)
+            out_names = list(model.conf.outputs)
         else:
             layer_keys = list(range(len(model.layers)))
 
         def local_step(params, states, opts, residual, threshold, iteration,
-                       x, y, keys, w):
+                       x, y, keys, w, fm, lm):
             residual = _unstack_first(residual)
             threshold = threshold[0]
             key = keys[0]
             subkeys = jax.random.split(key, len(layer_keys))
             if is_graph:
                 lkeys = dict(zip(layer_keys, subkeys))
+                feed = (dict(zip(in_names, x))
+                        if isinstance(x, (list, tuple)) else {in_names[0]: x})
+                labs = (dict(zip(out_names, y))
+                        if isinstance(y, (list, tuple)) else {out_names[0]: y})
                 (loss, new_states), grads = jax.value_and_grad(
                     model._loss, has_aux=True)(
-                    params, states, {in_name: x}, {out_name: y}, lkeys, w)
+                    params, states, feed, labs, lkeys, w, fm, lm)
             else:
                 lkeys = list(subkeys)
                 (loss, new_states), grads = jax.value_and_grad(
-                    model._loss, has_aux=True)(params, states, x, y, lkeys, w)
+                    model._loss, has_aux=True)(
+                    params, states, x, y, lkeys, w, fm, lm)
             quant, new_res, new_thr, _ratio = acc.encode(
                 grads, residual, threshold, iteration)
             shared = jax.tree_util.tree_map(
@@ -205,7 +224,7 @@ class SharedTrainingMaster:
             jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(rep, rep, rep, stacked, stacked, rep, stacked,
-                          stacked, stacked, stacked),
+                          stacked, stacked, stacked, stacked, stacked),
                 out_specs=(rep, rep, rep, stacked, stacked, rep),
                 check_vma=False,
             ),
@@ -230,12 +249,13 @@ class SharedTrainingMaster:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x, y, w = self.mesh.pad_shard_batch(ds.features, ds.labels)
+                x, y, w, (fm, lm) = self.mesh.pad_shard_batch(
+                    ds.features, ds.labels, extras=_batch_masks(ds, model))
                 model._rng_key, sub = jax.random.split(model._rng_key)
                 keys = jax.device_put(jax.random.split(sub, n), shard)
                 params, states, opts, residual, threshold, loss = self._step(
                     params, states, opts, residual, threshold,
-                    jnp.asarray(model.iteration), x, y, keys, w)
+                    jnp.asarray(model.iteration), x, y, keys, w, fm, lm)
                 model.iteration += 1
                 model.score_value = float(loss)
                 for lst in model.listeners:

@@ -58,20 +58,37 @@ class TrainingMesh:
         )
         return out if len(out) > 1 else out[0]
 
-    def pad_shard_batch(self, x, y):
+    def pad_shard_batch(self, x, y, extras=None):
         """Pad (x, y) to 'data'-axis divisibility and shard; returns
         (x, y, weights) where padded rows carry loss weight 0 so a weighted
         loss divides by the REAL example count — gradients stay exact for
-        ragged batches, not just divisible ones."""
-        x, y = np.asarray(x), np.asarray(y)
-        n = len(x)
+        ragged batches, not just divisible ones. ``x``/``y`` may each be a
+        list/tuple of arrays (multi-input/multi-output ComputationGraphs);
+        the matching return slot is then a tuple, sharded leaf-wise.
+        ``extras``: optional pytree of (B, ...) arrays (sequence masks etc.)
+        padded/sharded the same way — returned as a 4th element when given."""
+        multi_x = isinstance(x, (list, tuple))
+        multi_y = isinstance(y, (list, tuple))
+        xs = [np.asarray(v) for v in (x if multi_x else [x])]
+        ys = [np.asarray(v) for v in (y if multi_y else [y])]
+        n = len(xs[0])
         pad = (self.data - n % self.data) % self.data
         w = np.ones(n + pad, np.float32)
+        rep = lambda v: np.concatenate(
+            [v, np.repeat(v[-1:], pad, axis=0)], axis=0)
         if pad:
-            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
-            y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)], axis=0)
+            xs = [rep(v) for v in xs]
+            ys = [rep(v) for v in ys]
             w[n:] = 0.0
-        return self.shard_batch(x, y, w)
+        sharded = self.shard_batch(*xs, *ys, w)
+        sx, sy, sw = sharded[: len(xs)], sharded[len(xs):-1], sharded[-1]
+        out = (sx if multi_x else sx[0], sy if multi_y else sy[0], sw)
+        if extras is None:
+            return out
+        ex = jax.tree_util.tree_map(
+            lambda v: self.shard_batch(rep(np.asarray(v)) if pad
+                                       else np.asarray(v)), extras)
+        return out + (ex,)
 
     def replicate(self, tree, keep_existing: bool = True):
         """Place a pytree fully replicated. Leaves already carrying a
