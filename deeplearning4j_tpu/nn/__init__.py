@@ -2,7 +2,7 @@
 
 Reference parity: the deeplearning4j-nn module (SURVEY.md §2.2 J7–J9)."""
 
-from deeplearning4j_tpu.nn import activations, attention, layers, layers_spatial, listeners, losses, schedules, transfer, transformer, updaters, variational, vertices, weights  # noqa: F401
+from deeplearning4j_tpu.nn import activations, attention, layers, layers_spatial, layers_special, listeners, losses, schedules, transfer, transformer, updaters, variational, vertices, weights  # noqa: F401
 from deeplearning4j_tpu.nn.transfer import (  # noqa: F401
     FineTuneConfiguration,
     FrozenLayer,

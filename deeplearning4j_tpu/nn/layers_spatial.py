@@ -482,10 +482,16 @@ class MaskLayer(Layer):
 @dataclasses.dataclass(frozen=True)
 class MaskZeroLayer(Layer):
     """Wraps a recurrent layer, masking timesteps whose input is entirely
-    ``mask_value`` (conf/layers/recurrent/MaskZeroLayer.java)."""
+    ``mask_value`` (conf/layers/recurrent/MaskZeroLayer.java).
+
+    ``carry_masked_output=False`` (reference behavior) zeroes masked
+    timesteps' outputs; True emits the previous step's output instead —
+    tf.keras's Masking contract (verified against keras: masked steps repeat
+    the last valid output), used by the Keras importer."""
 
     underlying: Optional[Layer] = None
     mask_value: float = 0.0
+    carry_masked_output: bool = False
 
     def initialize(self, key, input_shape):
         return self.underlying.initialize(key, input_shape)
@@ -497,7 +503,10 @@ class MaskZeroLayer(Layer):
         return self.underlying.output_shape(input_shape)
 
     def _derived_mask(self, x):
-        return jnp.any(x != self.mask_value, axis=-1)  # (B, T)
+        # (B, T): a step is masked when EVERY feature equals mask_value —
+        # reduce over all non-(batch, time) axes (3-D sequences and 5-D
+        # image sequences alike)
+        return jnp.any(x != self.mask_value, axis=tuple(range(2, x.ndim)))
 
     def apply(self, params, state, x, *, training=False, key=None):
         import inspect
@@ -508,8 +517,22 @@ class MaskZeroLayer(Layer):
             kw["mask"] = mask
         y, ns = self.underlying.apply(params, state, x, training=training,
                                       key=key, **kw)
-        if y.ndim == 3:
-            y = y * mask[:, :, None].astype(y.dtype)
+        if y.ndim >= 3:
+            m = mask.reshape(mask.shape + (1,) * (y.ndim - 2)).astype(y.dtype)
+            if self.carry_masked_output:
+                # forward-fill the last valid output through masked steps
+                def fill(c, inp):
+                    yt, mt = inp
+                    c2 = mt * yt + (1 - mt) * c
+                    return c2, c2
+
+                yT = jnp.swapaxes(y * m, 0, 1)
+                mT = jnp.swapaxes(m, 0, 1)
+                _, outT = jax.lax.scan(
+                    fill, jnp.zeros_like(yT[0]), (yT, mT))
+                y = jnp.swapaxes(outT, 0, 1)
+            else:
+                y = y * m
         return y, ns
 
     def to_dict(self):

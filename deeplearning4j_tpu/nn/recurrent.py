@@ -40,6 +40,18 @@ from deeplearning4j_tpu.nn.layers import Layer, register_layer
 from deeplearning4j_tpu.ops import nn as nnops
 
 
+def _merge_loss_weights(weights, mask):
+    """Per-example loss weights (B,) and a sequence mask (B,T) compose by
+    broadcasting the weights over time — both must gate the loss (the
+    masters' padding weights must not silently drop the mask)."""
+    if weights is None:
+        return mask
+    if mask is None:
+        return weights
+    return mask * weights.reshape(
+        weights.shape + (1,) * (mask.ndim - weights.ndim))
+
+
 @dataclasses.dataclass(frozen=True)
 class BaseRecurrentLayer(Layer):
     """Common recurrent config: n_in/n_out, activations, weight inits."""
@@ -325,6 +337,95 @@ class Bidirectional(Layer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over image sequences (Shi et al. 2015; the
+    reference imports Keras ConvLSTM2D via KerasConvLSTM2D.java — path-cite,
+    mount empty). Input (B, T, H, W, C) -> (B, T, H', W', filters), or the
+    final hidden state (B, H', W', filters) when ``return_sequences=False``.
+
+    TPU-native shape: the input convolution for ALL timesteps is hoisted out
+    of the scan into one big (B*T) batched convolution on the MXU; the scan
+    body adds only the recurrent convolution (stride 1, SAME — keeps the
+    spatial dims, as in Keras). Gate order [i, f, o, g]."""
+
+    n_in: int = 0
+    n_out: int = 0               # filters
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"        # input-conv padding; recurrent conv is SAME
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    weight_init: str = "xavier"
+    return_sequences: bool = True
+    forget_gate_bias_init: float = 1.0
+
+    def initialize(self, key, input_shape):
+        c_in = self.n_in or input_shape[-1]
+        kh, kw = self.kernel_size
+        f = self.n_out
+        k1, k2 = jax.random.split(key)
+        b = jnp.zeros((4 * f,))
+        b = b.at[f : 2 * f].set(self.forget_gate_bias_init)
+        return {
+            "W": winit.init(k1, self.weight_init, (kh, kw, c_in, 4 * f)),
+            "U": winit.init(k2, self.weight_init, (kh, kw, f, 4 * f)),
+            "b": b,
+        }, {}
+
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        x = self._maybe_dropout(x, training, key)
+        B, T = x.shape[:2]
+        f = self.n_out
+        f_act = act.resolve(self.activation)
+        g_act = act.resolve(self.gate_activation)
+        xp = nnops.conv2d(
+            x.reshape((B * T,) + x.shape[2:]), params["W"].astype(x.dtype),
+            params["b"].astype(x.dtype), strides=self.stride,
+            padding=self.padding)
+        xp = xp.reshape((B, T) + xp.shape[1:])          # (B,T,H',W',4F)
+        h0 = jnp.zeros((B,) + xp.shape[2:4] + (f,), x.dtype)
+        carry = (h0, h0)
+        xT = jnp.swapaxes(xp, 0, 1)                     # (T,B,H',W',4F)
+        maskT = None if mask is None else jnp.swapaxes(mask, 0, 1)
+        U = params["U"]
+
+        def body(c, inp):
+            xt = inp if maskT is None else inp[0]
+            h_prev, c_prev = c
+            z = xt + nnops.conv2d(h_prev, U.astype(xt.dtype), None,
+                                  strides=(1, 1), padding="SAME")
+            i, fg, o, g = jnp.split(z, 4, axis=-1)
+            c_new = g_act(fg) * c_prev + g_act(i) * f_act(g)
+            h_new = g_act(o) * f_act(c_new)
+            if maskT is None:
+                return (h_new, c_new), h_new
+            m = inp[1].reshape(inp[1].shape + (1,) * 3).astype(h_new.dtype)
+            keep = jax.tree_util.tree_map(
+                lambda n, old: m * n + (1 - m) * old,
+                (h_new, c_new), c)
+            return keep, m * h_new
+
+        inputs = xT if maskT is None else (xT, maskT)
+        (h_fin, _), yT = jax.lax.scan(body, carry, inputs)
+        if not self.return_sequences:
+            return h_fin, state
+        return jnp.swapaxes(yT, 0, 1), state
+
+    def output_shape(self, input_shape):
+        t, h, w, _ = input_shape
+        sh, sw = self.stride
+        kh, kw = self.kernel_size
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:  # VALID
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        if not self.return_sequences:
+            return (oh, ow, self.n_out)
+        return (t, oh, ow, self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class LastTimeStep(Layer):
     """Extract the last (mask-aware) timestep: (B,T,F) -> (B,F)
     (conf/layers/recurrent/LastTimeStep.java wraps a layer; here it is a
@@ -373,7 +474,7 @@ class RnnOutputLayer(Layer):
         x = self._maybe_dropout(x, training, key)
         logits = self._logits(params, x)
         logits_fn, act_fn, fused_act = losses_mod.resolve(self.loss)
-        w = mask if weights is None else weights
+        w = _merge_loss_weights(weights, mask)
         if logits_fn is not None and fused_act == self.activation.lower():
             return logits_fn(logits, labels, w)
         preds = act.resolve(self.activation)(logits)
@@ -402,7 +503,7 @@ class RnnLossLayer(Layer):
     def compute_loss(self, params, state, x, labels, *, training=True, key=None,
                      weights=None, mask=None):
         logits_fn, act_fn, fused_act = losses_mod.resolve(self.loss)
-        w = mask if weights is None else weights
+        w = _merge_loss_weights(weights, mask)
         if logits_fn is not None and fused_act == self.activation.lower():
             return logits_fn(x, labels, w)
         preds = act.resolve(self.activation)(x)

@@ -333,7 +333,7 @@ class SqueezeNet(ZooModel):
 @dataclasses.dataclass
 class UNet(ZooModel):
     """zoo/model/UNet.java — encoder/decoder with skip merges. Output is a
-    per-pixel sigmoid map (the reference uses CnnLossLayer with XENT)."""
+    per-pixel sigmoid map on CnnLossLayer with XENT, as in the reference."""
 
     num_classes: int = 1
     input_shape: Tuple[int, int, int] = (128, 128, 3)
@@ -363,10 +363,10 @@ class UNet(ZooModel):
             gb.add_layer(f"up{i}", Deconvolution2D(n_out=f * mult, kernel_size=(2, 2), stride=(2, 2), activation="relu"), x)
             gb.add_vertex(f"skip{i}", MergeVertex(), f"up{i}", skips[i])
             x = double_conv(f"dec{i}", f"skip{i}", f * mult)
-        from deeplearning4j_tpu.nn.layers import LossLayer
+        from deeplearning4j_tpu.nn.layers_special import CnnLossLayer
 
         gb.add_layer("logits", ConvolutionLayer(n_out=self.num_classes, kernel_size=(1, 1)), x)
-        gb.add_layer("output", LossLayer(loss="xent", activation="sigmoid"), "logits")
+        gb.add_layer("output", CnnLossLayer(loss="xent", activation="sigmoid"), "logits")
         gb.set_outputs("output")
         gb.set_input_types(InputType.convolutional(h, w, c))
         return gb.build()

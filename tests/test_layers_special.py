@@ -1,0 +1,205 @@
+"""Capsule family + CNN loss heads + CenterLoss/OCNN + EmbeddingSequence
+(VERDICT r2 next-round #4): gradcheck row, JSON round-trip, and a small
+capsule-net training run, mirroring the reference gradientcheck suite
+(CNNGradientCheckTest / CapsnetGradientCheckTest — path-cite, mount empty).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import gradcheck
+from deeplearning4j_tpu.nn.layers_special import (
+    CapsuleLayer,
+    CapsuleStrengthLayer,
+    Cnn3DLossLayer,
+    CnnLossLayer,
+    CenterLossOutputLayer,
+    EmbeddingSequenceLayer,
+    OCNNOutputLayer,
+    PrimaryCapsules,
+)
+
+
+def _cast_like(p, x):
+    leaves = jax.tree_util.tree_leaves(p)
+    return x.astype(leaves[0].dtype) if leaves else x
+
+
+class TestGradients:
+    def test_primary_capsules_gradients(self, rng):
+        layer = PrimaryCapsules(capsule_dimensions=4, channels=3,
+                                kernel_size=(3, 3), stride=(2, 2))
+        params, state = layer.initialize(jax.random.PRNGKey(0), (7, 7, 2))
+        x = jnp.asarray(rng.standard_normal((2, 7, 7, 2)))
+
+        def loss(p):
+            y, _ = layer.apply(p, state, _cast_like(p, x))
+            return jnp.sum(y ** 2)
+
+        res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
+        assert res.passed, res
+
+    def test_capsule_layer_gradients(self, rng):
+        layer = CapsuleLayer(capsules=3, capsule_dimensions=4, routings=3)
+        params, state = layer.initialize(jax.random.PRNGKey(0), (6, 5))
+        x = jnp.asarray(rng.standard_normal((2, 6, 5)))
+
+        def loss(p):
+            y, _ = layer.apply(p, state, _cast_like(p, x))
+            return jnp.sum(y ** 2)
+
+        res = gradcheck.check_model_gradients(loss, params, eps=1e-4)
+        assert res.passed, res
+
+    def test_center_loss_gradients(self, rng):
+        layer = CenterLossOutputLayer(n_in=5, n_out=3, lambda_coeff=0.1,
+                                      alpha=0.5)
+        params, state = layer.initialize(jax.random.PRNGKey(0), (5,))
+        # move centers off zero so the center gradient is non-trivial
+        params["centers"] = jnp.asarray(rng.standard_normal((3, 5)) * 0.3)
+        x = jnp.asarray(rng.standard_normal((4, 5)))
+        y = jnp.asarray(np.eye(3)[[0, 2, 1, 0]])
+
+        def loss(p):
+            return layer.compute_loss(p, state, _cast_like(p, x),
+                                      _cast_like(p, y), training=False)
+
+        res = gradcheck.check_model_gradients(loss, params)
+        assert res.passed, res
+
+    def test_ocnn_gradients(self, rng):
+        layer = OCNNOutputLayer(n_in=5, hidden_size=4, nu=0.1)
+        params, state = layer.initialize(jax.random.PRNGKey(0), (5,))
+        x = jnp.asarray(rng.standard_normal((6, 5)))
+
+        def loss(p):
+            return layer.compute_loss(p, state, _cast_like(p, x), None,
+                                      training=False)
+
+        res = gradcheck.check_model_gradients(loss, params)
+        assert res.passed, res
+
+    def test_embedding_sequence_gradients(self, rng):
+        layer = EmbeddingSequenceLayer(n_in=7, n_out=3, has_bias=True)
+        params, state = layer.initialize(jax.random.PRNGKey(1), (4,))
+        ids = jnp.asarray(rng.integers(0, 7, size=(2, 4)))
+
+        def loss(p):
+            y, _ = layer.apply(p, state, ids)
+            return jnp.sum(y.astype(
+                jax.tree_util.tree_leaves(p)[0].dtype) ** 2)
+
+        res = gradcheck.check_model_gradients(loss, params)
+        assert res.passed, res
+
+
+class TestLossHeads:
+    def test_cnn_loss_layer_matches_manual(self, rng):
+        layer = CnnLossLayer(loss="xent", activation="sigmoid")
+        logits = jnp.asarray(rng.standard_normal((2, 4, 4, 1)))
+        labels = jnp.asarray(
+            rng.integers(0, 2, size=(2, 4, 4, 1)).astype(np.float64))
+        got = float(layer.compute_loss({}, {}, logits, labels))
+        p = jax.nn.sigmoid(logits)
+        want = float(jnp.mean(-(labels * jnp.log(p)
+                                + (1 - labels) * jnp.log1p(-p))))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cnn3d_loss_layer_runs(self, rng):
+        layer = Cnn3DLossLayer(loss="mse", activation="identity")
+        x = jnp.asarray(rng.standard_normal((2, 3, 4, 4, 2)))
+        y = jnp.asarray(rng.standard_normal((2, 3, 4, 4, 2)))
+        v = float(layer.compute_loss({}, {}, x, y))
+        np.testing.assert_allclose(v, float(jnp.mean((x - y) ** 2)), rtol=1e-4)
+
+    def test_ocnn_r_converges_to_quantile(self, rng):
+        """Gradient descent on r solves the nu-quantile stationarity —
+        the reference's explicit quantile re-solve, recovered by SGD."""
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        layer = OCNNOutputLayer(n_in=4, hidden_size=6, nu=0.2)
+        params, state = layer.initialize(jax.random.PRNGKey(0), (4,))
+        x = jnp.asarray(rng.standard_normal((256, 4)).astype(np.float32))
+        upd = Sgd(0.05)
+        opt = upd.init_state({"r": params["r"]})
+
+        @jax.jit
+        def step(r, opt, i):
+            def only_r(rv):
+                p = dict(params)
+                p["r"] = rv
+                return layer.compute_loss(p, state, x, None)
+
+            g = jax.grad(only_r)(r)
+            from deeplearning4j_tpu.nn import updaters as U
+            new, opt2 = U.apply_updater(upd, {"r": r}, {"r": g}, opt, i)
+            return new["r"], opt2
+
+        r = params["r"]
+        for i in range(400):
+            r, opt = step(r, opt, jnp.asarray(i))
+        scores = np.asarray(layer._score(params, x))
+        want = np.quantile(scores, layer.nu)
+        assert abs(float(r) - want) < 0.05, (float(r), want)
+
+
+class TestSerialization:
+    @pytest.mark.parametrize("layer", [
+        CnnLossLayer(loss="xent", activation="sigmoid"),
+        Cnn3DLossLayer(),
+        CenterLossOutputLayer(n_in=5, n_out=3, alpha=0.1, lambda_coeff=1e-3),
+        OCNNOutputLayer(n_in=5, hidden_size=7, nu=0.1),
+        EmbeddingSequenceLayer(n_in=11, n_out=6, has_bias=True),
+        PrimaryCapsules(capsule_dimensions=8, channels=4, kernel_size=(5, 5)),
+        CapsuleLayer(capsules=10, capsule_dimensions=16, routings=2),
+        CapsuleStrengthLayer(),
+    ])
+    def test_json_roundtrip(self, layer):
+        from deeplearning4j_tpu.nn.layers import layer_from_dict
+
+        back = layer_from_dict(layer.to_dict())
+        assert back == layer
+
+
+class TestCapsNetTraining:
+    def test_capsnet_trains_small_mnist_like(self, rng):
+        """PrimaryCapsules -> CapsuleLayer -> CapsuleStrengthLayer trains on
+        a small synthetic digit task (the reference's capsnet MNIST config,
+        shrunk to CI size)."""
+        from deeplearning4j_tpu.nn import (
+            InputType,
+            MultiLayerNetwork,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer, LossLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(2e-2))
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                        padding="VALID", activation="relu"))
+                .layer(PrimaryCapsules(capsule_dimensions=4, channels=4,
+                                       kernel_size=(3, 3), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=3, capsule_dimensions=6,
+                                    routings=3))
+                # capsule lengths live in [0,1): mse-to-one-hot is the
+                # margin-style objective that can actually reach 0 (softmax
+                # cross-entropy on lengths floors at -log softmax(1,0,0))
+                .layer(CapsuleStrengthLayer())
+                .layer(LossLayer(loss="mse", activation="identity"))
+                .set_input_type(InputType.convolutional(10, 10, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # 3 synthetic "digit" prototypes + noise
+        protos = rng.standard_normal((3, 10, 10, 1)).astype(np.float32)
+        ys = rng.integers(0, 3, 96)
+        xs = (protos[ys] + 0.3 * rng.standard_normal((96, 10, 10, 1))
+              ).astype(np.float32)
+        yoh = np.eye(3, dtype=np.float32)[ys]
+        s0 = net.score(x=xs, y=yoh)
+        net.fit(xs, yoh, epochs=120)
+        assert net.score(x=xs, y=yoh) < s0 * 0.5
+        acc = (np.argmax(np.asarray(net.output(xs)), 1) == ys).mean()
+        assert acc > 0.9, acc
