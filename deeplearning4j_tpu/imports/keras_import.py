@@ -720,7 +720,10 @@ _LAYER_BUILDERS = {
     "ConvLSTM2D": _conv_lstm2d,
     "Masking": lambda cfg, w: (
         _PendingMasking(cfg.get("mask_value", 0.0)), {}),
-    "LeakyReLU": lambda cfg, w: (L.ActivationLayer(activation="leakyrelu"), {}),
+    "LeakyReLU": lambda cfg, w: (L.ActivationLayer(
+        activation="leakyrelu",
+        activation_args={"alpha": float(cfg.get(
+            "negative_slope", cfg.get("alpha", 0.3)))}), {}),
     "GaussianNoise": lambda cfg, w: (None, {}),    # identity at inference
     "GaussianDropout": lambda cfg, w: (None, {}),  # identity at inference
 }

@@ -275,15 +275,21 @@ class BatchNormalization(Layer):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class ActivationLayer(Layer):
-    """Standalone activation (conf/layers/ActivationLayer.java)."""
+    """Standalone activation (conf/layers/ActivationLayer.java).
+    ``activation_args`` forwards extra config to the op (e.g. leakyrelu's
+    alpha — Keras LeakyReLU defaults to 0.3, the op to 0.01)."""
 
     activation: str = "relu"
+    activation_args: Optional[dict] = None
 
     def has_params(self):
         return False
 
     def apply(self, params, state, x, *, training=False, key=None):
-        return act.resolve(self.activation)(x), state
+        fn = act.resolve(self.activation)
+        if self.activation_args:
+            return fn(x, **self.activation_args), state
+        return fn(x), state
 
 
 @register_layer

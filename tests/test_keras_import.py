@@ -384,3 +384,18 @@ def test_masking_then_dense_rejected(rng, tmp_path):
     m.save(path)
     with pytest.raises(KerasImportError, match="Masking"):
         KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_leaky_relu_and_noise_layers(rng, tmp_path):
+    """LeakyReLU keeps keras's alpha (0.3 default, not the op's 0.01);
+    Gaussian noise/dropout are identity at inference."""
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(5),
+        tf.keras.layers.LeakyReLU(),
+        tf.keras.layers.GaussianNoise(0.5),
+        tf.keras.layers.Dense(3),
+        tf.keras.layers.GaussianDropout(0.3),
+    ])
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
