@@ -42,7 +42,12 @@ _ACT = {"relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
         "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
         "softplus": "softplus", "softsign": "softsign", "swish": "swish",
         "gelu": "gelu", "hard_sigmoid": "hard_sigmoid",
-        "leaky_relu": "leakyrelu", "exponential": "exp"}
+        "exponential": "exp"}
+# NOT mapped: the string form activation="leaky_relu" — Keras applies
+# negative_slope=0.2 there while the op default is 0.01, and the string
+# path cannot carry the slope; the LeakyReLU LAYER form imports correctly
+# (activation_args) and _act() raises for the string per the no-silent-
+# substitution convention.
 
 
 class KerasImportError(ValueError):

@@ -408,3 +408,98 @@ class TestDistributedBootstrap:
         assert outs[0]["err"] < 0.5, outs  # learning happened
         # (identity of replicas above is the core assertion; 30
         #  gloo-allreduce steps on one host core cannot fully converge)
+
+
+@pytest.mark.multichip
+class TestCompressionAtScale:
+    """VERDICT r2 next-round #6: the threshold/residual chain at a real
+    parameter count (25M), where encode cost, bitmap density, and residual
+    memory actually bite — not the toy gradient sizes of the unit tests."""
+
+    N_PARAMS = 25_000_000
+
+    def _big_net(self, rng):
+        from deeplearning4j_tpu.nn import (
+            InputType,
+            MultiLayerNetwork,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        # 2048*4096 + 4096*4096 + 4096*16 ≈ 25.3M params
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.01))
+                .list()
+                .layer(DenseLayer(n_in=2048, n_out=4096, activation="relu"))
+                .layer(DenseLayer(n_in=4096, n_out=4096, activation="relu"))
+                .layer(OutputLayer(n_in=4096, n_out=16, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(2048))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        n = sum(int(np.prod(np.shape(p)))
+                for lp in net.params for p in lp.values())
+        assert n >= self.N_PARAMS, n
+        return net
+
+    def test_encode_decode_conservation_25m(self, rng):
+        """Accumulator invariant at 25M elements: quantized + new_residual
+        == grad + old_residual to fp32 rounding (error feedback loses
+        nothing but low bits — subtracting ±t then re-adding loses up to
+        ~2e-10 at this scale)."""
+        import jax.numpy as jnp
+
+        acc = EncodedGradientsAccumulator(residual_post_processor=None)
+        g = jnp.asarray(rng.standard_normal(self.N_PARAMS).astype(np.float32)
+                        * 1e-3)
+        res = jnp.zeros_like(g)
+        thr = jnp.asarray(1e-3, jnp.float32)
+        quant, new_res, _, ratio = acc.encode(
+            {"g": g}, {"g": res}, thr, jnp.asarray(0))
+        np.testing.assert_allclose(
+            np.asarray(quant["g"] + new_res["g"]), np.asarray(g),
+            atol=1e-9)
+        # sane sparsity at threshold=sigma/… : some but not all transmitted
+        assert 0.0 < float(ratio) < 1.0
+        # transmitted entries move a multiple of t; untransmitted are intact
+        nz = np.asarray(quant["g"]) != 0
+        assert np.all(np.abs(np.asarray(quant["g"])[nz]) == np.float32(1e-3))
+
+    def test_shared_training_master_25m_steps(self, rng):
+        """3 full SharedTrainingMaster steps at 25M params on the 8-device
+        mesh: loss finite AND moving (a frozen loss means the threshold
+        chain swallowed every gradient), step time within a collapse-
+        detection factor of the dense (uncompressed) DP step."""
+        import time
+
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        xs = rng.standard_normal((32, 2048)).astype(np.float32)
+        ys = np.eye(16, dtype=np.float32)[rng.integers(0, 16, 32)]
+        it = ArrayDataSetIterator(xs, ys, batch=32)
+
+        net = self._big_net(rng)
+        master = SharedTrainingMaster(threshold=1e-4,
+                                      mesh=TrainingMesh(data=8))
+        master.fit(net, it, epochs=1)  # compile + first step
+        s_first = float(net.score_value)
+        t0 = time.perf_counter()
+        master.fit(net, it, epochs=2)
+        shared_dt = (time.perf_counter() - t0) / 2
+        assert np.isfinite(net.score_value)
+        assert float(net.score_value) != s_first  # gradients DO transmit
+
+        net2 = self._big_net(rng)
+        pw = ParallelWrapper(net2, mesh=TrainingMesh(data=8))
+        pw.fit(it, epochs=1)
+        t0 = time.perf_counter()
+        pw.fit(it, epochs=2)
+        dense_dt = (time.perf_counter() - t0) / 2
+        # Measured on this single-core host: shared ≈ 8.4x dense (13.8 s vs
+        # 1.6 s) — the 8 virtual devices each encode a full 25M-element
+        # gradient copy + carry an (8, 25M) residual, all on ONE core, so
+        # this measures host memory bandwidth, not the ICI design (numbers
+        # in BASELINE.md). The bound is a collapse detector (e.g. an
+        # accidental O(n^2) or per-element host loop), not a perf target.
+        assert shared_dt < dense_dt * 20 + 10.0, (shared_dt, dense_dt)
