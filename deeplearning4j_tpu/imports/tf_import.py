@@ -461,6 +461,30 @@ def _strided_slice(m, node):
         m.const_vals[node.name + ":0"] = np.asarray(m.const_vals[src])[idx]
 
 
+@rule("SpaceToBatchND")
+def _space_to_batch_nd(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    bs = tuple(int(v) for v in m.const(ins[1]))
+    pads = tuple(tuple(int(v) for v in row)
+                 for row in np.atleast_2d(m.const(ins[2])))
+    m.set(node.name, m.sd._op("space_to_batch", [x],
+                              attrs=dict(block_shape=bs, paddings=pads),
+                              name=node.name))
+
+
+@rule("BatchToSpaceND")
+def _batch_to_space_nd(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    bs = tuple(int(v) for v in m.const(ins[1]))
+    crops = tuple(tuple(int(v) for v in row)
+                  for row in np.atleast_2d(m.const(ins[2])))
+    m.set(node.name, m.sd._op("batch_to_space", [x],
+                              attrs=dict(block_shape=bs, crops=crops),
+                              name=node.name))
+
+
 @rule("Pad", "PadV2")
 def _pad(m, node):
     ins = m.inputs(node)

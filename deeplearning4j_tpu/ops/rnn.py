@@ -254,3 +254,110 @@ def gru_cell(x, h_prev, W, R, b=None, *, linear_before_reset=1,
         r = f_g(xr + h_prev @ Rr.T + brr)
         n = f_c(xh + (r * h_prev) @ Rn.T + bn)
     return (1.0 - z) * n + z * h_prev
+
+
+@op("sequence_mask", "rnn", differentiable=False)
+def sequence_mask(lengths, maxlen=None, dtype=jnp.bool_):
+    """lengths (B,) -> (B, maxlen) mask (generic/parity_ops/sequence_mask.cpp,
+    path-cite). ``maxlen`` must be static (XLA shapes); defaults to a
+    traceable max only when lengths is concrete."""
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    r = jnp.arange(maxlen)
+    return (r[None, :] < jnp.asarray(lengths)[:, None]).astype(dtype)
+
+
+@op("sru_cell", "rnn", aliases=("sruCell",))
+def sru_cell(x, c_prev, W, b):
+    """One Simple Recurrent Unit step (generic/recurrent/sruCell.cpp,
+    path-cite; Lei et al. 2017). x: (B, I); c_prev: (B, I); W: (3I, I);
+    b: (2I,). Returns (h, c). SRU's highway form requires n_out == n_in."""
+    i = x.shape[-1]
+    if W.shape != (3 * i, i) or b.shape != (2 * i,):
+        raise ValueError(
+            f"sru_cell expects W (3I,I)={3 * i, i} and b (2I,)={2 * i,}; "
+            f"got W {W.shape}, b {b.shape}")
+    z = x @ W.T.astype(x.dtype)                      # (B, 3I)
+    zt, f_in, r_in = jnp.split(z, 3, axis=-1)
+    bf, br = jnp.split(b.astype(x.dtype), 2)
+    f = jax.nn.sigmoid(f_in + bf)
+    r = jax.nn.sigmoid(r_in + br)
+    c = f * c_prev + (1.0 - f) * zt
+    h = r * jnp.tanh(c) + (1.0 - r) * x
+    return h, c
+
+
+@op("sru", "rnn", aliases=("sru_layer",))
+def sru(x, W, b, c0=None, mask=None, layout=1):
+    """Whole-sequence SRU (generic/recurrent/sru.cpp, path-cite). The
+    elementwise recurrence has NO recurrent matmul, so the scan body is
+    pure vector math — the big (B*T, I)x(I, 3I) projection is hoisted out
+    and hits the MXU once. layout 1 = (B, T, I), 0 = (T, B, I). Returns
+    (h_seq, c_final)."""
+    if layout == 1:
+        x = jnp.swapaxes(x, 0, 1)                    # (T, B, I)
+        if mask is not None:
+            mask = jnp.swapaxes(mask, 0, 1)
+    t, bsz, i = x.shape
+    z = (x.reshape(t * bsz, i) @ W.T.astype(x.dtype)).reshape(t, bsz, 3 * i)
+    zt, f_in, r_in = jnp.split(z, 3, axis=-1)
+    bf, br = jnp.split(b.astype(x.dtype), 2)
+    f = jax.nn.sigmoid(f_in + bf)
+    r = jax.nn.sigmoid(r_in + br)
+    c_init = jnp.zeros((bsz, i), x.dtype) if c0 is None else c0.astype(x.dtype)
+
+    def body(c, inp):
+        if mask is None:
+            xt, zt_, ft, rt = inp
+            c_new = ft * c + (1.0 - ft) * zt_
+        else:
+            xt, zt_, ft, rt, mt = inp
+            c_new = ft * c + (1.0 - ft) * zt_
+            m = mt[:, None].astype(c.dtype)
+            c_new = m * c_new + (1.0 - m) * c
+        h = rt * jnp.tanh(c_new) + (1.0 - rt) * xt
+        if mask is not None:
+            h = h * mt[:, None].astype(h.dtype)
+        return c_new, h
+
+    seq = (x, zt, f, r) if mask is None else (x, zt, f, r, mask)
+    c_fin, h = lax.scan(body, c_init, seq)
+    if layout == 1:
+        h = jnp.swapaxes(h, 0, 1)
+    return h, c_fin
+
+
+@op("conv_lstm_2d", "rnn", aliases=("convLstm2d",))
+def conv_lstm_2d(x, W, U, b=None, h0=None, c0=None, *, stride=(1, 1),
+                 padding="SAME", gate_activation="sigmoid",
+                 activation="tanh"):
+    """Convolutional LSTM over (B, T, H, W, C) (Shi et al. 2015; the
+    reference ships this capability via Keras import — KerasConvLSTM2D.java,
+    path-cite). W: (kh, kw, Cin, 4F) input-conv kernel; U: (kh, kw, F, 4F)
+    recurrent kernel (stride 1, SAME). Gate order [i, f, o, g]. Returns
+    (y_seq, (h_fin, c_fin)). The input convolution for ALL timesteps runs as
+    one batched MXU convolution outside the scan."""
+    f_act = _act(activation)
+    g_act = _act(gate_activation)
+    bsz, t = x.shape[:2]
+    nf = W.shape[-1] // 4
+    xp = nnops.conv2d(x.reshape((bsz * t,) + x.shape[2:]), W.astype(x.dtype),
+                      None if b is None else b.astype(x.dtype),
+                      strides=stride, padding=padding)
+    xp = xp.reshape((bsz, t) + xp.shape[1:])
+    zeros = jnp.zeros((bsz,) + xp.shape[2:4] + (nf,), x.dtype)
+    h_init = zeros if h0 is None else h0.astype(x.dtype)
+    c_init = zeros if c0 is None else c0.astype(x.dtype)
+
+    def body(carry, xt):
+        h_prev, c_prev = carry
+        z = xt + nnops.conv2d(h_prev, U.astype(xt.dtype), None,
+                              strides=(1, 1), padding="SAME")
+        i_g, f_g, o_g, g_g = jnp.split(z, 4, axis=-1)
+        c_new = g_act(f_g) * c_prev + g_act(i_g) * f_act(g_g)
+        h_new = g_act(o_g) * f_act(c_new)
+        return (h_new, c_new), h_new
+
+    (h_fin, c_fin), y = lax.scan(body, (h_init, c_init),
+                                 jnp.swapaxes(xp, 0, 1))
+    return jnp.swapaxes(y, 0, 1), (h_fin, c_fin)

@@ -256,7 +256,42 @@ def depth_to_space(x, block_size, data_format="NHWC"):
     return x
 
 
-@op("batch_to_space", "shape")
+@op("space_to_batch", "shape", aliases=("space_to_batch_nd",))
+def space_to_batch(x, block_shape, paddings):
+    """TF space_to_batch_nd semantics (generic/parity_ops/space_to_batch.cpp,
+    path-cite): zero-pad the M leading spatial dims, then move block factors
+    from the spatial dims into batch. Inverse of :func:`batch_to_space`."""
+    block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+    paddings = [(int(a), int(b)) for a, b in np.atleast_2d(paddings)]
+    if any(p0 < 0 or p1 < 0 for p0, p1 in paddings):
+        raise ValueError(f"paddings must be non-negative, got {paddings}")
+    m = len(block_shape)
+    pads = [(0, 0)] + paddings + [(0, 0)] * (x.ndim - 1 - m)
+    x = jnp.pad(x, pads)
+    b = x.shape[0]
+    spatial = x.shape[1:1 + m]
+    rest = x.shape[1 + m:]
+    for s, bs in zip(spatial, block_shape):
+        if s % bs:
+            raise ValueError(
+                f"padded spatial dims {spatial} not divisible by "
+                f"block_shape {block_shape}")
+    # (B, s0/b0, b0, s1/b1, b1, ..., rest) → blocks out front
+    shape = (b,)
+    for s, bs in zip(spatial, block_shape):
+        shape += (s // bs, bs)
+    y = x.reshape(shape + rest)
+    perm = [2 * i + 2 for i in range(m)] + [0] + \
+        [2 * i + 1 for i in range(m)] + \
+        list(range(1 + 2 * m, 1 + 2 * m + len(rest)))
+    y = jnp.transpose(y, perm)
+    prod = int(np.prod(block_shape))
+    return y.reshape((b * prod,)
+                     + tuple(s // bs for s, bs in zip(spatial, block_shape))
+                     + rest)
+
+
+@op("batch_to_space", "shape", aliases=("batch_to_space_nd",))
 def batch_to_space(x, block_shape, crops):
     """Inverse of space_to_batch (TF batch_to_space_nd semantics): moves
     block factors from the batch dim back into the spatial dims, then crops."""

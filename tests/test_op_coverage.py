@@ -185,6 +185,16 @@ OVERRIDES = {
                              jnp.ones((12, 3)) * 0.1),
     "gru_cell": lambda f: f(jnp.ones((2, 4)), jnp.zeros((2, 3)),
                             jnp.ones((9, 4)) * 0.1, jnp.ones((9, 3)) * 0.1),
+    "sequence_mask": lambda f: f(jnp.asarray([1, 3, 2]), 4),
+    "sru_cell": lambda f: f(jnp.ones((2, 4)), jnp.zeros((2, 4)),
+                            jnp.ones((12, 4)) * 0.1, jnp.zeros((8,))),
+    "sru": lambda f: f(jnp.ones((2, 3, 4)), jnp.ones((12, 4)) * 0.1,
+                       jnp.zeros((8,))),
+    "conv_lstm_2d": lambda f: f(jnp.ones((1, 2, 4, 4, 3)),
+                                jnp.ones((3, 3, 3, 8)) * 0.1,
+                                jnp.ones((3, 3, 2, 8)) * 0.1),
+    "space_to_batch": lambda f: f(jnp.ones((1, 4, 4, 1)), (2, 2),
+                                  [[0, 0], [0, 0]]),
     # image ops
     "image_resize": lambda f: f(IMG, (2, 2)),
     "resize_bilinear": lambda f: f(IMG, (2, 2)),
