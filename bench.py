@@ -157,6 +157,53 @@ def bench_scaling():
     }
 
 
+def bench_attention_2k(batch: int = 4, seq: int = 2048, iters: int = 8):
+    """Extra metric (VERDICT r2 #5): seq-2048 flash-attention fwd+bwd token
+    throughput — the regime where the Pallas kernel earns its keep (measured
+    crossover table in BASELINE.md). K iterations inside ONE jit to amortize
+    the tunnel dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import flash_attention
+
+    H, D = 12, 64
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(batch, H, seq, D)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def loss(q, k, v, s):
+        return jnp.sum(flash_attention(q + s, k, v).astype(jnp.float32))
+
+    g = jax.value_and_grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v):
+        def body(c, s):
+            val, grads = g(q, k, v, s.astype(jnp.bfloat16))
+            return c + val + sum(jnp.sum(x).astype(jnp.float32)
+                                 for x in grads), None
+
+        out, _ = jax.lax.scan(
+            body, jnp.float32(0),
+            jnp.arange(iters, dtype=jnp.float32) * 1e-6)
+        return out
+
+    float(many(q, k, v))  # compile + warm
+    t0 = time.perf_counter()
+    float(many(q, k, v))
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "metric": "flash_attention_seq2048_tokens_per_sec",
+        "model": f"flash fwd+bwd B={batch} H={H} S={seq} D={D} bf16",
+        "value": round(batch * seq / dt),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference number exists (BASELINE.md)
+    }
+
+
 def bench_lenet(batch: int, steps: int):
     import __graft_entry__ as ge
 
@@ -201,6 +248,12 @@ def main():
         extra.append(bench_scaling())
     except Exception as e:
         print(f"scaling bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+    if on_tpu:  # flash-vs-naive only means anything on the real chip
+        try:
+            extra.append(bench_attention_2k())
+        except Exception as e:
+            print(f"attention bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     result["extra_metrics"] = extra
     print(json.dumps(result))
 

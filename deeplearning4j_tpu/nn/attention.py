@@ -8,14 +8,16 @@ op) — path-cite, mount empty this round. SURVEY.md §5.7: attention in the
 reference exists only as these single-device layers.
 
 TPU-native: sequences are [batch, time, features]; the attention core is
-``ops.attention`` (exact einsum path or the Pallas flash kernel — set
-``flash=True`` for long sequences, which the reference cannot handle at all).
+``ops.attention`` — exact einsum path or the Pallas flash kernel, picked
+automatically by the measured crossover (``flash="auto"``, the default:
+flash from 1024 tokens on TPU; see BASELINE.md). The reference cannot
+handle long sequences at all.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +36,7 @@ class BaseAttentionLayer(Layer):
     head_size: Optional[int] = None  # default n_out // n_heads
     project_input: bool = True
     weight_init: str = "xavier"
-    flash: bool = False  # use the Pallas/blockwise flash path (no padding mask)
+    flash: Any = "auto"  # True | False | "auto" (measured-crossover dispatch)
 
     @property
     def _head_size(self) -> int:

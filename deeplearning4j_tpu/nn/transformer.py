@@ -8,14 +8,16 @@ natively in MultiLayerNetwork/ComputationGraph, with the TF-import path
 (deeplearning4j_tpu.samediff) as the parity route.
 
 TPU-native: [B,T,H] layout; each block is two residual sublayers whose
-matmuls XLA tiles onto the MXU; set ``flash=True`` on the attention for long
-sequences (Pallas kernel, no padding mask support).
+matmuls XLA tiles onto the MXU; attention picks the exact or Pallas flash
+path by the measured crossover (``flash="auto"``, the default — flash from
+1024 tokens on TPU, BASELINE.md; the Pallas path has no padding-mask
+support, so masked batches always use the exact path).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -95,7 +97,7 @@ class TransformerEncoderBlock(Layer):
     attn_dropout: float = 0.0
     hidden_dropout: float = 0.0
     init_range: float = 0.02
-    flash: bool = False
+    flash: Any = "auto"  # True | False | "auto" (measured-crossover dispatch)
     pre_norm: bool = False  # pre-LN variant (GPT-style)
 
     @property
@@ -126,7 +128,7 @@ class TransformerEncoderBlock(Layer):
         q = split(x @ params["Wq"] + params["bq"])
         k = split(x @ params["Wk"] + params["bk"])
         v = split(x @ params["Wv"] + params["bv"])
-        if self.flash and mask is None:
+        if attn_ops.resolve_flash(self.flash, t, t, mask):
             o = attn_ops.flash_attention(q, k, v)
         else:
             amask = None if mask is None else mask[:, None, None, :].astype(bool)

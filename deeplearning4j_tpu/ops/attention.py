@@ -301,6 +301,29 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, use_pallas, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# Measured crossover on the real chip (BASELINE.md round-3 table; fwd+bwd,
+# bf16, BERT-base head geometry, token count held constant): flash/naive
+# speedup by seq — 128: 1.00, 512: 0.70 (one 512-token block degenerates to
+# naive-with-overhead), 1024: 1.08, 2048: 1.29, 4096: 1.27. Flash earns its
+# keep from 1024 tokens; the jnp blockwise fallback never wins on CPU.
+FLASH_MIN_SEQ = 1024
+
+
+def resolve_flash(flash, seq_q, seq_k, mask=None) -> bool:
+    """Auto-dispatch rule for the attention layers: ``flash`` may be True,
+    False, or "auto" (pick the Pallas path when the measured crossover says
+    it wins — TPU backend, no padding mask, seq >= FLASH_MIN_SEQ)."""
+    if flash not in (True, False, "auto"):
+        raise ValueError(
+            f"flash must be True, False, or 'auto'; got {flash!r}")
+    if mask is not None:
+        return False
+    if flash == "auto":
+        return (jax.default_backend() == "tpu"
+                and min(seq_q, seq_k) >= FLASH_MIN_SEQ)
+    return bool(flash)
+
+
 @op("flash_attention", "attention")
 def flash_attention(
     q,
@@ -357,17 +380,19 @@ def multi_head_dot_product_attention(
     mask=None,
     scale: Optional[float] = None,
     causal: bool = False,
-    flash: bool = False,
+    flash="auto",
 ):
     """Projected multi-head attention over [B, T, F] sequences.
 
     Wq/Wk/Wv: (F, H*Dh); Wo: (H*Dh, Fout). ``mask`` is a [B, Tk] padding mask
     (ND4J semantics: 1 = valid) or a full [B, 1|H, Tq, Tk] attention mask.
+    ``flash``: True | False | "auto" (measured-crossover dispatch — see
+    :func:`resolve_flash`).
     """
     q = _split_heads(queries @ Wq, n_heads)
     k = _split_heads(keys @ Wk, n_heads)
     v = _split_heads(values @ Wv, n_heads)
-    if flash and mask is None:
+    if resolve_flash(flash, q.shape[2], k.shape[2], mask):
         o = flash_attention(q, k, v, scale=scale, causal=causal)
     else:
         amask = None

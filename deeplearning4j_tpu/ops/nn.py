@@ -621,11 +621,15 @@ def dot_product_attention(q, k, v, mask=None, scale=None, is_causal=False):
     ).astype(q.dtype)
 
 
-@op("multi_head_dot_product_attention", "attention", aliases=("multihead_attention",))
+@op("multihead_attention", "attention")
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None, is_causal=False):
-    """Full MHA: project, split heads, attend, merge, project.
+    """Two-input MHA convenience form: project, split heads, attend, merge.
 
-    x_q: [B,Tq,D], x_kv: [B,Tk,D]; wq/wk/wv: [D, H*dh]; wo: [H*dh, D]."""
+    x_q: [B,Tq,D], x_kv: [B,Tk,D]; wq/wk/wv: [D, H*dh]; wo: [H*dh, D].
+    NOTE: deliberately NOT named multi_head_dot_product_attention — that
+    name (the ND4J-parity three-input q/k/v op with flash auto-dispatch)
+    belongs to ops/attention.py; registering both under one name silently
+    shadowed whichever imported first (review finding, round 3)."""
     b, tq, _ = x_q.shape
     tk = x_kv.shape[1]
 

@@ -259,10 +259,16 @@ def gru_cell(x, h_prev, W, R, b=None, *, linear_before_reset=1,
 @op("sequence_mask", "rnn", differentiable=False)
 def sequence_mask(lengths, maxlen=None, dtype=jnp.bool_):
     """lengths (B,) -> (B, maxlen) mask (generic/parity_ops/sequence_mask.cpp,
-    path-cite). ``maxlen`` must be static (XLA shapes); defaults to a
-    traceable max only when lengths is concrete."""
+    path-cite). ``maxlen`` must be static (it sets the output shape, an XLA
+    requirement); omitting it is only possible with concrete lengths."""
     if maxlen is None:
-        maxlen = int(jnp.max(lengths))
+        if isinstance(lengths, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask under jit needs an explicit maxlen — the "
+                "output shape cannot depend on traced values (XLA static "
+                "shapes)")
+        arr = np.asarray(lengths)
+        maxlen = int(arr.max()) if arr.size else 0
     r = jnp.arange(maxlen)
     return (r[None, :] < jnp.asarray(lengths)[:, None]).astype(dtype)
 

@@ -43,7 +43,7 @@ class Bert(ZooModel):
     hidden_dropout: float = 0.1
     task: str = "classification"
     num_classes: int = 2
-    flash: bool = False
+    flash: object = "auto"  # True | False | "auto" (measured-crossover dispatch)
 
     @classmethod
     def base(cls, **kw):

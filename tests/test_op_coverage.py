@@ -264,7 +264,8 @@ SKIP = {
     # composite/attention/conv ops with dedicated deep tests elsewhere
     "conv2d", "deconv2d", "depthwise_conv2d", "separable_conv2d",
     "dot_product_attention", "flash_attention",
-    "multi_head_dot_product_attention", "batchnorm", "batchnorm_train",
+    "multi_head_dot_product_attention", "multihead_attention",
+    "batchnorm", "batchnorm_train",
     "layernorm", "lrn", "maxpool2d", "avgpool2d", "upsampling2d",
     "global_avg_pool", "global_max_pool", "xw_plus_b", "bias_add",
     "softmax_cross_entropy", "sigmoid_cross_entropy",
