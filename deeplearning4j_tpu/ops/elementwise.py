@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.ops.registry import op
@@ -165,13 +166,13 @@ op("rsub", "pairwise", aliases=("reversesubtract",))(lambda x, y: y - x)
 op("rdiv", "pairwise", aliases=("reversedivide",))(lambda x, y: y / x)
 op("pow", "pairwise", aliases=("power",))(jnp.power)
 op("floordiv", "pairwise", aliases=("floor_div",))(jnp.floor_divide)
-op("mod", "pairwise")(jnp.mod)
+op("mod", "pairwise", aliases=("floormod",))(jnp.mod)
 op("fmod", "pairwise")(jnp.fmod)  # C semantics: sign follows the dividend
 op("truncatediv", "pairwise")(lambda x, y: jnp.trunc(x / y))
 op("maximum", "pairwise", aliases=("max_pairwise",))(jnp.maximum)
 op("minimum", "pairwise", aliases=("min_pairwise",))(jnp.minimum)
 op("atan2", "pairwise")(jnp.arctan2)
-op("squareddifference", "pairwise", aliases=("squared_difference",))(
+op("squareddifference", "pairwise", aliases=("squared_difference", "squared_subtract"))(
     lambda x, y: jnp.square(x - y)
 )
 op("hypot", "pairwise")(jnp.hypot)
@@ -273,3 +274,70 @@ op("logit", "transform_float")(
     lambda x: jax.scipy.special.logit(x))
 op("expit", "transform_float")(
     lambda x: jax.scipy.special.expit(x))
+
+
+op("divide_no_nan", "pairwise")(
+    lambda x, y: jnp.where(y == 0, jnp.zeros_like(jnp.asarray(x) * 0.0),
+                           jnp.asarray(x) / jnp.where(y == 0, 1, y))
+)
+op("toggle_bits", "transform_same", differentiable=False)(
+    lambda x: jnp.invert(jnp.asarray(x))
+)
+
+
+@op("cyclic_shift_bits", "pairwise_bool", aliases=("rotl", "cyclic_rshift_bits_inv"),
+    differentiable=False)
+def cyclic_shift_bits(x, n):
+    """Rotate-left of integer bits (libnd4j cyclic_shift_bits, path-cite)."""
+    x = jnp.asarray(x)
+    bits = x.dtype.itemsize * 8
+    n = jnp.asarray(n) % bits
+    # unsigned view: signed dtypes would sign-extend the right shift; and
+    # mask the complementary shift so n==0 never shifts by the full width
+    # (implementation-defined in XLA)
+    ux = x.view(jnp.dtype(f"uint{bits}"))
+    out = jnp.where(n == 0, ux, (ux << n) | (ux >> ((bits - n) % bits)))
+    return out.view(x.dtype)
+
+
+@op("cumlogsumexp", "transform_same")
+def cumlogsumexp(x, axis=0, exclusive=False, reverse=False):
+    """Cumulative log-sum-exp (libnd4j cumlogsumexp, path-cite) — an
+    O(log n) associative scan of logaddexp, not a host loop."""
+    x = jnp.asarray(x)
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    out = jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad, constant_values=-jnp.inf)
+        out = jax.lax.slice_in_dim(out, 0, x.shape[axis], axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@op("clip_by_global_norm", "transform_same")
+def clip_by_global_norm(arrays, clip_norm):
+    """Scale a LIST of arrays so their joint L2 norm is <= clip_norm
+    (generic/parity_ops/clip_by_global_norm.cpp, path-cite). Returns
+    (clipped_list, global_norm)."""
+    arrays = [jnp.asarray(a) for a in arrays]
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(a.astype(jnp.float32)))
+                         for a in arrays))
+    scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+    return [a * scale.astype(a.dtype) for a in arrays], gnorm
+
+
+@op("clipbyavgnorm", "transform_same", aliases=("clip_by_avg_norm",))
+def clip_by_avg_norm(x, clip_value, axes=None):
+    """Clip by AVERAGE L2 norm (norm / numel) — libnd4j clipbyavgnorm
+    (path-cite)."""
+    x = jnp.asarray(x)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
+    avg = n / x.size if axes is None else n / np.prod(
+        [x.shape[a] for a in np.atleast_1d(axes)])
+    scale = jnp.where(avg > clip_value, clip_value / jnp.maximum(avg, 1e-12),
+                      1.0)
+    return x * scale

@@ -670,3 +670,30 @@ def xw_plus_b(x, w, b):
 @op("batch_dot", "nn_misc")
 def batch_dot(a, b):
     return jnp.einsum("b...i,b...i->b", a, b)
+
+
+@op("weighted_cross_entropy_with_logits", "loss")
+def weighted_cross_entropy_with_logits(targets, logits, pos_weight):
+    """TF semantics (generic/loss/weighted_cross_entropy_with_logits.cpp,
+    path-cite): like sigmoid CE with positive targets scaled by pos_weight.
+    Elementwise (no reduction), as in TF/the reference."""
+    z = _accf(logits)
+    t = _accf(targets)
+    log1p = jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return ((1 - t) * z
+            + (1 + (pos_weight - 1) * t) * (log1p + jnp.maximum(-z, 0)))
+
+
+@op("col2im", "conv")
+def col2im(patches, output_shape, kernel, strides=(1, 1), padding=(0, 0),
+           dilation=(1, 1)):
+    """Inverse of im2col: scatter-add patches back to the image
+    (helpers/col2im, path-cite). im2col is linear, so its exact adjoint
+    comes from jax.linear_transpose — no throwaway forward evaluation, and
+    XLA lowers it to the same conv-transpose machinery the backward pass
+    uses."""
+    shape = jax.ShapeDtypeStruct(
+        tuple(int(s) for s in output_shape), patches.dtype)
+    transpose = jax.linear_transpose(
+        lambda x: im2col(x, kernel, strides, padding, dilation), shape)
+    return transpose(patches)[0]

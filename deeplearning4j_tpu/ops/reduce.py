@@ -98,7 +98,7 @@ def std(x, axis=None, keepdims=False, bias_corrected=True):
 # --- reduce3 (pairwise distance reductions) --------------------------------
 
 
-@op("cosinesimilarity", "reduce3")
+@op("cosinesimilarity", "reduce3", aliases=("cosine_similarity",))
 def cosine_similarity(x, y, axis=None, keepdims=False, eps=1e-12):
     num = jnp.sum(x * y, axis=axis, keepdims=keepdims)
     nx = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
@@ -106,7 +106,7 @@ def cosine_similarity(x, y, axis=None, keepdims=False, eps=1e-12):
     return num / jnp.maximum(nx * ny, eps)
 
 
-@op("cosinedistance", "reduce3")
+@op("cosinedistance", "reduce3", aliases=("cosine_distance",))
 def cosine_distance(x, y, axis=None, keepdims=False):
     return 1.0 - cosine_similarity(x, y, axis=axis, keepdims=keepdims)
 
@@ -128,7 +128,7 @@ def jaccard_distance(x, y, axis=None, keepdims=False, eps=1e-12):
     return 1.0 - num / jnp.maximum(den, eps)
 
 
-@op("hammingdistance", "reduce3", differentiable=False)
+@op("hammingdistance", "reduce3", aliases=("hamming",), differentiable=False)
 def hamming_distance(x, y, axis=None, keepdims=False):
     return jnp.sum((x != y).astype(jnp.float32), axis=axis, keepdims=keepdims)
 
@@ -194,3 +194,26 @@ def percentile(x, q, axis=None, keepdims=False, interpolation="linear"):
 @op("quantile", "reduce")
 def quantile(x, q, axis=None, keepdims=False):
     return jnp.quantile(x, q, axis=axis, keepdims=keepdims)
+
+
+@op("entropy", "reduce_float")
+def entropy(x, axis=None, keepdims=False):
+    """-sum(p * ln p) (libnd4j entropy, path-cite); zero-probability terms
+    contribute 0."""
+    x = jnp.asarray(x)
+    t = jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-38)), 0.0)
+    return -jnp.sum(t, axis=axis, keepdims=keepdims)
+
+
+@op("shannon_entropy", "reduce_float", aliases=("shannonentropy",))
+def shannon_entropy(x, axis=None, keepdims=False):
+    """-sum(p * log2 p) (libnd4j shannonEntropy, path-cite)."""
+    x = jnp.asarray(x)
+    t = jnp.where(x > 0, x * jnp.log2(jnp.maximum(x, 1e-38)), 0.0)
+    return -jnp.sum(t, axis=axis, keepdims=keepdims)
+
+
+@op("log_entropy", "reduce_float", aliases=("logentropy",))
+def log_entropy(x, axis=None, keepdims=False):
+    """ln(entropy) (libnd4j logEntropy, path-cite)."""
+    return jnp.log(entropy(x, axis=axis, keepdims=keepdims))

@@ -190,14 +190,49 @@ def unique(x, size=None):
     return jnp.unique(x, size=size)
 
 
+@op("unique_with_counts", "sorting", differentiable=False)
+def unique_with_counts(x, size=None):
+    """(values, counts) — generic/parity_ops/unique.cpp's second output
+    (path-cite). ``size`` makes the result shape static for jit."""
+    return jnp.unique(x, return_counts=True, size=size)
+
+
+@op("listdiff", "sorting", aliases=("setdiff1d",), differentiable=False)
+def listdiff(x, y):
+    """Values of x not in y, plus their indices in x (TF ListDiff /
+    generic/parity_ops/listdiff.cpp, path-cite). Output shape is
+    data-dependent, so this is host-side only (not jittable) — the same
+    restriction the reference's dynamic-shape ops carry on TPU."""
+    if isinstance(x, jax.core.Tracer) or isinstance(y, jax.core.Tracer):
+        raise ValueError("listdiff has a data-dependent output shape and "
+                         "cannot run under jit (XLA static shapes)")
+    xa = np.asarray(x).reshape(-1)
+    keep = ~np.isin(xa, np.asarray(y).reshape(-1))
+    return jnp.asarray(xa[keep]), jnp.asarray(np.nonzero(keep)[0])
+
+
+@op("nth_element", "sorting", differentiable=False)
+def nth_element(x, n, reverse=False):
+    """n-th smallest (or largest) along the last axis
+    (generic/parity_ops/nth_element.cpp, path-cite)."""
+    s = jnp.sort(x, axis=-1)
+    idx = -int(n) - 1 if reverse else int(n)
+    return s[..., idx]
+
+
 @op("searchsorted", "sorting", differentiable=False)
 def searchsorted(sorted_seq, values, side="left"):
     return jnp.searchsorted(sorted_seq, values, side=side)
 
 
-@op("linspace", "creation", differentiable=False)
+@op("linspace", "creation", aliases=("lin_space",), differentiable=False)
 def linspace(start, stop, num, dtype=jnp.float32):
     return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+@op("logspace", "creation", differentiable=False)
+def logspace(start, stop, num, base=10.0, dtype=jnp.float32):
+    return jnp.logspace(start, stop, num, base=base, dtype=dtype)
 
 
 @op("arange", "creation", aliases=("range",), differentiable=False)
@@ -320,34 +355,73 @@ def batch_to_space(x, block_shape, crops):
     return y[idx]
 
 
-@op("segment_sum", "segment", differentiable=False)
+# jax's segment reductions never required sorted ids, so the sorted and
+# unsorted reference ops (generic/parity_ops/unsorted_segment_*.cpp,
+# path-cite) collapse onto the same lowerings — aliases, not duplicates.
+@op("segment_sum", "segment", aliases=("unsorted_segment_sum",), differentiable=False)
 def segment_sum(data, segment_ids, num_segments):
     import jax.ops
 
     return jax.ops.segment_sum(data, segment_ids, num_segments)
 
 
-@op("segment_max", "segment", differentiable=False)
+@op("segment_max", "segment", aliases=("unsorted_segment_max",), differentiable=False)
 def segment_max(data, segment_ids, num_segments):
     import jax.ops
 
     return jax.ops.segment_max(data, segment_ids, num_segments)
 
 
-@op("segment_min", "segment", differentiable=False)
+@op("segment_min", "segment", aliases=("unsorted_segment_min",), differentiable=False)
 def segment_min(data, segment_ids, num_segments):
     import jax.ops
 
     return jax.ops.segment_min(data, segment_ids, num_segments)
 
 
-@op("segment_mean", "segment", differentiable=False)
+@op("segment_mean", "segment", aliases=("unsorted_segment_mean",), differentiable=False)
 def segment_mean(data, segment_ids, num_segments):
     import jax.ops
 
     sums = jax.ops.segment_sum(data, segment_ids, num_segments)
     counts = jax.ops.segment_sum(jnp.ones_like(segment_ids, dtype=data.dtype), segment_ids, num_segments)
     return sums / jnp.maximum(counts, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+@op("segment_prod", "segment", aliases=("unsorted_segment_prod",), differentiable=False)
+def segment_prod(data, segment_ids, num_segments):
+    import jax.ops
+
+    return jax.ops.segment_prod(data, segment_ids, num_segments)
+
+
+@op("batch_gather", "shape", differentiable=False)
+def batch_gather(x, indices):
+    """Per-batch-row gather along axis 1 (TF batch_gather semantics)."""
+    return jnp.take_along_axis(
+        x, indices.reshape(indices.shape + (1,) * (x.ndim - indices.ndim)),
+        axis=1)
+
+
+@op("tensor_scatter_update", "shape", differentiable=False)
+def tensor_scatter_update(tensor, indices, updates):
+    """TF tensor_scatter_nd_update: out[idx] = updates (last index axis
+    addresses leading dims)."""
+    idx = tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))
+    return jnp.asarray(tensor).at[idx].set(updates)
+
+
+@op("sparse_to_dense", "shape", differentiable=False)
+def sparse_to_dense(indices, output_shape, values, default_value=0):
+    """Numeric sparse->dense (generic/parity_ops/sparse_to_dense.cpp,
+    path-cite; the string variant is waived — WAIVED.md)."""
+    out = jnp.full(tuple(int(s) for s in np.asarray(output_shape)),
+                   default_value,
+                   dtype=jnp.asarray(values).dtype)
+    idx = jnp.asarray(indices)
+    if idx.ndim == 1:
+        idx = idx[:, None]
+    return out.at[tuple(jnp.moveaxis(idx, -1, 0))].set(values)
 
 
 @op("confusion_matrix", "custom", differentiable=False)

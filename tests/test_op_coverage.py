@@ -63,6 +63,29 @@ OVERRIDES = {
     "segment_mean": lambda f: f(XN, IDX, 2),
     "segment_max": lambda f: f(XN, IDX, 2),
     "segment_min": lambda f: f(XN, IDX, 2),
+    "segment_prod": lambda f: f(XN, IDX, 2),
+    "unique_with_counts": lambda f: f(jnp.asarray([1, 2, 2, 3])),
+    "listdiff": lambda f: f(jnp.asarray([1, 2, 3, 4]), jnp.asarray([2, 4])),
+    "nth_element": lambda f: f(XN, 2),
+    "batch_gather": lambda f: f(XN, jnp.asarray([[0, 2], [1, 3], [0, 0], [5, 1]])),
+    "tensor_scatter_update": lambda f: f(XN, jnp.asarray([[0], [2]]),
+                                         XN[:2]),
+    "sparse_to_dense": lambda f: f(jnp.asarray([[0, 1], [2, 3]]), (4, 6),
+                                   jnp.asarray([1.0, 2.0])),
+    "logspace": lambda f: f(0.0, 2.0, 5),
+    "divide_no_nan": lambda f: f(XN, X.at[0, 0].set(0.0)),
+    "toggle_bits": lambda f: f(jnp.asarray([1, 2, 3], jnp.int32)),
+    "cyclic_shift_bits": lambda f: f(jnp.asarray([1, 2], jnp.int32), 3),
+    "cumlogsumexp": lambda f: f(XN),
+    "clip_by_global_norm": lambda f: f([XN, X], 1.0),
+    "clipbyavgnorm": lambda f: f(XN, 0.01),
+    "entropy": lambda f: f(X),
+    "shannon_entropy": lambda f: f(X),
+    "log_entropy": lambda f: f(X),
+    "weighted_cross_entropy_with_logits": lambda f: f(
+        (XN > 0).astype(jnp.float32), XN, 2.0),
+    "col2im": lambda f: f(
+        registry.get_op("im2col").fn(IMG, (2, 2)), IMG.shape, (2, 2)),
     "depth_to_space": lambda f: f(jnp.ones((1, 4, 4, 8)), 2),
     "dynamic_stitch": lambda f: f([jnp.asarray([0, 2]), jnp.asarray([1, 3])],
                                   [jnp.ones((2, 3)), jnp.zeros((2, 3))]),

@@ -290,3 +290,103 @@ class TestRound3Ops:
         y_b, (_, c_b) = ops.exec_op("conv_lstm_2d", x, W, U, h0=h0,
                                     c0=jnp.zeros_like(h0))
         np.testing.assert_allclose(np.asarray(y_a), np.asarray(y_b), atol=1e-6)
+
+
+class TestLongTailOps:
+    """Round-3 registry push beyond the named families (registry 377)."""
+
+    def test_unsorted_segment_family(self):
+        data = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        ids = jnp.asarray([1, 0, 1])
+        s = ops.exec_op("unsorted_segment_sum", data, ids, 2)
+        np.testing.assert_allclose(np.asarray(s), [[3, 4], [6, 8]])
+        m = ops.exec_op("unsorted_segment_mean", data, ids, 2)
+        np.testing.assert_allclose(np.asarray(m), [[3, 4], [3, 4]])
+        p = ops.exec_op("unsorted_segment_prod", data, ids, 2)
+        np.testing.assert_allclose(np.asarray(p), [[3, 4], [5, 12]])
+
+    def test_unique_with_counts_and_listdiff(self):
+        v, c = ops.exec_op("unique_with_counts", jnp.asarray([3, 1, 3, 2, 3]))
+        np.testing.assert_array_equal(np.asarray(v), [1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(c), [1, 1, 3])
+        vals, idx = ops.exec_op("listdiff", jnp.asarray([1, 2, 3, 4, 5]),
+                                jnp.asarray([2, 4]))
+        np.testing.assert_array_equal(np.asarray(vals), [1, 3, 5])
+        np.testing.assert_array_equal(np.asarray(idx), [0, 2, 4])
+
+    def test_cumlogsumexp_matches_numpy(self, rng):
+        x = jnp.asarray(rng.normal(size=(5, 3)).astype(np.float32))
+        got = np.asarray(ops.exec_op("cumlogsumexp", x, axis=0))
+        want = np.logaddexp.accumulate(np.asarray(x), axis=0)
+        # TPU transcendentals are ~1e-4-accurate; exact on CPU
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+        ex = np.asarray(ops.exec_op("cumlogsumexp", x, axis=0,
+                                    exclusive=True))
+        assert np.all(np.isneginf(ex[0]))
+        np.testing.assert_allclose(ex[1:], want[:-1], atol=5e-4, rtol=1e-4)
+
+    def test_weighted_xent_matches_tf(self, rng):
+        tf = __import__("pytest").importorskip("tensorflow")
+        t = (rng.random((3, 4)) > 0.5).astype(np.float32)
+        l = rng.normal(size=(3, 4)).astype(np.float32)
+        want = tf.nn.weighted_cross_entropy_with_logits(
+            labels=t, logits=l, pos_weight=2.0).numpy()
+        got = np.asarray(ops.exec_op(
+            "weighted_cross_entropy_with_logits", t, l, 2.0))
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+
+    def test_col2im_adjoint_of_im2col(self, rng):
+        """<im2col(x), p> == <x, col2im(p)> — exact adjointness."""
+        x = jnp.asarray(rng.normal(size=(1, 4, 4, 2)).astype(np.float32))
+        patches = ops.exec_op("im2col", x, (2, 2))
+        p = jnp.asarray(rng.normal(size=patches.shape).astype(np.float32))
+        lhs = float(jnp.sum(patches * p))
+        back = ops.exec_op("col2im", p, x.shape, (2, 2))
+        rhs = float(jnp.sum(x * back))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+    def test_clip_by_global_norm(self, rng):
+        a = jnp.asarray(rng.normal(size=(4,)).astype(np.float32)) * 10
+        b = jnp.asarray(rng.normal(size=(3,)).astype(np.float32)) * 10
+        clipped, gn = ops.exec_op("clip_by_global_norm", [a, b], 1.0)
+        got = float(jnp.sqrt(sum(jnp.sum(c * c) for c in clipped)))
+        np.testing.assert_allclose(got, 1.0, rtol=1e-4)
+        np.testing.assert_allclose(
+            float(gn), float(jnp.sqrt(jnp.sum(a * a) + jnp.sum(b * b))),
+            rtol=1e-5)
+
+    def test_entropy_family(self):
+        p = jnp.asarray([0.5, 0.25, 0.25, 0.0])
+        e = float(ops.exec_op("entropy", p))
+        np.testing.assert_allclose(e, 1.5 * np.log(2.0), rtol=1e-5)
+        sh = float(ops.exec_op("shannon_entropy", p))
+        np.testing.assert_allclose(sh, 1.5, rtol=1e-5)
+        le = float(ops.exec_op("log_entropy", p))
+        np.testing.assert_allclose(le, np.log(1.5 * np.log(2.0)), rtol=1e-5)
+
+    def test_sparse_to_dense_and_scatter(self):
+        d = ops.exec_op("sparse_to_dense", jnp.asarray([[0, 1], [1, 0]]),
+                        (2, 2), jnp.asarray([5.0, 7.0]), default_value=-1.0)
+        np.testing.assert_allclose(np.asarray(d), [[-1, 5], [7, -1]])
+        u = ops.exec_op("tensor_scatter_update", jnp.zeros((3, 2)),
+                        jnp.asarray([[2]]), jnp.asarray([[9.0, 9.0]]))
+        np.testing.assert_allclose(np.asarray(u)[2], [9, 9])
+
+    def test_bit_ops(self):
+        x = jnp.asarray([1, 2], jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(ops.exec_op("toggle_bits", x)), [-2, -3])
+        r = ops.exec_op("cyclic_shift_bits", jnp.asarray([1], jnp.int32), 33)
+        np.testing.assert_array_equal(np.asarray(r), [2])  # rot by 33 == 1
+
+    def test_divide_no_nan(self):
+        out = ops.exec_op("divide_no_nan", jnp.asarray([1.0, 2.0]),
+                          jnp.asarray([0.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(out), [0.0, 1.0])
+
+    def test_cyclic_shift_signed_dtypes(self):
+        """Rotations on signed ints must not sign-extend (review fix)."""
+        r = ops.exec_op("cyclic_shift_bits", jnp.asarray([-127], jnp.int8), 1)
+        np.testing.assert_array_equal(np.asarray(r), [3])  # 0b10000001 rotl 1
+        r0 = ops.exec_op("cyclic_shift_bits", jnp.asarray([-5], jnp.int16), 16)
+        np.testing.assert_array_equal(np.asarray(r0), [-5])  # full-width = id
