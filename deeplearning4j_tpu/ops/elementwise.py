@@ -291,11 +291,13 @@ def cyclic_shift_bits(x, n):
     """Rotate-left of integer bits (libnd4j cyclic_shift_bits, path-cite)."""
     x = jnp.asarray(x)
     bits = x.dtype.itemsize * 8
-    n = jnp.asarray(n) % bits
     # unsigned view: signed dtypes would sign-extend the right shift; and
     # mask the complementary shift so n==0 never shifts by the full width
-    # (implementation-defined in XLA)
+    # (implementation-defined in XLA). n is cast to the view dtype so a
+    # wider count array cannot promote ux (the final .view would then
+    # reinterpret widened bytes as extra elements).
     ux = x.view(jnp.dtype(f"uint{bits}"))
+    n = (jnp.asarray(n) % bits).astype(ux.dtype)
     out = jnp.where(n == 0, ux, (ux << n) | (ux >> ((bits - n) % bits)))
     return out.view(x.dtype)
 

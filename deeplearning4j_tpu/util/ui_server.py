@@ -53,11 +53,31 @@ class UIServer:
     def detach(self, storage):
         self.storages.remove(storage)
 
-    def _records(self):
+    def _records(self, session: "Optional[str]" = None):
         out = []
         for s in self.storages:
-            out.extend(s.records)
+            out.extend(r for r in s.records
+                       if session is None or r.get("session") == session)
         return sorted(out, key=lambda r: r.get("iteration", 0))
+
+    def _sessions(self) -> List[str]:
+        """All session ids across attached storages (StatsStorage
+        listSessionIDs parity — the reference UI's session browser)."""
+        ids = set()
+        for s in self.storages:
+            for r in s.records:
+                if "session" in r:
+                    ids.add(r["session"])
+        return sorted(ids)
+
+    def _newest_session(self) -> "Optional[str]":
+        """Session of the most recently inserted record (storage lists are
+        append-ordered) — 'newest' by actual arrival, not id spelling."""
+        for s in reversed(self.storages):
+            for r in reversed(s.records):
+                if "session" in r:
+                    return r["session"]
+        return None
 
     def _start(self):
         server = self
@@ -74,11 +94,23 @@ class UIServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path.startswith("/train/data"):
-                    self._send(json.dumps(server._records()).encode(),
+                from urllib.parse import parse_qs, unquote, urlparse
+
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                session = q.get("session", [None])[0]
+                if u.path == "/train/sessions":
+                    self._send(json.dumps(server._sessions()).encode(),
                                "application/json")
-                elif self.path in ("/", "/train", "/train/"):
-                    self._send(server._render().encode(), "text/html")
+                elif u.path.startswith("/train/data"):
+                    self._send(
+                        json.dumps(server._records(session)).encode(),
+                        "application/json")
+                elif u.path.startswith("/train/session/"):
+                    sid = unquote(u.path[len("/train/session/"):].rstrip("/"))
+                    self._send(server._render(sid).encode(), "text/html")
+                elif u.path in ("/", "/train", "/train/"):
+                    self._send(server._render(session).encode(), "text/html")
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -98,13 +130,18 @@ class UIServer:
             UIServer._instance = None
 
     # ------------------------------------------------------------- rendering
-    def _render(self) -> str:
+    def _render(self, session: "Optional[str]" = None) -> str:
         """DL4J overview-page parity: score chart, update:param-ratio chart
         (the reference's signature training-health plot), per-layer param
-        stddevs, iteration timing — all inline SVG, zero JS dependencies."""
+        stddevs, iteration timing — all inline SVG, zero JS dependencies.
+        Multi-session browsing (VertxUIServer session selector): every
+        session attached to any storage gets its own page."""
         import math
 
-        recs = self._records()
+        sessions = self._sessions()
+        if session is None and len(sessions) > 1:
+            session = self._newest_session()
+        recs = self._records(session)
         scores = [(r["iteration"], r["score"]) for r in recs if "score" in r]
         charts = [_line_chart(scores, "model score vs iteration")]
 
@@ -148,11 +185,24 @@ class UIServer:
             f"<td>{r['score']:.6f}</td><td>{ms(r)}</td></tr>"
             for r in recs[-25:] if isinstance(r.get("score"), (int, float))
         )
+        import html as _html
+        from urllib.parse import quote
+
         charts_html = "".join(f"<div>{c}</div>" for c in charts)
+        nav = ""
+        if sessions:
+            links = " | ".join(
+                (f"<b>{_html.escape(s)}</b>" if s == session else
+                 f'<a href="/train/session/{quote(s, safe="")}">'
+                 f"{_html.escape(s)}</a>")
+                for s in sessions)
+            nav = f"<p>sessions: {links}</p>"
+        title = (f"Training overview — {_html.escape(session)}"
+                 if session else "Training overview")
         return f"""<!doctype html><html><head><title>Training UI</title>
 <meta http-equiv="refresh" content="5"></head>
 <body style="font-family:sans-serif">
-<h2>Training overview</h2>{charts_html}
+<h2>{title}</h2>{nav}{charts_html}
 <h3>Recent iterations</h3>
 <table border=1 cellpadding=4>
 <tr><th>iter</th><th>epoch</th><th>score</th><th>ms</th></tr>{rows}</table>

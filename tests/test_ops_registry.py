@@ -390,3 +390,11 @@ class TestLongTailOps:
         np.testing.assert_array_equal(np.asarray(r), [3])  # 0b10000001 rotl 1
         r0 = ops.exec_op("cyclic_shift_bits", jnp.asarray([-5], jnp.int16), 16)
         np.testing.assert_array_equal(np.asarray(r0), [-5])  # full-width = id
+
+    def test_cyclic_shift_array_count_no_promotion(self):
+        """Array-valued counts wider than x must not widen the bit view
+        (review fix): output keeps x's shape and dtype."""
+        r = ops.exec_op("cyclic_shift_bits", jnp.asarray([1, 1], jnp.int16),
+                        jnp.asarray([1, 2], jnp.int32))
+        assert r.shape == (2,) and r.dtype == jnp.int16
+        np.testing.assert_array_equal(np.asarray(r), [2, 4])
