@@ -29,6 +29,7 @@ from deeplearning4j_tpu.datavec.records import (  # noqa: F401
 from deeplearning4j_tpu.datavec.transform import (  # noqa: F401
     ColumnType,
     Join,
+    Reducer,
     Schema,
     TransformProcess,
 )

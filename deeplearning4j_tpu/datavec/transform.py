@@ -362,6 +362,185 @@ class TransformProcess:
 
             return self._add("appendStr", rec, lambda s: s)
 
+        def replace_missing_value_with(self, name, value):
+            """ReplaceInvalidWithIntegerTransform/fillna parity: None or
+            empty-string cells become ``value``."""
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                if r[i] is None or r[i] == "":
+                    r[i] = value
+                return r
+
+            return self._add(f"fillna {name}", rec, lambda s: s)
+
+        def filter_invalid_values(self, *names):
+            """Drop records whose named numeric cells are None/''/NaN
+            (FilterInvalidValues parity)."""
+
+            def bad(v):
+                if v is None or v == "":
+                    return True
+                try:
+                    return math.isnan(float(v))
+                except (TypeError, ValueError):
+                    return True
+
+            def rec(r, schema):
+                return None if any(
+                    bad(r[schema.column_index(n)]) for n in names) else r
+
+            return self._add(f"filter_invalid{names}", rec, lambda s: s)
+
+        def add_constant_column(self, name, col_type: "ColumnType", value):
+            def rec(r, schema):
+                return list(r) + [value]
+
+            def sch(schema):
+                return Schema(schema.columns + [(name, col_type, None)])
+
+            return self._add(f"const {name}", rec, sch)
+
+        def duplicate_column(self, name, new_name):
+            def rec(r, schema):
+                return list(r) + [r[schema.column_index(name)]]
+
+            def sch(schema):
+                n, t, m = schema.columns[schema.column_index(name)]
+                return Schema(schema.columns + [(new_name, t, m)])
+
+            return self._add(f"dup {name}", rec, sch)
+
+        def integer_to_categorical(self, name, states):
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                v = int(r[i])
+                if not 0 <= v < len(states):
+                    raise ValueError(
+                        f"integer_to_categorical: value {v} out of range "
+                        f"for {len(states)} states in column {name!r}")
+                r[i] = states[v]
+                return r
+
+            def sch(schema):
+                return Schema([
+                    (n, ColumnType.Categorical if n == name else t,
+                     list(states) if n == name else m)
+                    for n, t, m in schema.columns
+                ])
+
+            return self._add(f"int2cat {name}", rec, sch)
+
+        def integer_math_op(self, name, op: str, value: int):
+            """IntegerMathOpTransform parity: Add/Subtract/Multiply/Divide/
+            Modulus/ScalarMin/ScalarMax. Divide/Modulus follow the
+            reference's JAVA semantics — truncation toward zero, remainder
+            keeping the dividend's sign — not Python floor division."""
+            fns = {"Add": lambda v: v + value,
+                   "Subtract": lambda v: v - value,
+                   "Multiply": lambda v: v * value,
+                   "Divide": lambda v: int(v / value),
+                   "Modulus": lambda v: int(math.fmod(v, value)),
+                   "ScalarMin": lambda v: min(v, value),
+                   "ScalarMax": lambda v: max(v, value)}
+            fn = fns[op]
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = fn(int(r[i]))
+                return r
+
+            return self._add(f"imath {op} {name}", rec, lambda s: s)
+
+        def change_case_string_transform(self, name, upper=False):
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = str(r[i]).upper() if upper else str(r[i]).lower()
+                return r
+
+            return self._add(f"case {name}", rec, lambda s: s)
+
+        def replace_string_transform(self, name, pattern, replacement,
+                                     regex=False):
+            """ReplaceStringTransform / RegexReplace parity."""
+            import re as _re
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = (_re.sub(pattern, replacement, str(r[i])) if regex
+                        else str(r[i]).replace(pattern, replacement))
+                return r
+
+            return self._add(f"replace {name}", rec, lambda s: s)
+
+        def map_string(self, name, fn: Callable[[str], str]):
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = fn(str(r[i]))
+                return r
+
+            return self._add(f"map_string {name}", rec, lambda s: s)
+
+        def normalize(self, name, min_value: float, max_value: float):
+            """Min-max scale to [0, 1] using the given statistics (DataVec's
+            Normalize.MinMax over a DataAnalysis)."""
+            span = max(max_value - min_value, 1e-12)
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = (float(r[i]) - min_value) / span
+                return r
+
+            return self._add(f"minmax {name}", rec, lambda s: s)
+
+        def standardize(self, name, mean: float, stdev: float):
+            """Z-score using given statistics (Normalize.Standardize)."""
+            sd = max(stdev, 1e-12)
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                r = list(r)
+                r[i] = (float(r[i]) - mean) / sd
+                return r
+
+            return self._add(f"standardize {name}", rec, lambda s: s)
+
+        def derive_column_from_time(self, name, field: str,
+                                    new_name: "Optional[str]" = None):
+            """DeriveColumnsFromTimeTransform parity: extract hour_of_day /
+            day_of_week / day_of_month / month / year from a Time column
+            (epoch milliseconds, UTC)."""
+            import datetime as _dt
+
+            getters = {
+                "hour_of_day": lambda d: d.hour,
+                "day_of_week": lambda d: d.weekday(),
+                "day_of_month": lambda d: d.day,
+                "month": lambda d: d.month,
+                "year": lambda d: d.year,
+            }
+            get = getters[field]
+            out = new_name or f"{name}_{field}"
+
+            def rec(r, schema):
+                i = schema.column_index(name)
+                d = _dt.datetime.fromtimestamp(int(r[i]) / 1000.0,
+                                               _dt.timezone.utc)
+                return list(r) + [get(d)]
+
+            def sch(schema):
+                return Schema(schema.columns + [(out, ColumnType.Integer,
+                                                 None)])
+
+            return self._add(f"time {field} {name}", rec, sch)
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self.schema, self.steps)
 
@@ -452,3 +631,122 @@ class Join:
                         row[li] = ki  # key values survive on the left side
                     out.append(row + [r[i] for i in self._r_keep])
         return out
+
+
+class Reducer:
+    """Group-by aggregation (org/datavec/api/transform/reduce/Reducer.java,
+    path-cite): records sharing the key column values collapse to one row
+    per group, non-key columns reduced by the configured op.
+
+    Ops: sum, mean, min, max, count, stdev, first, last, takefirst (alias
+    of first, as upstream).
+    """
+
+    _OPS = {
+        "sum": lambda vs: sum(float(v) for v in vs),
+        "mean": lambda vs: sum(float(v) for v in vs) / len(vs),
+        "min": lambda vs: min(float(v) for v in vs),
+        "max": lambda vs: max(float(v) for v in vs),
+        "count": lambda vs: len(vs),
+        "stdev": lambda vs: _stdev([float(v) for v in vs]),
+        "first": lambda vs: vs[0],
+        "takefirst": lambda vs: vs[0],
+        "last": lambda vs: vs[-1],
+    }
+    _NUMERIC = {"sum", "mean", "min", "max", "stdev"}
+
+    def __init__(self, schema: Schema, keys: List[str],
+                 default_op: str = "takefirst",
+                 column_ops: "Optional[dict]" = None):
+        self.schema = schema
+        self.keys = list(keys)
+        self.default_op = default_op.lower()
+        self.column_ops = {k: v.lower() for k, v in (column_ops or {}).items()}
+        for o in [self.default_op, *self.column_ops.values()]:
+            if o not in self._OPS:
+                raise ValueError(f"unknown reduce op {o!r}")
+
+    class Builder:
+        def __init__(self, schema: Schema, *keys: str):
+            self._schema = schema
+            self._keys = list(keys)
+            self._default = "takefirst"
+            self._ops: dict = {}
+
+        def default_op(self, op: str):
+            self._default = op
+            return self
+
+        def op(self, op: str, *names: str):
+            for n in names:
+                self._ops[n] = op
+            return self
+
+        # upstream spelling helpers
+        def sum_columns(self, *names):
+            return self.op("sum", *names)
+
+        def mean_columns(self, *names):
+            return self.op("mean", *names)
+
+        def min_columns(self, *names):
+            return self.op("min", *names)
+
+        def max_columns(self, *names):
+            return self.op("max", *names)
+
+        def count_columns(self, *names):
+            return self.op("count", *names)
+
+        def stdev_columns(self, *names):
+            return self.op("stdev", *names)
+
+        def build(self) -> "Reducer":
+            return Reducer(self._schema, self._keys, self._default,
+                           self._ops)
+
+    def output_schema(self) -> Schema:
+        cols = []
+        for n, t, m in self.schema.columns:
+            if n in self.keys:
+                cols.append((n, t, m))
+                continue
+            o = self.column_ops.get(n, self.default_op)
+            if o == "count":
+                cols.append((f"count({n})", ColumnType.Long, None))
+            elif o in self._NUMERIC:
+                cols.append((f"{o}({n})", ColumnType.Double, None))
+            else:
+                cols.append((n, t, m))
+        return Schema(cols)
+
+    def execute(self, records: Sequence[Sequence[Any]]) -> List[list]:
+        names = self.schema.column_names()
+        kidx = [self.schema.column_index(k) for k in self.keys]
+        groups: dict = {}
+        order = []
+        for r in records:
+            key = tuple(r[i] for i in kidx)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        out = []
+        for key in order:
+            rows = groups[key]
+            row = []
+            for i, n in enumerate(names):
+                if n in self.keys:
+                    row.append(rows[0][i])
+                    continue
+                o = self.column_ops.get(n, self.default_op)
+                row.append(self._OPS[o]([r[i] for r in rows]))
+            out.append(row)
+        return out
+
+
+def _stdev(vals):
+    if len(vals) < 2:
+        return 0.0
+    m = sum(vals) / len(vals)
+    return (sum((v - m) ** 2 for v in vals) / (len(vals) - 1)) ** 0.5

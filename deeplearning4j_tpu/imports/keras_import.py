@@ -68,10 +68,17 @@ def _pad(cfg):
 
 
 class KerasModelImport:
-    """KerasModelImport.java parity (HDF5 whole-model format)."""
+    """KerasModelImport.java parity. The reference reads the legacy HDF5
+    whole-model format; this importer additionally accepts the Keras v3
+    ``.keras`` zip (config.json + model.weights.h5) — the save default since
+    Keras 3, so modern exports import without a re-save."""
 
     @staticmethod
     def import_keras_model_and_weights(path: str):
+        import zipfile
+
+        if zipfile.is_zipfile(path):
+            return KerasModelImport._import_keras_v3(path)
         import h5py
 
         with h5py.File(path, "r") as f:
@@ -80,6 +87,44 @@ class KerasModelImport:
                 raw = raw.decode("utf-8")
             config = json.loads(raw)
             weights = _read_weights(f["model_weights"])
+        return _build(config, weights)
+
+    @staticmethod
+    def _import_keras_v3(path: str):
+        import io
+        import zipfile
+
+        import h5py
+
+        with zipfile.ZipFile(path) as z:
+            config = json.loads(z.read("config.json"))
+            with h5py.File(io.BytesIO(z.read("model.weights.h5")), "r") as f:
+                by_group = _read_weights_v3(f)
+        # v3 weight groups are per-class snake_case slugs with per-model
+        # occurrence suffixes ("dense", "dense_1", ...), NOT the config
+        # layer names — remap onto config names for _build's lookups
+        weights: Dict[str, List[np.ndarray]] = {}
+        counters: Dict[str, int] = {}
+        consumed = set()
+        for lc in config["config"]["layers"]:
+            cls = lc["class_name"]
+            if cls == "InputLayer":
+                continue
+            slug = _to_snake_case(cls)
+            k = counters.get(slug, 0)
+            counters[slug] = k + 1
+            group = slug if k == 0 else f"{slug}_{k}"
+            if group in by_group:
+                weights[lc["config"]["name"]] = by_group[group]
+                consumed.add(group)
+        # a group that matched NO config layer means the slug/counter
+        # reconstruction diverged from the store layout — fail loudly
+        # rather than importing an uninitialized model
+        unused = set(by_group) - consumed
+        if unused:
+            raise KerasImportError(
+                f".keras weight groups {sorted(unused)} did not match any "
+                "config layer (Keras weight-store layout drift?)")
         return _build(config, weights)
 
     # convenience alias matching the reference's Sequential entry point
@@ -116,6 +161,50 @@ def _read_weights(grp) -> Dict[str, List[np.ndarray]]:
 
             sub.visititems(visit)
             arrays = [a for _, _, a in sorted(found, key=lambda t: (t[0], t[1]))]
+        if arrays:
+            out[lname] = arrays
+    return out
+
+
+def _to_snake_case(name: str) -> str:
+    """Keras's class-name → slug rule (Conv2D→conv2d, PReLU→p_re_lu,
+    ConvLSTM2D→conv_lstm2d) — the naming the v3 weight store uses."""
+    import re
+
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def _read_weights_v3(f) -> Dict[str, List[np.ndarray]]:
+    """weight-group name → [arrays] from a Keras v3 model.weights.h5: each
+    layer group holds a ``vars`` subgroup with numerically-keyed datasets in
+    SAVE order (kernel=0, bias=1, ...); nested wrapper layers
+    (Bidirectional, TimeDistributed, RNN cells) hold their sublayers'
+    groups, collected depth-first — forward before backward, matching the
+    legacy weight order the builders expect."""
+    import h5py
+
+    out: Dict[str, List[np.ndarray]] = {}
+    layers = f.get("layers")
+    if layers is None:
+        return out
+
+    def collect(grp) -> List[np.ndarray]:
+        arrays: List[np.ndarray] = []
+        vars_grp = grp.get("vars")
+        if isinstance(vars_grp, h5py.Group):
+            for k in sorted(vars_grp, key=lambda s: (len(s), s)):
+                arrays.append(np.asarray(vars_grp[k]))
+        children = [k for k in grp
+                    if k != "vars" and isinstance(grp[k], h5py.Group)]
+        children.sort(key=lambda s: (s == "backward_layer", s))
+        for k in children:
+            arrays.extend(collect(grp[k]))
+        return arrays
+
+    for lname in layers:
+        arrays = collect(layers[lname])
         if arrays:
             out[lname] = arrays
     return out

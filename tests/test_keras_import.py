@@ -399,3 +399,61 @@ def test_leaky_relu_and_noise_layers(rng, tmp_path):
     ])
     x = rng.normal(size=(4, 6)).astype(np.float32)
     _roundtrip(m, x, tmp_path)
+
+
+def _roundtrip_v3(model, x, tmp_path, atol=1e-5):
+    """Same as _roundtrip but through the Keras v3 .keras zip format."""
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    golden = np.asarray(model(x))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, golden, atol=atol, rtol=1e-4)
+    return net
+
+
+def test_keras_v3_format_mlp(rng, tmp_path):
+    """Keras v3 .keras zip (the modern default save format — beyond the
+    reference's HDF5-only importer)."""
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(4, activation="softmax"),
+    ])
+    x = rng.normal(size=(5, 6)).astype(np.float32)
+    _roundtrip_v3(m, x, tmp_path)
+
+
+def test_keras_v3_format_cnn_bn(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 10, 2)),
+        tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(3),
+    ])
+    m.compile("sgd", "mse")
+    xs = rng.normal(size=(8, 10, 10, 2)).astype(np.float32)
+    m.fit(xs, rng.normal(size=(8, 3)).astype(np.float32), epochs=1, verbose=0)
+    x = rng.normal(size=(2, 10, 10, 2)).astype(np.float32)
+    _roundtrip_v3(m, x, tmp_path, atol=1e-4)
+
+
+def test_keras_v3_format_lstm(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.LSTM(6, return_sequences=True),
+    ])
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    _roundtrip_v3(m, x, tmp_path)
+
+
+def test_keras_v3_format_bidirectional(rng, tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 4)),
+        tf.keras.layers.Bidirectional(
+            tf.keras.layers.LSTM(3, return_sequences=True)),
+    ])
+    x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+    _roundtrip_v3(m, x, tmp_path)
