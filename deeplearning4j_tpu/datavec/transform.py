@@ -369,7 +369,11 @@ class TransformProcess:
             def rec(r, schema):
                 i = schema.column_index(name)
                 r = list(r)
-                if r[i] is None or r[i] == "":
+                v = r[i]
+                missing = v is None or v == ""
+                if not missing and isinstance(v, float):
+                    missing = math.isnan(v)  # same rule filter_invalid uses
+                if missing:
                     r[i] = value
                 return r
 
@@ -438,11 +442,17 @@ class TransformProcess:
             Modulus/ScalarMin/ScalarMax. Divide/Modulus follow the
             reference's JAVA semantics — truncation toward zero, remainder
             keeping the dividend's sign — not Python floor division."""
+            def trunc_div(v):
+                # exact integer truncation toward zero (no float64 detour —
+                # Long-range values stay exact)
+                q = abs(v) // abs(value)
+                return q if (v < 0) == (value < 0) else -q
+
             fns = {"Add": lambda v: v + value,
                    "Subtract": lambda v: v - value,
                    "Multiply": lambda v: v * value,
-                   "Divide": lambda v: int(v / value),
-                   "Modulus": lambda v: int(math.fmod(v, value)),
+                   "Divide": trunc_div,
+                   "Modulus": lambda v: v - trunc_div(v) * value,
                    "ScalarMin": lambda v: min(v, value),
                    "ScalarMax": lambda v: max(v, value)}
             fn = fns[op]

@@ -574,3 +574,389 @@ def _o_simple_rnn(m, node):
     y, yh = m.sd._op("rnn_layer", [x, W, R, b, seq_lens, h0],
                      attrs=attrs, n_out=2, name=node.name or "rnn")
     _o_rnn_set_outputs(m, node, (y, yh))
+
+
+# ------------------------------------------------------------- round-3 tail
+# Breadth beyond the r2 set (samediff-import-onnx rule files, path-cite):
+# shape/indexing, remaining reductions, ConvTranspose/InstanceNorm/Resize,
+# and the elementwise stragglers common in exported vision/NLP models.
+
+
+@orule("Slice")
+def _o_slice(m, node):
+    x = m.get(node.inputs[0])
+    starts = [int(v) for v in m.const(node.inputs[1])]
+    ends = [int(v) for v in m.const(node.inputs[2])]
+    axes = ([int(v) for v in m.const(node.inputs[3])]
+            if m.has_input(node, 3) else list(range(len(starts))))
+    steps = ([int(v) for v in m.const(node.inputs[4])]
+             if m.has_input(node, 4) else [1] * len(starts))
+    if x.shape is not None:
+        nd = len(x.shape)
+    else:
+        if any(a < 0 for a in axes):
+            raise NotImplementedError(
+                "Slice with negative axes on an unknown-rank input")
+        nd = max(axes) + 1
+    spec = [("s", None, None, None)] * nd
+    BIG = 2**31 - 1
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        # INT_MIN/INT_MAX are ONNX's "to the end" sentinels in either
+        # direction; map them to open slice bounds
+        s_ = None if abs(s) >= BIG else s
+        e_ = None if abs(e) >= BIG else e
+        spec[a % nd] = ("s", s_, e_, st)
+    m.set(node.outputs[0], m.sd._op("getitem", [x],
+                                    attrs=dict(spec=tuple(spec)),
+                                    name=node.outputs[0]))
+
+
+@orule("Split")
+def _o_split(m, node):
+    x = m.get(node.inputs[0])
+    axis = int(node.attr("axis", 0))
+    sizes = node.attr("split")
+    if sizes is None and m.has_input(node, 1):
+        sizes = [int(v) for v in m.const(node.inputs[1])]
+    if sizes is None:
+        outs = m.sd.math.split(x, num_or_sections=len(node.outputs),
+                               axis=axis)
+    else:
+        outs = m.sd._op("split_v", [x], attrs=dict(sizes=tuple(sizes),
+                                                   axis=axis),
+                        n_out=len(node.outputs))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+    for o, v in zip(node.outputs, outs):
+        m.set(o, v)
+
+
+@orule("Pad")
+def _o_pad(m, node):
+    x = m.get(node.inputs[0])
+    mode = node.attr("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    pads = [int(v) for v in (m.const(node.inputs[1])
+                             if m.has_input(node, 1)
+                             else node.attr("pads"))]
+    n = len(pads) // 2
+    per_axis = [(pads[i], pads[i + n]) for i in range(n)]
+    if m.has_input(node, 3):  # opset-18 axes: pads cover only these axes
+        if x.shape is None:
+            raise NotImplementedError("Pad with axes on unknown-rank input")
+        axes = [int(a) % len(x.shape) for a in m.const(node.inputs[3])]
+        full = [(0, 0)] * len(x.shape)
+        for a, p in zip(axes, per_axis):
+            full[a] = p
+        per_axis = full
+    elif x.shape is not None and n != len(x.shape):
+        raise NotImplementedError(
+            f"Pad pads cover {n} axes but input has {len(x.shape)}")
+    paddings = tuple(per_axis)
+    cv = (float(np.asarray(m.const(node.inputs[2])))
+          if m.has_input(node, 2) else 0.0)
+    attrs = dict(paddings=paddings)
+    if mode == "constant":
+        attrs["constant_value"] = cv
+    else:
+        attrs["mode"] = {"reflect": "reflect", "edge": "edge"}[mode]
+    m.set(node.outputs[0], m.sd._op("pad", [x], attrs=attrs,
+                                    name=node.outputs[0]))
+
+
+@orule("Tile")
+def _o_tile(m, node):
+    x = m.get(node.inputs[0])
+    reps = tuple(int(v) for v in m.const(node.inputs[1]))
+    m.set(node.outputs[0], m.sd._op("tile", [x], attrs=dict(reps=reps),
+                                    name=node.outputs[0]))
+
+
+@orule("Expand")
+def _o_expand(m, node):
+    x = m.get(node.inputs[0])
+    shape = [int(v) for v in m.const(node.inputs[1])]
+    # ONNX Expand: dim value 1 broadcasts; other values must match or x is 1
+    xs = x.shape
+    if xs is not None and len(xs) == len(shape):
+        shape = [int(a) if s in (1, -1) and a not in (None, -1) else int(s)
+                 for s, a in zip(shape, xs)]
+    m.set(node.outputs[0], m.sd._op("broadcast_to", [x],
+                                    attrs=dict(shape=tuple(shape)),
+                                    name=node.outputs[0]))
+
+
+@orule("ConstantOfShape")
+def _o_const_of_shape(m, node):
+    shape = tuple(int(v) for v in m.const(node.inputs[0]))
+    val = node.attr("value")
+    v = float(np.asarray(val).reshape(-1)[0]) if val is not None else 0.0
+    dt = np.asarray(val).dtype if val is not None else np.float32
+    arr = np.full(shape, v, dtype=dt)
+    m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
+          const_val=arr)
+
+
+@orule("Range")
+def _o_range(m, node):
+    s, l, d = (np.asarray(m.const(i)).item() for i in node.inputs[:3])
+    arr = np.arange(s, l, d)
+    m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
+          const_val=arr)
+
+
+@orule("ArgMax", "ArgMin")
+def _o_argminmax(m, node):
+    opname = "argmax" if node.op_type == "ArgMax" else "argmin"
+    x = m.get(node.inputs[0])
+    axis = int(node.attr("axis", 0))
+    kd = bool(node.attr("keepdims", 1))
+    y = m.sd._op(opname, [x], attrs=dict(axis=axis))
+    if kd:
+        y = m.sd._op("expand_dims", [y], attrs=dict(axis=axis))
+    m.set(node.outputs[0], m.sd._op("identity", [y], name=node.outputs[0]))
+
+
+@orule("ReduceProd", "ReduceL2")
+def _o_reduce2(m, node):
+    x = m.get(node.inputs[0])
+    axes = node.attr("axes")
+    if axes is None and m.has_input(node, 1):
+        axes = [int(a) for a in m.const(node.inputs[1])]
+    kd = bool(node.attr("keepdims", 1))
+    attrs = dict(keepdims=kd)
+    if axes:
+        attrs["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
+    opname = "prod" if node.op_type == "ReduceProd" else "norm2"
+    m.set(node.outputs[0], m.sd._op(opname, [x], attrs=attrs,
+                                    name=node.outputs[0]))
+
+
+@orule("CumSum")
+def _o_cumsum(m, node):
+    x = m.get(node.inputs[0])
+    axis = int(np.asarray(m.const(node.inputs[1])))
+    if node.attr("exclusive", 0) or node.attr("reverse", 0):
+        raise NotImplementedError("CumSum exclusive/reverse")
+    m.set(node.outputs[0], m.sd._op("cumsum", [x], attrs=dict(axis=axis),
+                                    name=node.outputs[0]))
+
+
+@orule("PRelu")
+def _o_prelu(m, node):
+    x, slope = m.get(node.inputs[0]), m.get(node.inputs[1])
+    m.set(node.outputs[0], m.sd._op("prelu", [x, slope],
+                                    name=node.outputs[0]))
+
+
+@orule("Elu")
+def _o_elu(m, node):
+    if node.attr("alpha", 1.0) != 1.0:
+        raise NotImplementedError("Elu alpha != 1")
+    m.set(node.outputs[0], m.sd._op("elu", [m.get(node.inputs[0])],
+                                    name=node.outputs[0]))
+
+
+@orule("GlobalMaxPool")
+def _o_gmp(m, node):
+    x = m.get(node.inputs[0])
+    m.set(node.outputs[0], m.sd._op("max", [x], attrs=dict(
+        axis=(2, 3), keepdims=True), name=node.outputs[0]))
+
+
+@orule("ConvTranspose")
+def _o_conv_transpose(m, node):
+    x, w = m.get(node.inputs[0]), m.get(node.inputs[1])
+    strides = tuple(node.attr("strides", [1, 1]))
+    pads = node.attr("pads", [0, 0, 0, 0])
+    if node.attr("dilations", [1, 1]) != [1, 1]:
+        raise NotImplementedError("ConvTranspose dilations")
+    if node.attr("group", 1) != 1:
+        raise NotImplementedError("ConvTranspose groups")
+    if node.attr("output_padding") or node.attr("output_shape"):
+        raise NotImplementedError("ConvTranspose output_padding/output_shape")
+    auto_pad = node.attr("auto_pad", "NOTSET")
+    if isinstance(auto_pad, bytes):
+        auto_pad = auto_pad.decode()
+    kshape = node.attr("kernel_shape")
+    if kshape is None and w.shape is not None:
+        kshape = w.shape[2:4]
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    elif all(p == 0 for p in pads):
+        padding = "VALID"
+    elif pads[0] == pads[2] and pads[1] == pads[3]:
+        # ONNX/torch pads p mean "crop p from the full deconv output"; the
+        # underlying dilated conv needs k-1-p explicit padding per side
+        # (verified vs torch: k=4, s=2, p=1 → padding 2)
+        if kshape is None:
+            raise NotImplementedError(
+                "ConvTranspose pads without a known kernel shape")
+        kh, kw = int(kshape[0]), int(kshape[1])
+        if kh - 1 - pads[0] < 0 or kw - 1 - pads[1] < 0:
+            raise NotImplementedError("ConvTranspose pads > kernel-1")
+        padding = (kh - 1 - pads[0], kw - 1 - pads[1])  # symmetric pairs
+    else:
+        raise NotImplementedError("ConvTranspose asymmetric pads")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    # ONNX ConvTranspose weights are IOHW (I = x's channels); deconv2d's
+    # HWIO spec wants that same I in slot 2 → axes (2, 3, 0, 1). ONNX (like
+    # torch) defines the op as the GRADIENT of conv — spatially flipped
+    # relative to deconv2d's fractionally-strided convolution — so flip H/W.
+    wh = m.sd._op("permute", [w], attrs=dict(axes=(2, 3, 0, 1)))
+    wh = m.sd._op("flip", [wh], attrs=dict(axis=(0, 1)))
+    ins = [xh, wh]
+    if m.has_input(node, 2):
+        ins.append(m.get(node.inputs[2]))
+    y = m.sd._op("deconv2d", ins, attrs=dict(strides=strides,
+                                             padding=padding))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+@orule("InstanceNormalization")
+def _o_instancenorm(m, node):
+    x, gamma, beta = (m.get(i) for i in node.inputs[:3])
+    eps = node.attr("epsilon", 1e-5)
+
+    def inorm(xv, g, b):
+        import jax.numpy as jnp
+
+        axes = tuple(range(2, xv.ndim))
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        shape = (1, -1) + (1,) * (xv.ndim - 2)
+        return ((xv - mu) / jnp.sqrt(var + eps) * g.reshape(shape)
+                + b.reshape(shape))
+
+    m.set(node.outputs[0], m.sd.custom_op(inorm, x, gamma, beta,
+                                          name=node.outputs[0]))
+
+
+@orule("DepthToSpace")
+def _o_d2s(m, node):
+    x = m.get(node.inputs[0])
+    bs = int(node.attr("blocksize"))
+    mode = node.attr("mode", "DCR")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if mode != "DCR":
+        # our depth_to_space decomposes channels as (b, b, C') — ONNX DCR
+        raise NotImplementedError("DepthToSpace CRD mode")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    y = m.sd._op("depth_to_space", [xh], attrs=dict(block_size=bs))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+@orule("SpaceToDepth")
+def _o_s2d(m, node):
+    x = m.get(node.inputs[0])
+    bs = int(node.attr("blocksize"))
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    y = m.sd._op("space_to_depth", [xh], attrs=dict(block_size=bs))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+@orule("TopK")
+def _o_topk(m, node):
+    x = m.get(node.inputs[0])
+    k = int(np.asarray(m.const(node.inputs[1])))
+    if int(node.attr("axis", -1)) not in (-1, len(x.shape or []) - 1):
+        raise NotImplementedError("TopK on a non-last axis")
+    if not node.attr("largest", 1):
+        raise NotImplementedError("TopK largest=0")
+    vals, idx = m.sd._op("top_k", [x], attrs=dict(k=k), n_out=2,
+                         name=node.name or "topk")
+    m.set(node.outputs[0], vals)
+    if len(node.outputs) > 1:
+        m.set(node.outputs[1], idx)
+
+
+@orule("GatherElements")
+def _o_gather_elements(m, node):
+    x, idx = m.get(node.inputs[0]), m.get(node.inputs[1])
+    axis = int(node.attr("axis", 0))
+    m.set(node.outputs[0], m.sd._op("take_along_axis", [x, idx],
+                                    attrs=dict(axis=axis),
+                                    name=node.outputs[0]))
+
+
+@orule("ScatterND")
+def _o_scatternd(m, node):
+    x, idx, upd = (m.get(i) for i in node.inputs[:3])
+    m.set(node.outputs[0], m.sd._op("tensor_scatter_update", [x, idx, upd],
+                                    name=node.outputs[0]))
+
+
+@orule("OneHot")
+def _o_onehot(m, node):
+    idx = m.get(node.inputs[0])
+    depth = int(np.asarray(m.const(node.inputs[1])))
+    vals = np.asarray(m.const(node.inputs[2]))  # [off, on]
+    axis = int(node.attr("axis", -1))
+    m.set(node.outputs[0], m.sd._op(
+        "onehot", [idx], attrs=dict(depth=depth, on_value=float(vals[1]),
+                                    off_value=float(vals[0]), axis=axis),
+        name=node.outputs[0]))
+
+
+@orule("Trilu")
+def _o_trilu(m, node):
+    x = m.get(node.inputs[0])
+    k = (int(np.asarray(m.const(node.inputs[1])))
+         if m.has_input(node, 1) else 0)
+    upper = bool(node.attr("upper", 1))
+
+    def trilu(xv):
+        import jax.numpy as jnp
+
+        return jnp.triu(xv, k) if upper else jnp.tril(xv, k)
+
+    m.set(node.outputs[0], m.sd.custom_op(trilu, x, name=node.outputs[0]))
+
+
+@orule("Resize")
+def _o_resize(m, node):
+    x = m.get(node.inputs[0])
+    mode = node.attr("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    method = {"nearest": "nearest", "linear": "bilinear"}.get(mode)
+    if method is None:
+        raise NotImplementedError(f"Resize mode {mode!r}")
+    ctm = node.attr("coordinate_transformation_mode", "half_pixel")
+    if isinstance(ctm, bytes):
+        ctm = ctm.decode()
+    if ctm not in ("half_pixel", "asymmetric"):
+        # align_corners / pytorch_half_pixel etc. shift sampling points —
+        # importing them through jax's half-pixel resize would be silently
+        # wrong at non-integer scales
+        raise NotImplementedError(
+            f"Resize coordinate_transformation_mode {ctm!r}")
+    nm = node.attr("nearest_mode")
+    if nm is not None and (nm.decode() if isinstance(nm, bytes) else nm) \
+            != "round_prefer_floor":
+        raise NotImplementedError("Resize non-default nearest_mode")
+    shp = x.shape
+    if shp is None or any(s is None or s < 0 for s in shp[2:]):
+        raise NotImplementedError("Resize with unknown spatial dims")
+    if m.has_input(node, 3):  # sizes given directly
+        sizes = [int(v) for v in m.const(node.inputs[3])]
+        out_hw = tuple(sizes[2:])
+    elif m.has_input(node, 2):
+        scales = [float(v) for v in m.const(node.inputs[2])]
+        out_hw = tuple(int(round(s * f)) for s, f in zip(shp[2:], scales[2:]))
+    else:
+        raise NotImplementedError("Resize without scales or sizes")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    y = m.sd._op("image_resize", [xh], attrs=dict(size=out_hw,
+                                                  method=method))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))

@@ -385,3 +385,19 @@ def test_int_to_categorical_range_checked():
           .integer_to_categorical("age", ["a", "b"]).build())
     with pytest.raises(ValueError, match="out of range"):
         tp.execute([["x", -1]])
+
+
+def test_int_math_exact_above_2_53():
+    """No float64 detour: Long-range values divide exactly (review fix)."""
+    big = 2**53 + 1
+    tp = (TransformProcess.builder(_int_schema())
+          .integer_math_op("age", "Divide", 1).build())
+    assert tp.execute([["a", big]])[0][1] == big
+
+
+def test_fillna_covers_nan():
+    schema = (Schema.builder().add_column_string("n")
+              .add_column_double("v").build())
+    tp = (TransformProcess.builder(schema)
+          .replace_missing_value_with("v", 0.0).build())
+    assert tp.execute([["a", float("nan")]])[0][1] == 0.0

@@ -926,3 +926,189 @@ class TestTFBatchNormTraining:
             (xs.mean(axis=(1, 2, 3)) > 0).astype(int)]
         hist = sd.fit((xs, ys), epochs=30)
         assert hist[-1] < hist[0] * 0.6, (hist[0], hist[-1])
+
+
+def _onnx_attr_s(name, v):
+    return pm.f_str(1, name) + pm.f_bytes(4, v.encode()) + pm.f_varint(20, 3)
+
+
+class TestOnnxRound3Rules:
+    """Round-3 ONNX breadth (93 rules): shape/indexing, ConvTranspose,
+    InstanceNorm, Resize, reductions — goldens vs torch/numpy."""
+
+    def test_slice_pad_tile_expand(self, rng):
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("Slice", ["x", "st", "en", "ax", "sp"], ["s"]),
+                _onnx_node("Pad", ["s", "pads"], ["p"]),
+                _onnx_node("Tile", ["p", "reps"], ["t"]),
+                _onnx_node("Expand", ["t", "eshape"], ["e"]),
+            ],
+            initializers=[
+                _onnx_tensor("st", np.asarray([1], np.int64)),
+                _onnx_tensor("en", np.asarray([5], np.int64)),
+                _onnx_tensor("ax", np.asarray([1], np.int64)),
+                _onnx_tensor("sp", np.asarray([2], np.int64)),
+                _onnx_tensor("pads", np.asarray([0, 1, 0, 1], np.int64)),
+                _onnx_tensor("reps", np.asarray([2, 1], np.int64)),
+                _onnx_tensor("eshape", np.asarray([4, 4], np.int64)),
+            ],
+            inputs=[_onnx_input("x", (2, 6))], outputs=["e"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["e"])["e"])
+        ref = x[:, 1:5:2]
+        ref = np.pad(ref, [(0, 0), (1, 1)])
+        ref = np.tile(ref, (2, 1))
+        ref = np.broadcast_to(ref, (4, 4))
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_split_argmax_cumsum_onehot(self, rng):
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("Split", ["x"], ["a", "b"], _onnx_attr_i("axis", 1),
+                           _onnx_attr_ints("split", [2, 2])),
+                _onnx_node("ArgMax", ["a"], ["am"], _onnx_attr_i("axis", 1),
+                           _onnx_attr_i("keepdims", 0)),
+                _onnx_node("OneHot", ["am", "depth", "vals"], ["oh"]),
+                _onnx_node("CumSum", ["b", "cax"], ["cs"]),
+            ],
+            initializers=[
+                _onnx_tensor("depth", np.asarray([2], np.int64)),
+                _onnx_tensor("vals", np.asarray([0.0, 1.0], np.float32)),
+                _onnx_tensor("cax", np.asarray([1], np.int64)),
+            ],
+            inputs=[_onnx_input("x", (3, 4))], outputs=["oh", "cs"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        res = sd.output({"x": x}, [sd.tf_name_map.get("oh", "oh")
+                                   if hasattr(sd, "tf_name_map") else "oh",
+                                   "cs"])
+        a, b = x[:, :2], x[:, 2:]
+        np.testing.assert_allclose(np.asarray(res["oh"]),
+                                   np.eye(2)[a.argmax(1)], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(res["cs"]),
+                                   np.cumsum(b, axis=1), atol=1e-5)
+
+    def test_conv_transpose_matches_torch(self, rng):
+        import torch
+        import torch.nn.functional as F
+
+        w = rng.normal(size=(2, 3, 2, 2)).astype(np.float32) * 0.4  # IOHW
+        model = _onnx_model(
+            nodes=[_onnx_node("ConvTranspose", ["x", "w"], ["y"],
+                              _onnx_attr_ints("strides", [2, 2]),
+                              _onnx_attr_ints("kernel_shape", [2, 2]))],
+            initializers=[_onnx_tensor("w", w)],
+            inputs=[_onnx_input("x", (1, 2, 4, 4))], outputs=["y"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                                 stride=2).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_instance_norm_matches_torch(self, rng):
+        import torch
+        import torch.nn.functional as F
+
+        g = (rng.normal(size=3) * 0.3 + 1).astype(np.float32)
+        b = rng.normal(size=3).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("InstanceNormalization", ["x", "g", "b"], ["y"],
+                              _onnx_attr_f("epsilon", 1e-5))],
+            initializers=[_onnx_tensor("g", g), _onnx_tensor("b", b)],
+            inputs=[_onnx_input("x", (2, 3, 5, 5))], outputs=["y"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(2, 3, 5, 5)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        ref = F.instance_norm(torch.from_numpy(x),
+                              weight=torch.from_numpy(g),
+                              bias=torch.from_numpy(b), eps=1e-5).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_depth_space_roundtrip_and_resize(self, rng):
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("SpaceToDepth", ["x"], ["s"],
+                           _onnx_attr_i("blocksize", 2)),
+                _onnx_node("DepthToSpace", ["s"], ["d"],
+                           _onnx_attr_i("blocksize", 2),
+                           _onnx_attr_s("mode", "DCR")),
+                _onnx_node("Resize", ["d", "", "scales"], ["r"],
+                           _onnx_attr_s("mode", "nearest")),
+            ],
+            initializers=[_onnx_tensor(
+                "scales", np.asarray([1.0, 1.0, 2.0, 2.0], np.float32))],
+            inputs=[_onnx_input("x", (1, 2, 4, 4))], outputs=["r"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["r"])["r"])
+        ref = np.repeat(np.repeat(x, 2, axis=2), 2, axis=3)  # s2d∘d2s = id
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_topk_gather_elements_scatternd(self, rng):
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("TopK", ["x", "k"], ["v", "i"]),
+                _onnx_node("GatherElements", ["x", "i"], ["g"],
+                           _onnx_attr_i("axis", 1)),
+            ],
+            initializers=[_onnx_tensor("k", np.asarray([2], np.int64))],
+            inputs=[_onnx_input("x", (3, 5))], outputs=["v", "g"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(3, 5)).astype(np.float32)
+        res = sd.output({"x": x}, ["v", "g"])
+        want = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(np.asarray(res["v"]), want, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(res["g"]), want, atol=1e-6)
+
+    def test_conv_transpose_with_padding_matches_torch(self, rng):
+        """DCGAN shape: k=4 s=2 p=1 — the ONNX pads→(k-1-p) mapping
+        (review fix; direct pads pass-through only coincides at p=(k-1)/2)."""
+        import torch
+        import torch.nn.functional as F
+
+        w = rng.normal(size=(2, 3, 4, 4)).astype(np.float32) * 0.3
+        model = _onnx_model(
+            nodes=[_onnx_node("ConvTranspose", ["x", "w"], ["y"],
+                              _onnx_attr_ints("strides", [2, 2]),
+                              _onnx_attr_ints("pads", [1, 1, 1, 1]),
+                              _onnx_attr_ints("kernel_shape", [4, 4]))],
+            initializers=[_onnx_tensor("w", w)],
+            inputs=[_onnx_input("x", (1, 2, 4, 4))], outputs=["y"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                                 stride=2, padding=1).numpy()
+        assert out.shape == ref.shape == (1, 3, 8, 8)
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_pad_with_axes_input(self, rng):
+        """Opset-18 Pad axes: pads cover only the named axes (review fix)."""
+        model = _onnx_model(
+            nodes=[_onnx_node("Pad", ["x", "pads", "", "axes"], ["y"])],
+            initializers=[
+                _onnx_tensor("pads", np.asarray([1, 1], np.int64)),
+                _onnx_tensor("axes", np.asarray([1], np.int64)),
+            ],
+            inputs=[_onnx_input("x", (2, 3))], outputs=["y"])
+        sd = import_onnx(model)
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        assert out.shape == (2, 5)  # axis 0 untouched
+        np.testing.assert_allclose(out, np.pad(x, [(0, 0), (1, 1)]),
+                                   atol=1e-6)
+
+    def test_resize_rejects_align_corners(self, rng):
+        model = _onnx_model(
+            nodes=[_onnx_node("Resize", ["x", "", "scales"], ["y"],
+                              _onnx_attr_s("mode", "linear"),
+                              _onnx_attr_s("coordinate_transformation_mode",
+                                           "align_corners"))],
+            initializers=[_onnx_tensor(
+                "scales", np.asarray([1.0, 1.0, 2.0, 2.0], np.float32))],
+            inputs=[_onnx_input("x", (1, 2, 4, 4))], outputs=["y"])
+        with pytest.raises(NotImplementedError, match="align_corners"):
+            import_onnx(model)
