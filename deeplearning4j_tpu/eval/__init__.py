@@ -8,6 +8,7 @@ stays on device).
 """
 
 from deeplearning4j_tpu.eval.classification import (  # noqa: F401
+    EvaluationBinary,
     Evaluation,
     EvaluationCalibration,
     ROC,

@@ -229,3 +229,84 @@ class EvaluationCalibration:
 
     def probability_histogram(self):
         return self._prob_hist.copy()
+
+
+class EvaluationBinary:
+    """Per-output binary metrics on multi-label sigmoid outputs
+    (org/nd4j/evaluation/classification/EvaluationBinary.java, path-cite).
+
+    Labels/predictions are [batch, n_outputs] with independent {0,1} labels
+    per column; an optional (batch, n_outputs) mask excludes entries."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def _ensure(self, n: int):
+        if self.tp is None:
+            self.tp = np.zeros(n)
+            self.fp = np.zeros(n)
+            self.tn = np.zeros(n)
+            self.fn = np.zeros(n)
+        elif len(self.tp) != n:
+            raise ValueError(
+                f"EvaluationBinary was accumulated with {len(self.tp)} "
+                f"outputs; this batch has {n}")
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            preds = preds.reshape(labels.shape)
+        elif preds.shape != labels.shape:
+            raise ValueError(
+                f"predictions shape {preds.shape} != labels shape "
+                f"{labels.shape}")
+        self._ensure(labels.shape[1])
+        pos = preds >= self.threshold
+        lab = labels >= 0.5
+        w = np.ones_like(labels, dtype=np.float64) if mask is None \
+            else np.asarray(mask, dtype=np.float64).reshape(labels.shape)
+        self.tp += np.sum(w * (pos & lab), axis=0)
+        self.fp += np.sum(w * (pos & ~lab), axis=0)
+        self.tn += np.sum(w * (~pos & ~lab), axis=0)
+        self.fn += np.sum(w * (~pos & lab), axis=0)
+        return self
+
+    def num_outputs(self) -> int:
+        if self.tp is None:
+            raise ValueError("no data: call eval() first")
+        return len(self.tp)
+
+    def accuracy(self, i: int) -> float:
+        self.num_outputs()  # no-data guard
+        t = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / t) if t else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def average_accuracy(self) -> float:
+        return float(np.mean([self.accuracy(i)
+                              for i in range(self.num_outputs())]))
+
+    def average_f1(self) -> float:
+        return float(np.mean([self.f1(i) for i in range(self.num_outputs())]))
+
+    def stats(self) -> str:
+        rows = [f"  out {i}: acc={self.accuracy(i):.4f} "
+                f"precision={self.precision(i):.4f} "
+                f"recall={self.recall(i):.4f} f1={self.f1(i):.4f}"
+                for i in range(self.num_outputs())]
+        return "EvaluationBinary ({} outputs)\n{}".format(
+            self.num_outputs(), "\n".join(rows))

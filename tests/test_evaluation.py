@@ -80,3 +80,42 @@ def test_roc_accepts_onehot_labels():
     roc = ROC()
     roc.eval(np.eye(2)[[0, 0, 1, 1]], np.array([0.1, 0.2, 0.8, 0.9]))
     assert roc.calculate_auc() == pytest.approx(1.0)
+
+
+class TestEvaluationBinary:
+    def test_per_output_counts_and_stats(self):
+        from deeplearning4j_tpu.eval import EvaluationBinary
+
+        eb = EvaluationBinary()
+        labels = np.asarray([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+        preds = np.asarray([[0.9, 0.2], [0.4, 0.8], [0.1, 0.7], [0.6, 0.9]],
+                           np.float32)
+        eb.eval(labels, preds)
+        # output 0: tp=1 (row0), fn=1 (row1), tn=1 (row2), fp=1 (row3)
+        assert (eb.tp[0], eb.fp[0], eb.tn[0], eb.fn[0]) == (1, 1, 1, 1)
+        assert eb.accuracy(0) == 0.5
+        # output 1: tp=2 (rows 1,3), fp=1 (row2), tn=1 (row0), fn=0
+        assert eb.precision(1) == 2 / 3 and eb.recall(1) == 1.0
+        assert "EvaluationBinary (2 outputs)" in eb.stats()
+
+    def test_mask_excludes_entries(self):
+        from deeplearning4j_tpu.eval import EvaluationBinary
+
+        eb = EvaluationBinary()
+        labels = np.asarray([[1], [0]], np.float32)
+        preds = np.asarray([[0.9], [0.9]], np.float32)
+        eb.eval(labels, preds, mask=np.asarray([[1], [0]], np.float32))
+        assert eb.fp[0] == 0  # the wrong row was masked out
+        assert eb.accuracy(0) == 1.0
+
+    def test_shape_and_no_data_guards(self):
+        from deeplearning4j_tpu.eval import EvaluationBinary
+
+        with pytest.raises(ValueError, match="no data"):
+            EvaluationBinary().accuracy(0)
+        eb = EvaluationBinary()
+        with pytest.raises(ValueError, match="shape"):
+            eb.eval(np.zeros((4, 2)), np.zeros((2, 4)))
+        eb.eval(np.zeros((4, 2)), np.zeros((4, 2)))
+        with pytest.raises(ValueError, match="outputs"):
+            eb.eval(np.zeros((4, 3)), np.zeros((4, 3)))
