@@ -258,6 +258,10 @@ class EvaluationBinary:
         preds = np.asarray(predictions)
         if labels.ndim == 1:
             labels = labels[:, None]
+            if preds.shape not in ((labels.shape[0],), labels.shape):
+                raise ValueError(
+                    f"predictions shape {preds.shape} != labels shape "
+                    f"({labels.shape[0]},)")
             preds = preds.reshape(labels.shape)
         elif preds.shape != labels.shape:
             raise ValueError(
@@ -285,10 +289,12 @@ class EvaluationBinary:
         return float((self.tp[i] + self.tn[i]) / t) if t else 0.0
 
     def precision(self, i: int) -> float:
+        self.num_outputs()  # no-data guard
         d = self.tp[i] + self.fp[i]
         return float(self.tp[i] / d) if d else 0.0
 
     def recall(self, i: int) -> float:
+        self.num_outputs()  # no-data guard
         d = self.tp[i] + self.fn[i]
         return float(self.tp[i] / d) if d else 0.0
 

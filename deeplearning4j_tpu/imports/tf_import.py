@@ -252,6 +252,18 @@ def _batch_matmul(m, node):
         name=node.name))
 
 
+@rule("Einsum", "XlaEinsum")
+def _einsum(m, node):
+    """tf.einsum / XlaEinsum — what keras MultiHeadAttention lowers its
+    projection and attention matmuls to. Lowered to the registered
+    einsum_apply op (NOT custom_op: imported transformers stay
+    serializable and nothing leaks into the global registry per node)."""
+    eq = node.attr["equation"].s.decode()
+    ins = [m.get(i) for i in m.inputs(node)]
+    m.set(node.name, m.sd._op("einsum_apply", ins,
+                              attrs=dict(equation=eq), name=node.name))
+
+
 @rule("BiasAdd")
 def _bias_add(m, node):
     x, b = (m.get(i) for i in m.inputs(node))

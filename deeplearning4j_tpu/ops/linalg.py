@@ -48,6 +48,15 @@ def einsum(subscripts, *operands):
     return jnp.einsum(subscripts, *operands)
 
 
+@op("einsum_apply", "linalg")
+def einsum_apply(*operands, equation):
+    """einsum with the equation as a KEYWORD attr — the graph-node form
+    (sessions call ops as fn(*input_arrays, **attrs), so the TF Einsum
+    import rule needs the operands first; unlike a custom_op closure this
+    stays serializable)."""
+    return jnp.einsum(equation, *operands)
+
+
 @op("mmul_vector", "linalg", aliases=("gemv",))
 def gemv(a, x):
     return jnp.matmul(a, x)

@@ -119,3 +119,12 @@ class TestEvaluationBinary:
         eb.eval(np.zeros((4, 2)), np.zeros((4, 2)))
         with pytest.raises(ValueError, match="outputs"):
             eb.eval(np.zeros((4, 3)), np.zeros((4, 3)))
+
+    def test_all_metrics_no_data_guard_and_1d_shapes(self):
+        from deeplearning4j_tpu.eval import EvaluationBinary
+
+        for meth in ("precision", "recall", "f1"):
+            with pytest.raises(ValueError, match="no data"):
+                getattr(EvaluationBinary(), meth)(0)
+        with pytest.raises(ValueError, match="shape"):
+            EvaluationBinary().eval(np.zeros(4), np.zeros((2, 2)))

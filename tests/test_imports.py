@@ -1112,3 +1112,45 @@ class TestOnnxRound3Rules:
             inputs=[_onnx_input("x", (1, 2, 4, 4))], outputs=["y"])
         with pytest.raises(NotImplementedError, match="align_corners"):
             import_onnx(model)
+
+
+class TestRealTransformerGraph:
+    """A real tf.keras MultiHeadAttention transformer block as a FROZEN
+    GraphDef (the BERT-config import path with keras's actual lowering —
+    Einsum projections, BatchMatMul-style attention)."""
+
+    def test_keras_mha_block_imports(self, rng):
+        H, heads = 8, 2
+        inp = tf.keras.Input((6, H))
+        att = tf.keras.layers.MultiHeadAttention(
+            num_heads=heads, key_dim=H // heads)(inp, inp)
+        h = tf.keras.layers.LayerNormalization()(inp + att)
+        f = tf.keras.layers.Dense(H * 2, activation="gelu")(h)
+        f = tf.keras.layers.Dense(H)(f)
+        out = tf.keras.layers.LayerNormalization()(h + f)
+        model = tf.keras.Model(inp, out)
+
+        x = rng.normal(size=(2, 6, H)).astype(np.float32)
+        _golden_match(*_freeze(lambda t: model(t), [x]), [x], atol=1e-4)
+
+    def test_imported_mha_graph_serializes(self, rng, tmp_path):
+        """Einsum lowers to a REGISTERED op, so imported transformers
+        round-trip through save/load (review fix — custom_op would not)."""
+        H = 8
+        inp = tf.keras.Input((4, H))
+        att = tf.keras.layers.MultiHeadAttention(num_heads=2, key_dim=4)(
+            inp, inp)
+        model = tf.keras.Model(inp, att)
+        gd, golden, in_names, out_names = _freeze(lambda t: model(t), [
+            rng.normal(size=(2, 4, H)).astype(np.float32)])
+        sd = import_graph_def(gd)
+        path = str(tmp_path / "mha.sd")
+        sd.save(path)
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        sd2 = SameDiff.load(path)
+        x = rng.normal(size=(2, 4, H)).astype(np.float32)
+        key = sd.tf_name_map[out_names[0]]
+        a = np.asarray(sd.output({in_names[0]: x}, [key])[key])
+        b = np.asarray(sd2.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_allclose(a, b, atol=1e-6)

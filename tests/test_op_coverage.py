@@ -79,6 +79,7 @@ OVERRIDES = {
     "cumlogsumexp": lambda f: f(XN),
     "clip_by_global_norm": lambda f: f([XN, X], 1.0),
     "clipbyavgnorm": lambda f: f(XN, 0.01),
+    "einsum_apply": lambda f: f(XN, X, equation="ij,ij->i"),
     "entropy": lambda f: f(X),
     "shannon_entropy": lambda f: f(X),
     "log_entropy": lambda f: f(X),
