@@ -195,7 +195,10 @@ _BINOP = {
     "Add": "add", "AddV2": "add", "Sub": "subtract", "Mul": "multiply",
     "RealDiv": "divide", "Div": "divide", "Maximum": "maximum",
     "Minimum": "minimum", "Pow": "pow", "SquaredDifference": "squareddifference",
-    "FloorDiv": "floordiv", "Mod": "mod", "Atan2": "atan2",
+    "FloorDiv": "floordiv", "FloorMod": "mod",
+    "Mod": "fmod",  # raw Mod is C/truncation semantics (sign of dividend)
+    "TruncateDiv": "truncatediv", "DivNoNan": "divide_no_nan",
+    "Atan2": "atan2",
     "Greater": "greater", "GreaterEqual": "greaterequal", "Less": "less",
     "LessEqual": "lessequal", "Equal": "equals", "NotEqual": "notequals",
     "LogicalAnd": "and", "LogicalOr": "or",
@@ -1177,3 +1180,241 @@ def _range(m, node):
         raise UnsupportedOpError(
             f"Range {node.name!r} with non-constant bounds (dynamic shapes "
             "are not XLA-traceable)")
+
+
+# ---------------------------------------------------------------------------
+# Round-3 rule tail: cumulative/scatter/segment/image ops common in real
+# TF graphs (TFGraphMapper op coverage, path-cite).
+# ---------------------------------------------------------------------------
+
+
+@rule("Cumsum", "Cumprod")
+def _tf_cumulative(m, node):
+    x = m.get(m.inputs(node)[0])
+    axis = int(m.const(m.inputs(node)[1]))
+    if node.attr["exclusive"].b or node.attr["reverse"].b:
+        raise UnsupportedOpError(f"{node.op} exclusive/reverse")
+    opname = "cumsum" if node.op == "Cumsum" else "cumprod"
+    m.set(node.name, m.sd._op(opname, [x], attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("ArgMin")
+def _tf_argmin(m, node):
+    x = m.get(m.inputs(node)[0])
+    axis = int(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("argmin", [x], attrs=dict(axis=axis),
+                              name=node.name))
+
+
+@rule("TopKV2")
+def _tf_topk(m, node):
+    x = m.get(m.inputs(node)[0])
+    k = int(m.const(m.inputs(node)[1]))
+    vals, idx = m.sd._op("top_k", [x], attrs=dict(k=k), n_out=2,
+                         name=node.name)
+    m.set(node.name, vals, slot=0)
+    m.set(node.name, idx, slot=1)
+
+
+@rule("ZerosLike")
+def _tf_zeros_like(m, node):
+    m.set(node.name, m.sd._op("zeros_like", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("OnesLike")
+def _tf_ones_like(m, node):
+    m.set(node.name, m.sd._op("ones_like", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("Rank", "Size")
+def _tf_rank_size(m, node):
+    src = m._canon(m.inputs(node)[0])
+    shp = m.vars[src].shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise UnsupportedOpError(f"{node.op} of dynamically-shaped tensor")
+    v = len(shp) if node.op == "Rank" else int(np.prod(shp))
+    arr = np.asarray(v, np.int32)
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+
+
+@rule("BroadcastTo")
+def _tf_broadcast_to(m, node):
+    x = m.get(m.inputs(node)[0])
+    shape = tuple(int(s) for s in m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("broadcast_to", [x], attrs=dict(shape=shape),
+                              name=node.name))
+
+
+@rule("InvertPermutation")
+def _tf_invert_permutation(m, node):
+    m.set(node.name, m.sd._op("invert_permutation",
+                              [m.get(m.inputs(node)[0])], name=node.name))
+
+
+@rule("MatrixBandPart")
+def _tf_band_part(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    lo, hi = int(m.const(ins[1])), int(m.const(ins[2]))
+    m.set(node.name, m.sd._op("matrix_band_part", [x],
+                              attrs=dict(num_lower=lo, num_upper=hi),
+                              name=node.name))
+
+
+@rule("Bincount")
+def _tf_bincount(m, node):
+    ins = m.inputs(node)
+    arr = m.get(ins[0])
+    size = int(m.const(ins[1]))
+    # TF DROPS values >= size (the registered op clamps into the last bin):
+    # gate via weights — out-of-range entries contribute 0. User weights
+    # (input 3, empty tensor when unweighted) multiply in.
+    in_range = m.sd._op("less", [arr, m.sd.constant(
+        np.asarray(size, np.int32), name=f"{node.name}_size")])
+    w = m.sd._op("cast", [in_range], attrs=dict(dtype=np.float32))
+    unweighted = True
+    if len(ins) > 2:
+        wconst = m.const_vals.get(m._canon(ins[2]))
+        if wconst is None or wconst.size:
+            w = m.sd._op("multiply", [w, m.get(ins[2])])
+            unweighted = False
+    out = m.sd._op("bincount", [arr, w],
+                   attrs=dict(minlength=size, maxlength=size))
+    if unweighted:  # TF returns int32 counts when weights are empty
+        out = m.sd._op("cast", [out], attrs=dict(dtype=np.int32))
+    m.set(node.name, m.sd._op("identity", [out], name=node.name))
+
+
+@rule("SegmentSum", "UnsortedSegmentSum")
+def _tf_segment_sum(m, node):
+    ins = m.inputs(node)
+    data, ids = m.get(ins[0]), m.get(ins[1])
+    if node.op == "UnsortedSegmentSum":
+        n = int(m.const(ins[2]))
+    else:
+        # sorted SegmentSum carries no num_segments input: static ids only
+        n = int(np.asarray(m.const(ins[1])).max()) + 1
+    m.set(node.name, m.sd._op("segment_sum", [data, ids],
+                              attrs=dict(num_segments=n), name=node.name))
+
+
+@rule("TensorScatterUpdate")
+def _tf_tensor_scatter(m, node):
+    ins = [m.get(i) for i in m.inputs(node)]
+    m.set(node.name, m.sd._op("tensor_scatter_update", ins, name=node.name))
+
+
+@rule("ScatterNd")
+def _tf_scatter_nd(m, node):
+    ins = m.inputs(node)
+    idx, upd = m.get(ins[0]), m.get(ins[1])
+    shape = tuple(int(s) for s in m.const(ins[2]))
+    m.set(node.name, m.sd._op("scatter_nd", [idx, upd],
+                              attrs=dict(shape=shape), name=node.name))
+
+
+@rule("GatherNd")
+def _tf_gather_nd(m, node):
+    ins = [m.get(i) for i in m.inputs(node)]
+    m.set(node.name, m.sd._op("gather_nd", ins, name=node.name))
+
+
+@rule("ReverseV2")
+def _tf_reverse(m, node):
+    x = m.get(m.inputs(node)[0])
+    axes = tuple(int(a) for a in np.atleast_1d(m.const(m.inputs(node)[1])))
+    m.set(node.name, m.sd._op("flip", [x], attrs=dict(axis=axes),
+                              name=node.name))
+
+
+@rule("ReverseSequence")
+def _tf_reverse_sequence(m, node):
+    ins = m.inputs(node)
+    x, lens = m.get(ins[0]), m.get(ins[1])
+    m.set(node.name, m.sd._op(
+        "reverse_sequence", [x, lens],
+        attrs=dict(seq_axis=int(node.attr["seq_dim"].i),
+                   batch_axis=int(node.attr["batch_dim"].i)),
+        name=node.name))
+
+
+@rule("Roll")
+def _tf_roll(m, node):
+    ins = m.inputs(node)
+    x = m.get(ins[0])
+    shift = [int(s) for s in np.atleast_1d(m.const(ins[1]))]
+    axis = [int(a) for a in np.atleast_1d(m.const(ins[2]))]
+    m.set(node.name, m.sd._op(
+        "roll", [x], attrs=dict(shift=tuple(shift) if len(shift) > 1
+                                else shift[0],
+                                axis=tuple(axis) if len(axis) > 1
+                                else axis[0]),
+        name=node.name))
+
+
+@rule("LinSpace")
+def _tf_linspace(m, node):
+    ins = m.inputs(node)
+    start = float(np.asarray(m.const(ins[0])))
+    stop = float(np.asarray(m.const(ins[1])))
+    num = int(m.const(ins[2]))
+    arr = np.linspace(start, stop, num, dtype=np.float32)
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+
+
+@rule("DepthToSpace", "SpaceToDepth")
+def _tf_depth_space(m, node):
+    if not _nhwc(node):
+        raise UnsupportedOpError(f"{node.op} NCHW")
+    x = m.get(m.inputs(node)[0])
+    bs = int(node.attr["block_size"].i)
+    opname = ("depth_to_space" if node.op == "DepthToSpace"
+              else "space_to_depth")
+    m.set(node.name, m.sd._op(opname, [x], attrs=dict(block_size=bs),
+                              name=node.name))
+
+
+@rule("ExtractImagePatches")
+def _tf_extract_patches(m, node):
+    x = m.get(m.inputs(node)[0])
+    ks = list(node.attr["ksizes"].list.i)
+    st = list(node.attr["strides"].list.i)
+    rates = list(node.attr["rates"].list.i)
+    pad = node.attr["padding"].s.decode()
+    m.set(node.name, m.sd._op(
+        "extract_image_patches", [x],
+        attrs=dict(ksizes=(ks[1], ks[2]), strides=(st[1], st[2]),
+                   rates=(rates[1], rates[2]), padding=pad),
+        name=node.name))
+
+
+def _attr_or(node, name, kind, default):
+    """Attr value honoring explicit zeros (0 and 0.0 are meaningful — no
+    falsy-default collapse; see the FusedBatchNorm exponential_avg_factor
+    review finding)."""
+    if name not in node.attr:
+        return default
+    return getattr(node.attr[name], kind)
+
+
+@rule("LRN")
+def _tf_lrn(m, node):
+    x = m.get(m.inputs(node)[0])
+    m.set(node.name, m.sd._op(
+        "lrn", [x],
+        attrs=dict(depth_radius=int(_attr_or(node, "depth_radius", "i", 5)),
+                   bias=float(_attr_or(node, "bias", "f", 1.0)),
+                   alpha=float(_attr_or(node, "alpha", "f", 1.0)),
+                   beta=float(_attr_or(node, "beta", "f", 0.5))),
+        name=node.name))
+
+
+@rule("LeakyRelu")
+def _tf_leaky_relu(m, node):
+    m.set(node.name, m.sd._op(
+        "leakyrelu", [m.get(m.inputs(node)[0])],
+        attrs=dict(alpha=float(_attr_or(node, "alpha", "f", 0.2))),
+        name=node.name))

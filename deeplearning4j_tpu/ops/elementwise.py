@@ -109,8 +109,8 @@ op("relu", "transform_same")(jax.nn.relu)
 op("relu6", "transform_same")(jax.nn.relu6)
 op("identity", "transform_same", aliases=("linear", "old_identity"))(lambda x: x)
 op("stop_gradient", "transform_same")(lax.stop_gradient)
-op("oneslike", "transform_same", aliases=("ones_as",))(jnp.ones_like)
-op("zeroslike", "transform_same", aliases=("zeros_as",))(jnp.zeros_like)
+op("oneslike", "transform_same", aliases=("ones_as", "ones_like"))(jnp.ones_like)
+op("zeroslike", "transform_same", aliases=("zeros_as", "zeros_like"))(jnp.zeros_like)
 
 
 @op("leakyrelu", "transform_same", aliases=("leaky_relu",))
@@ -168,7 +168,14 @@ op("pow", "pairwise", aliases=("power",))(jnp.power)
 op("floordiv", "pairwise", aliases=("floor_div",))(jnp.floor_divide)
 op("mod", "pairwise", aliases=("floormod",))(jnp.mod)
 op("fmod", "pairwise")(jnp.fmod)  # C semantics: sign follows the dividend
-op("truncatediv", "pairwise")(lambda x, y: jnp.trunc(x / y))
+@op("truncatediv", "pairwise")
+def truncatediv(x, y):
+    """Division truncating toward zero; integer inputs keep their dtype
+    (lax.div is trunc-division for ints — jnp.trunc(x/y) would float them)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if jnp.issubdtype(jnp.result_type(x, y), jnp.integer):
+        return lax.div(*jnp.broadcast_arrays(x, y))
+    return jnp.trunc(x / y)
 op("maximum", "pairwise", aliases=("max_pairwise",))(jnp.maximum)
 op("minimum", "pairwise", aliases=("min_pairwise",))(jnp.minimum)
 op("atan2", "pairwise")(jnp.arctan2)

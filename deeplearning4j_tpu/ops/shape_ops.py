@@ -61,6 +61,13 @@ op("rank", "shape", differentiable=False)(lambda x: x.ndim)
 op("shape_of", "shape", differentiable=False)(lambda x: jnp.array(x.shape, dtype=jnp.int64))
 
 
+@op("invert_permutation", "sorting", differentiable=False)
+def invert_permutation(p):
+    """inv[p[i]] = i (generic/parity_ops/invert_permutation.cpp, path-cite)."""
+    p = jnp.asarray(p)
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=p.dtype))
+
+
 @op("pad", "shape")
 def pad(x, paddings, mode="constant", constant_value=0.0):
     """Pad; paddings is [(lo, hi), ...] per dim (TF-style)."""

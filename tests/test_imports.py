@@ -1154,3 +1154,90 @@ class TestRealTransformerGraph:
         a = np.asarray(sd.output({in_names[0]: x}, [key])[key])
         b = np.asarray(sd2.output({in_names[0]: x}, [key])[key])
         np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+class TestTFRuleTail:
+    """Round-3 TF rule tail (165 op types): cumulative/scatter/segment/
+    image/shape ops, golden-tested vs TF."""
+
+    def test_cumulative_argmin_topk(self, rng):
+        def fn(x):
+            c = tf.cumsum(x, axis=1)
+            p = tf.math.cumprod(x, axis=0)
+            am = tf.argmin(x, axis=1)
+            v, i = tf.math.top_k(x, k=2)
+            return c, p, am, v, i
+
+        x = rng.normal(size=(3, 5)).astype(np.float32)
+        _golden_match(*_freeze(fn, [x]), [x])
+
+    def test_scatter_gather_nd_segment(self, rng):
+        def fn(x):
+            idx = tf.constant([[0], [2]])
+            sc = tf.scatter_nd(idx, x[:2], tf.constant([4, 4]))
+            tsu = tf.tensor_scatter_nd_update(x, idx, x[:2] * 2.0)
+            gn = tf.gather_nd(x, tf.constant([[1, 2], [3, 0]]))
+            seg = tf.math.unsorted_segment_sum(
+                x, tf.constant([0, 1, 0, 1]), 2)
+            return sc, tsu, gn, seg
+
+        x = rng.normal(size=(4, 4)).astype(np.float32)
+        _golden_match(*_freeze(fn, [x]), [x])
+
+    def test_reverse_roll_broadcast_like(self, rng):
+        def fn(x):
+            r = tf.reverse(x, axis=[1])
+            rs = tf.reverse_sequence(x, tf.constant([2, 3]), seq_axis=1,
+                                     batch_axis=0)
+            ro = tf.roll(x, shift=[1], axis=[0])
+            b = tf.broadcast_to(x[:1], tf.constant([2, 4]))
+            z = tf.zeros_like(x) + tf.ones_like(x)
+            return r, rs, ro, b, z
+
+        x = rng.normal(size=(2, 4)).astype(np.float32)
+        _golden_match(*_freeze(fn, [x]), [x])
+
+    def test_depth_space_patches_lrn_leaky(self, rng):
+        def fn(x):
+            d = tf.nn.space_to_depth(x, 2)
+            u = tf.nn.depth_to_space(d, 2)
+            p = tf.image.extract_patches(x, sizes=[1, 2, 2, 1],
+                                         strides=[1, 2, 2, 1],
+                                         rates=[1, 1, 1, 1], padding="VALID")
+            n = tf.nn.lrn(x, depth_radius=1, bias=1.0, alpha=0.5, beta=0.4)
+            lk = tf.nn.leaky_relu(x[..., 0], alpha=0.0)  # explicit 0 honored
+            return d, u, p, n, lk
+
+        x = rng.normal(size=(1, 4, 4, 4)).astype(np.float32)
+        _golden_match(*_freeze(fn, [x]), [x], atol=1e-4)
+
+    def test_band_bincount_invperm_linspace(self, rng):
+        def fn(x):
+            bp = tf.linalg.band_part(x, 1, 0)
+            ip = tf.math.invert_permutation(tf.constant([2, 0, 1, 3]))
+            ls = tf.raw_ops.LinSpace(start=0.0, stop=1.0, num=5)
+            fm = tf.math.floormod(x, 2.0)
+            return bp, ip, ls, fm
+
+        x = rng.normal(size=(4, 4)).astype(np.float32)
+        _golden_match(*_freeze(fn, [x]), [x])
+
+    def test_mod_truncdiv_bincount_semantics(self, rng):
+        """Raw Mod is truncation (sign of dividend); TruncateDiv keeps int
+        dtype; Bincount DROPS values >= size and honors weights
+        (review fixes)."""
+        def fn(a, b, v):
+            m_ = tf.raw_ops.Mod(x=a, y=b)
+            td = tf.raw_ops.TruncateDiv(x=tf.cast(a, tf.int32),
+                                        y=tf.cast(b, tf.int32))
+            bc = tf.raw_ops.Bincount(arr=v, size=3,
+                                     weights=tf.constant([], tf.float32))
+            bw = tf.raw_ops.Bincount(arr=v, size=3,
+                                     weights=tf.constant(
+                                         [0.5, 2.0, 1.0, 4.0], tf.float32))
+            return m_, td, bc, bw
+
+        a = np.asarray([-7.0, 7.0, -7.0], np.float32)
+        b = np.asarray([3.0, -3.0, -3.0], np.float32)
+        v = np.asarray([0, 1, 5, 1], np.int32)  # 5 is out of range -> dropped
+        _golden_match(*_freeze(fn, [a, b, v]), [a, b, v])

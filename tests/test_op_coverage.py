@@ -65,6 +65,7 @@ OVERRIDES = {
     "segment_min": lambda f: f(XN, IDX, 2),
     "segment_prod": lambda f: f(XN, IDX, 2),
     "unique_with_counts": lambda f: f(jnp.asarray([1, 2, 2, 3])),
+    "invert_permutation": lambda f: f(jnp.asarray([2, 0, 1, 3])),
     "listdiff": lambda f: f(jnp.asarray([1, 2, 3, 4]), jnp.asarray([2, 4])),
     "nth_element": lambda f: f(XN, 2),
     "batch_gather": lambda f: f(XN, jnp.asarray([[0, 2], [1, 3], [0, 0], [5, 1]])),
