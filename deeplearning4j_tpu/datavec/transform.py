@@ -531,7 +531,8 @@ class TransformProcess:
 
             getters = {
                 "hour_of_day": lambda d: d.hour,
-                "day_of_week": lambda d: d.weekday(),
+                # isoweekday: Monday=1..Sunday=7 (Joda/DataVec convention)
+                "day_of_week": lambda d: d.isoweekday(),
                 "day_of_month": lambda d: d.day,
                 "month": lambda d: d.month,
                 "year": lambda d: d.year,

@@ -329,6 +329,10 @@ def _build(config, weights):
             layers.append(lyr)
             params.append(p)
             states.append(st)
+    if pending_mask is not None:
+        raise KerasImportError(
+            "Masking is the last layer — nothing consumes its mask; the "
+            "import would silently drop the masking semantics")
     if input_shape is None:
         raise KerasImportError("could not determine input shape")
 

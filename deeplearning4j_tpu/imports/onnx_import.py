@@ -782,7 +782,11 @@ def _o_conv_transpose(m, node):
     kshape = node.attr("kernel_shape")
     if kshape is None and w.shape is not None:
         kshape = w.shape[2:4]
-    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+    if auto_pad == "SAME_LOWER":
+        # upper-biased 'SAME' would shift the output one pixel whenever the
+        # total padding is odd
+        raise NotImplementedError("ConvTranspose SAME_LOWER")
+    if auto_pad == "SAME_UPPER":
         padding = "SAME"
     elif all(p == 0 for p in pads):
         padding = "VALID"
@@ -930,7 +934,13 @@ def _o_resize(m, node):
     method = {"nearest": "nearest", "linear": "bilinear"}.get(mode)
     if method is None:
         raise NotImplementedError(f"Resize mode {mode!r}")
-    ctm = node.attr("coordinate_transformation_mode", "half_pixel")
+    ctm = node.attr("coordinate_transformation_mode")
+    if ctm is None and len(node.inputs) == 2:
+        # opset-10 Resize (inputs X, scales — no roi slot) has no attr and
+        # implicit ASYMMETRIC semantics; must not default to half_pixel
+        ctm = "asymmetric"
+    elif ctm is None:
+        ctm = "half_pixel"
     if isinstance(ctm, bytes):
         ctm = ctm.decode()
     if ctm not in ("half_pixel", "asymmetric"):
@@ -954,6 +964,15 @@ def _o_resize(m, node):
         out_hw = tuple(int(round(s * f)) for s, f in zip(shp[2:], scales[2:]))
     else:
         raise NotImplementedError("Resize without scales or sizes")
+    if ctm == "asymmetric":
+        # jax.image.resize samples at half-pixel coordinates; that coincides
+        # with asymmetric (x_in = x_out/scale) only for nearest at exact
+        # integer upscales, where both select floor(x_out/scale)
+        if method != "nearest" or any(o % s for s, o in zip(shp[2:], out_hw)):
+            raise NotImplementedError(
+                "Resize coordinate_transformation_mode 'asymmetric' only "
+                "supported for nearest integer upscales (where half-pixel "
+                "and asymmetric sampling coincide)")
     xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
     y = m.sd._op("image_resize", [xh], attrs=dict(size=out_hw,
                                                   method=method))

@@ -1269,11 +1269,15 @@ def _tf_bincount(m, node):
     ins = m.inputs(node)
     arr = m.get(ins[0])
     size = int(m.const(ins[1]))
-    # TF DROPS values >= size (the registered op clamps into the last bin):
-    # gate via weights — out-of-range entries contribute 0. User weights
-    # (input 3, empty tensor when unweighted) multiply in.
-    in_range = m.sd._op("less", [arr, m.sd.constant(
-        np.asarray(size, np.int32), name=f"{node.name}_size")])
+    # TF DROPS values >= size (the registered op clamps into the last bin)
+    # and rejects negatives (the op clamps them into bin 0): gate BOTH via
+    # weights — out-of-range entries contribute 0. User weights (input 3,
+    # empty tensor when unweighted) multiply in.
+    in_range = m.sd._op("and", [
+        m.sd._op("greaterequal", [arr, m.sd.constant(
+            np.asarray(0, np.int32), name=f"{node.name}_zero")]),
+        m.sd._op("less", [arr, m.sd.constant(
+            np.asarray(size, np.int32), name=f"{node.name}_size")])])
     w = m.sd._op("cast", [in_range], attrs=dict(dtype=np.float32))
     unweighted = True
     if len(ins) > 2:

@@ -326,7 +326,7 @@ class TestTransformBreadth:
               .build())
         out = tp.execute([["a", 1, 0.0, ms]])[0]
         assert out[-2] == 13
-        assert out[-1] == 1  # Tuesday
+        assert out[-1] == 2  # Tuesday (Joda/DataVec: Monday=1..Sunday=7)
         names = tp.final_schema().column_names()
         assert names[-2:] == ["ts_hour_of_day", "ts_day_of_week"]
 
