@@ -141,9 +141,17 @@ class OnnxImporter:
 
     def const(self, name: str) -> np.ndarray:
         if name not in self.const_vals:
-            raise NotImplementedError(
-                f"input {name!r} must be an initializer/Constant (static "
-                "shapes under XLA)")
+            # eager-eval fallback: shape chains (Shape→Gather→Unsqueeze→
+            # Concat…, torch LSTM/attention exports build state shapes and
+            # masks this way) are placeholder-free once Shape folds — run
+            # the producing subgraph now and record the value
+            try:
+                val = np.asarray(self.vars[name].eval({}))
+            except Exception as e:
+                raise NotImplementedError(
+                    f"input {name!r} must be an initializer/Constant (static "
+                    f"shapes under XLA); eager eval failed: {e!r}") from e
+            self.const_vals[name] = val
         return self.const_vals[name]
 
     def set(self, name: str, var, const_val=None):
@@ -190,14 +198,16 @@ def import_onnx(model) -> SameDiff:
 _OBIN = {"Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
          "Pow": "pow", "MatMul": "matmul", "Greater": "greater", "Less": "less",
          "Equal": "equals", "Max": "maximum", "Min": "minimum", "And": "and",
-         "Or": "or"}
+         "Or": "or", "LessOrEqual": "lessequal",
+         "GreaterOrEqual": "greaterequal", "Xor": "xor"}
 _OUN = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh", "Exp": "exp",
         "Log": "log", "Sqrt": "sqrt", "Neg": "neg", "Abs": "abs",
         "Erf": "erf", "Floor": "floor", "Ceil": "ceil", "Round": "round",
         "Softplus": "softplus", "Softsign": "softsign", "Sign": "sign",
         "Reciprocal": "reciprocal", "Not": "not", "Selu": "selu",
         "Sin": "sin", "Cos": "cos", "Tan": "tan", "Mish": "mish",
-        "HardSigmoid": "hard_sigmoid", "Identity": "identity"}
+        "HardSigmoid": "hard_sigmoid", "HardSwish": "hardswish",
+        "IsNaN": "isnan", "Identity": "identity"}
 
 
 def _register_onnx_simple():
@@ -268,6 +278,18 @@ def _o_log_softmax(m, node):
 def _o_reshape(m, node):
     x = m.get(node.inputs[0])
     shape = [int(s) for s in m.const(node.inputs[1])]
+    if 0 in shape and not node.attr("allowzero", 0):
+        # ONNX: dim 0 = copy the corresponding input dim (torch RNN exports
+        # emit e.g. [0, 0, -1])
+        xs = x.shape
+        if xs is None:
+            raise NotImplementedError("Reshape 0-dim with unknown input shape")
+        shape = [xs[i] if s == 0 else s for i, s in enumerate(shape)]
+        if sum(1 for s in shape if s == -1) > 1:
+            # a copied dim was itself dynamic (-1) next to an explicit -1 —
+            # jnp.reshape allows only one unknown dim
+            raise NotImplementedError(
+                "Reshape 0-dim copying a dynamic input dim alongside -1")
     m.set(node.outputs[0], m.sd._op("reshape", [x],
                                     attrs=dict(shape=tuple(shape)),
                                     name=node.outputs[0]))
@@ -482,6 +504,22 @@ def _o_ln(m, node):
     m.set(node.outputs[0], m.sd._op(
         "layernorm", ins, attrs=dict(eps=node.attr("epsilon", 1e-5)),
         name=node.outputs[0]))
+
+
+@orule("IsInf")
+def _o_isinf(m, node):
+    x = m.get(node.inputs[0])
+    if not (node.attr("detect_positive", 1) and node.attr("detect_negative", 1)):
+        raise NotImplementedError("IsInf one-sided detection")
+    m.set(node.outputs[0], m.sd._op("isinf", [x], name=node.outputs[0]))
+
+
+@orule("Mod")
+def _o_mod(m, node):
+    # fmod=0 (default): sign follows the divisor (python %); fmod=1: C fmod
+    a, b = m.get(node.inputs[0]), m.get(node.inputs[1])
+    opname = "fmod" if node.attr("fmod", 0) else "mod"
+    m.set(node.outputs[0], m.sd._op(opname, [a, b], name=node.outputs[0]))
 
 
 @orule("Shape")

@@ -182,6 +182,16 @@ def _identity(m, node):
           const_val=m.const_vals.get(src))
 
 
+@rule("IdentityN")
+def _identity_n(m, node):
+    # N-ary Identity (tf.identity_n / custom_gradient plumbing — keras
+    # EfficientNet's stem emits these): output i forwards input i
+    for i, inp in enumerate(m.inputs(node)):
+        src = m._canon(inp)
+        m.set(node.name, m.sd._op("identity", [m.vars[src]]), slot=i,
+              const_val=m.const_vals.get(src))
+
+
 @rule("NoOp", "Assert")
 def _noop(m, node):
     pass
@@ -514,12 +524,18 @@ def _pad(m, node):
 def _tile(m, node):
     x = m.get(m.inputs(node)[0])
     reps = tuple(int(v) for v in m.const(m.inputs(node)[1]))
+    if any(r < 0 for r in reps):
+        # -1 = the Shape rule's dynamic-dim sentinel; tiling by it is not
+        # expressible statically
+        raise UnsupportedOpError("Tile reps derived from a dynamic dim")
     m.set(node.name, m.sd._op("tile", [x], attrs=dict(reps=reps), name=node.name))
 
 
 @rule("Fill")
 def _fill(m, node):
     shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    if any(s < 0 for s in shape):
+        raise UnsupportedOpError("Fill shape derived from a dynamic dim")
     val = m.const(m.inputs(node)[1])
     arr = np.full(shape, val)
     m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
@@ -625,11 +641,16 @@ def _fused_bn(m, node):
 
 @rule("Shape")
 def _shape(m, node):
-    # static under XLA: materialize as a constant if the input shape is known
+    # static under XLA: materialize as a constant. Dims that depend on a
+    # dynamic (-1) placeholder dim fold as the -1 sentinel (TF's own
+    # unknown-dim convention) — the keras Reshape pattern
+    # Pack(StridedSlice(Shape(x)), 1, 1, C) then reaches jnp.reshape as a
+    # (-1, 1, 1, C) target, which handles the runtime batch natively
     src = m._canon(m.inputs(node)[0])
     v = m.vars[src]
-    shp = v.shape
-    if shp is None or any(s is None or s < 0 for s in shp):
+    shp = m.sd._infer(v.name, "shape", mark_dynamic=True) \
+        if v.vtype.name == "ARRAY" else v.shape
+    if shp is None or any(s is None for s in shp):
         raise UnsupportedOpError("Shape of dynamically-shaped tensor")
     arr = np.asarray(shp, np.int32)
     m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
@@ -1173,13 +1194,18 @@ def _range(m, node):
     ins = m.inputs(node)
     try:  # static limits → constant (shape math stays static)
         start, limit, delta = (int(np.asarray(m.const(i))) for i in ins)
-        arr = np.arange(start, limit, delta,
-                        dtype=_tf_dtype(node.attr["Tidx"].type))
-        m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
     except UnsupportedOpError:
         raise UnsupportedOpError(
             f"Range {node.name!r} with non-constant bounds (dynamic shapes "
             "are not XLA-traceable)")
+    if limit < 0:
+        # -1 = the Shape rule's dynamic-dim sentinel: np.arange would
+        # silently produce an empty array
+        raise UnsupportedOpError(
+            f"Range {node.name!r} limit derived from a dynamic dim")
+    arr = np.arange(start, limit, delta,
+                    dtype=_tf_dtype(node.attr["Tidx"].type))
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
 
 
 # ---------------------------------------------------------------------------

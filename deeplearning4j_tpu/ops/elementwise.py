@@ -69,6 +69,8 @@ op("swish", "transform_float", aliases=("silu",))(jax.nn.silu)
 op("mish", "transform_float")(jax.nn.mish)
 # ND4J HardSigmoid: clip(0.2x + 0.5, 0, 1) — NOT jax.nn.hard_sigmoid (slope 1/6)
 op("hard_sigmoid", "transform_float")(lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
+# hardswish (MobileNetV3 / ONNX HardSwish / torch Hardswish): x·relu6(x+3)/6
+op("hardswish", "transform_float", aliases=("hard_swish",))(jax.nn.hard_swish)
 op("hard_tanh", "transform_float", aliases=("hardtanh",))(
     lambda x: jnp.clip(x, -1.0, 1.0)
 )

@@ -816,7 +816,11 @@ class SameDiff:
         names = [o.name if isinstance(o, SDVariable) else o for o in outputs]
         return self.output(feeds, names)
 
-    def _infer(self, name: str, what: str):
+    def _infer(self, name: str, what: str, *, mark_dynamic: bool = False):
+        """Shape/dtype inference. With ``mark_dynamic=True`` (shape only),
+        dims that depend on a dynamic (-1) placeholder dim are reported as
+        -1 instead of the 1-substituted guess — eval_shape runs twice with
+        different substitutions and differing dims are flagged."""
         v = self._vars[name]
         if v.vtype in (VariableType.VARIABLE, VariableType.CONSTANT):
             arr = self._arrays[name]
@@ -824,12 +828,8 @@ class SameDiff:
         if v.vtype is VariableType.PLACEHOLDER:
             shp, dt = self._ph_specs[name]
             return shp if what == "shape" else dt
-        # ARRAY: eval_shape the graph with placeholder specs (-1 → 1)
+        # ARRAY: eval_shape the graph with placeholder specs (-1 → sub)
         try:
-            abstract = {
-                k: jax.ShapeDtypeStruct(tuple(1 if s == -1 else s for s in (shp or ())), dt)
-                for k, (shp, dt) in self._ph_specs.items()
-            }
             arrays = {k: jax.ShapeDtypeStruct(v2.shape, v2.dtype)
                       for k, v2 in self._arrays.items()}
 
@@ -838,8 +838,27 @@ class SameDiff:
                 vals.update(phs)
                 return self._trace(vals, [name])
 
-            out = jax.eval_shape(run, arrays, abstract)[0]
-            return out.shape if what == "shape" else out.dtype
+            def ev(sub):
+                abstract = {
+                    k: jax.ShapeDtypeStruct(
+                        tuple(sub if s == -1 else s for s in (shp or ())), dt)
+                    for k, (shp, dt) in self._ph_specs.items()
+                }
+                return jax.eval_shape(run, arrays, abstract)[0]
+
+            out = ev(1)
+            if what != "shape":
+                return out.dtype
+            if mark_dynamic and any(-1 in (shp or ())
+                                    for shp, _ in self._ph_specs.values()):
+                out2 = ev(2)
+                if len(out.shape) != len(out2.shape):
+                    # rank itself depends on the dynamic dim (e.g. a full
+                    # squeeze) — not representable as a -1-marked shape
+                    return None
+                return tuple(s if s == s2 else -1
+                             for s, s2 in zip(out.shape, out2.shape))
+            return out.shape
         except Exception:
             return None
 
