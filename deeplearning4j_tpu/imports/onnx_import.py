@@ -50,6 +50,16 @@ def tensor_name(buf: bytes) -> str:
     return pm.get_str(pm.decode(buf), 8)
 
 
+class _GraphAttr:
+    """Raw GraphProto bytes carried as a node attribute (Loop/If/Scan
+    bodies) — wrapped so rules can tell them from string attrs."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+
+
 class _Node:
     def __init__(self, buf: bytes):
         f = pm.decode(buf)
@@ -70,12 +80,17 @@ class _Node:
                 self.attrs[aname] = pm.get_str(af, 4)
             elif atype == 4:  # TENSOR
                 self.attrs[aname] = parse_tensor(pm.get_bytes(af, 5))
+            elif atype == 5:  # GRAPH (control-flow body)
+                self.attrs[aname] = _GraphAttr(pm.get_bytes(af, 6))
             elif atype == 6:  # FLOATS
                 self.attrs[aname] = pm.get_floats(af, 7)
             elif atype == 7:  # INTS
                 self.attrs[aname] = pm.get_ints(af, 8)
             elif atype == 8:  # STRINGS (e.g. RNN `activations`)
                 self.attrs[aname] = pm.get_strs(af, 9)
+            elif atype == 10:  # GRAPHS
+                self.attrs[aname] = [
+                    _GraphAttr(b) for b in pm.get_messages(af, 11)]
             else:
                 self.attrs[aname] = None
 
@@ -117,10 +132,11 @@ def orule(*ops):
 
 
 class OnnxImporter:
-    def __init__(self, model_bytes: bytes):
-        mf = pm.decode(model_bytes)
-        gbuf = pm.get_bytes(mf, 7)
-        gf = pm.decode(gbuf)
+    def __init__(self, model_bytes: bytes = None, *, graph_buf: bytes = None):
+        if graph_buf is None:
+            mf = pm.decode(model_bytes)
+            graph_buf = pm.get_bytes(mf, 7)
+        gf = pm.decode(graph_buf)
         self.nodes = [_Node(b) for b in pm.get_messages(gf, 1)]
         self.initializers = {
             tensor_name(b): parse_tensor(b) for b in pm.get_messages(gf, 5)
@@ -207,7 +223,10 @@ _OUN = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh", "Exp": "exp",
         "Reciprocal": "reciprocal", "Not": "not", "Selu": "selu",
         "Sin": "sin", "Cos": "cos", "Tan": "tan", "Mish": "mish",
         "HardSigmoid": "hard_sigmoid", "HardSwish": "hardswish",
-        "IsNaN": "isnan", "Identity": "identity"}
+        "IsNaN": "isnan", "Identity": "identity",
+        "Atan": "atan", "Asin": "asin", "Acos": "acos", "Sinh": "sinh",
+        "Cosh": "cosh", "Atanh": "atanh", "Asinh": "asinh", "Acosh": "acosh",
+        "Det": "matrix_determinant"}
 
 
 def _register_onnx_simple():
@@ -373,6 +392,130 @@ def _o_reduce(m, node):
         attrs["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
     m.set(node.outputs[0], m.sd._op(opname, [x], attrs=attrs,
                                     name=node.outputs[0]))
+
+
+def _reduce_axes_attrs(m, node):
+    axes = node.attr("axes")
+    if axes is None and m.has_input(node, 1):
+        axes = [int(a) for a in m.const(node.inputs[1])]
+    attrs = dict(keepdims=bool(node.attr("keepdims", 1)))
+    if axes:
+        attrs["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
+    return attrs
+
+
+@orule("ReduceProd")
+def _o_reduce_prod(m, node):
+    m.set(node.outputs[0], m.sd._op("prod", [m.get(node.inputs[0])],
+                                    attrs=_reduce_axes_attrs(m, node),
+                                    name=node.outputs[0]))
+
+
+@orule("ReduceL1", "ReduceL2", "ReduceSumSquare", "ReduceLogSum",
+       "ReduceLogSumExp")
+def _o_reduce_composed(m, node):
+    x = m.get(node.inputs[0])
+    attrs = _reduce_axes_attrs(m, node)
+    t = node.op_type
+    if t == "ReduceLogSumExp":
+        out = m.sd._op("logsumexp", [x], attrs=attrs)
+    else:
+        pre = {"ReduceL1": "abs", "ReduceL2": "square",
+               "ReduceSumSquare": "square", "ReduceLogSum": None}[t]
+        v = m.sd._op(pre, [x]) if pre else x
+        out = m.sd._op("sum", [v], attrs=attrs)
+        if t == "ReduceL2":
+            out = m.sd._op("sqrt", [out])
+        elif t == "ReduceLogSum":
+            out = m.sd._op("log", [out])
+    m.set(node.outputs[0], m.sd._op("identity", [out], name=node.outputs[0]))
+
+
+@orule("Sum", "Mean")
+def _o_variadic(m, node):
+    acc = m.get(node.inputs[0])
+    for i in node.inputs[1:]:
+        acc = m.sd._op("add", [acc, m.get(i)])
+    if node.op_type == "Mean" and len(node.inputs) > 1:
+        acc = m.sd._op("divide", [acc, m.sd.constant(
+            np.float32(len(node.inputs)), name=(node.name or "mean") + "_n")])
+    m.set(node.outputs[0], m.sd._op("identity", [acc], name=node.outputs[0]))
+
+
+@orule("CastLike")
+def _o_cast_like(m, node):
+    x, like = m.get(node.inputs[0]), m.get(node.inputs[1])
+    dt = like.dtype
+    if dt is None:
+        raise NotImplementedError("CastLike target dtype unknown")
+    m.set(node.outputs[0], m.sd._op("cast", [x], attrs=dict(dtype=np.dtype(dt)),
+                                    name=node.outputs[0]))
+
+
+@orule("Size")
+def _o_size(m, node):
+    shp = m.get(node.inputs[0]).shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise NotImplementedError("Size of dynamically-shaped tensor")
+    arr = np.asarray(int(np.prod(shp)), np.int64)
+    m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
+          const_val=arr)
+
+
+@orule("EyeLike")
+def _o_eyelike(m, node):
+    shp = m.get(node.inputs[0]).shape
+    if shp is None or len(shp) != 2:
+        raise NotImplementedError("EyeLike needs a static 2-D input")
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else \
+        (m.get(node.inputs[0]).dtype or np.float32)
+    arr = np.eye(shp[0], shp[1], k=int(node.attr("k", 0)), dtype=dt)
+    m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
+          const_val=arr)
+
+
+@orule("GatherND")
+def _o_gather_nd(m, node):
+    if node.attr("batch_dims", 0):
+        raise NotImplementedError("GatherND batch_dims != 0")
+    x, idx = m.get(node.inputs[0]), m.get(node.inputs[1])
+    m.set(node.outputs[0], m.sd._op("gather_nd", [x, idx],
+                                    name=node.outputs[0]))
+
+
+@orule("Celu")
+def _o_celu(m, node):
+    m.set(node.outputs[0], m.sd._op(
+        "celu", [m.get(node.inputs[0])],
+        attrs=dict(alpha=float(node.attr("alpha", 1.0))),
+        name=node.outputs[0]))
+
+
+@orule("ThresholdedRelu")
+def _o_thresholded_relu(m, node):
+    m.set(node.outputs[0], m.sd._op(
+        "thresholded_relu", [m.get(node.inputs[0])],
+        attrs=dict(alpha=float(node.attr("alpha", 1.0))),
+        name=node.outputs[0]))
+
+
+@orule("Shrink")
+def _o_shrink(m, node):
+    m.set(node.outputs[0], m.sd._op(
+        "shrink", [m.get(node.inputs[0])],
+        attrs=dict(lambd=float(node.attr("lambd", 0.5)),
+                   bias=float(node.attr("bias", 0.0))),
+        name=node.outputs[0]))
+
+
+@orule("LpNormalization")
+def _o_lp_norm(m, node):
+    if int(node.attr("p", 2)) != 2:
+        raise NotImplementedError("LpNormalization p != 2")
+    m.set(node.outputs[0], m.sd._op(
+        "l2_normalize", [m.get(node.inputs[0])],
+        attrs=dict(axis=int(node.attr("axis", -1))),
+        name=node.outputs[0]))
 
 
 @orule("Cast")
@@ -1016,4 +1159,276 @@ def _o_resize(m, node):
                                                   method=method))
     m.set(node.outputs[0], m.sd._op("permute", [y],
                                     attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+# ----------------------------------------------------------- control flow
+# Reference parity: samediff-import-onnx maps Loop/If/Scan onto SameDiff
+# control-flow ops interpreted op-at-a-time on the JVM (path-cite, mount
+# empty). TPU-native collapse (same design as the TF side's While/If): each
+# control-flow node's GraphProto body is imported into a scratch SameDiff
+# and traced as an array-level function inside ONE lax.while_loop /
+# lax.cond / lax.scan custom node, compiling into the enclosing XLA program.
+# ONNX subgraphs capture enclosing-scope tensors by NAME; captures that are
+# constants fold into the sub-graph, the rest become trailing runtime
+# arguments of the traced callable (lax closures must be argument-explicit).
+
+
+def _graph_local_names(gf) -> set:
+    names = {tensor_name(b) for b in pm.get_messages(gf, 5)}
+    names |= {_value_info(b)[0] for b in pm.get_messages(gf, 11)}
+    return names
+
+
+def _external_refs(gf, scope=()) -> List[str]:
+    """Names referenced in a GraphProto (recursively, through nested
+    control-flow bodies) but defined neither locally nor in `scope`."""
+    local = set(scope) | _graph_local_names(gf)
+    refs: List[str] = []
+    for nb in pm.get_messages(gf, 1):
+        node = _Node(nb)
+        for i in node.inputs:
+            if i and i not in local and i not in refs:
+                refs.append(i)
+        for v in node.attrs.values():
+            graphs = ([v] if isinstance(v, _GraphAttr) else
+                      [g for g in v if isinstance(g, _GraphAttr)]
+                      if isinstance(v, list) else [])
+            for g in graphs:
+                for r in _external_refs(pm.decode(g.buf), local):
+                    if r not in refs:
+                        refs.append(r)
+        local.update(o for o in node.outputs if o)
+    return refs
+
+
+def _subgraph_fn(m, gattr: _GraphAttr, input_shapes=None):
+    """GraphProto attr → (run, formal_input_names, runtime_captures,
+    n_outputs). ``run(*arrays)`` is jax-traceable and takes the formal
+    inputs followed by the runtime captures. ``input_shapes`` overrides
+    formal-input (shape, dtype) pairs — subgraph value_infos often omit
+    them, but the enclosing rule knows the carried shapes."""
+    sub = OnnxImporter(graph_buf=gattr.buf)
+    gf = pm.decode(gattr.buf)
+    formal = [n for n, _, _ in sub.graph_inputs]
+    runtime_caps: List[str] = []
+    for c in _external_refs(gf):
+        if c in formal:
+            continue
+        if c in m.const_vals:
+            arr = np.asarray(m.const_vals[c])
+            sub.set(c, sub.sd.constant(arr, name=c), const_val=arr)
+        else:
+            ov = m.get(c)
+            sub.set(c, sub.sd.placeholder(c, shape=ov.shape, dtype=ov.dtype))
+            runtime_caps.append(c)
+    for idx, (n, shp, dt) in enumerate(sub.graph_inputs):
+        if input_shapes is not None and idx < len(input_shapes):
+            shp, dt = input_shapes[idx]
+        sub.set(n, sub.sd.placeholder(n, shape=shp, dtype=dt or np.float32))
+    sub.build()
+    outnames = [sub.vars[o].name for o in sub.graph_outputs]
+    ph = formal + runtime_caps
+
+    def run(*arrays):
+        vals = dict(sub.sd._arrays)
+        vals.update(zip(ph, arrays))
+        return sub.sd._trace(vals, outnames)
+
+    return run, formal, runtime_caps, len(outnames)
+
+
+@orule("Loop")
+def _o_loop(m, node):
+    """ONNX Loop → lax.while_loop (loop-carried only) or lax.scan (with
+    scan outputs; needs a static trip count M for XLA-static shapes).
+
+    Early-exit deviation on the scan path: lax.scan always runs M
+    iterations — loop-carried values freeze exactly at the ONNX exit point
+    (masked updates), but scan-output rows PAST the exit hold the frozen
+    state's computation instead of being truncated (ONNX returns a
+    dynamically shorter tensor, which XLA cannot represent)."""
+    import jax
+    import jax.numpy as jnp
+
+    body = node.attr("body")
+    has_M = m.has_input(node, 0)
+    has_cond = m.has_input(node, 1)
+    carried = [m.get(i) for i in node.inputs[2:]]
+    N = len(carried)
+    shapes = [((), np.int64), ((), np.bool_)] + \
+        [(v.shape, v.dtype) for v in carried]
+    run, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
+    if len(formal) != 2 + N:
+        raise NotImplementedError(
+            f"Loop body has {len(formal)} inputs for {N} carried vars")
+    K = n_out - 1 - N
+    cap_vars = [m.get(c) for c in caps]
+
+    M_static = None
+    if has_M:
+        try:
+            M_static = int(np.asarray(m.const(node.inputs[0])))
+        except NotImplementedError:
+            M_static = None
+
+    if K > 0:
+        if M_static is None:
+            raise NotImplementedError(
+                "Loop with scan outputs needs a static trip count M")
+
+        def impl(*args):
+            i = 0
+            cond0 = jnp.asarray(True)
+            if has_cond:
+                cond0 = jnp.reshape(args[0], ()).astype(bool)
+                i = 1
+            carr0 = tuple(args[i:i + N])
+            capsv = tuple(args[i + N:])
+
+            def step(state, it):
+                cond, carr = state
+                outs = run(jnp.asarray(it, jnp.int64), cond, *carr, *capsv)
+                cond2 = cond & jnp.reshape(outs[0], ()).astype(bool)
+                carr2 = tuple(jnp.where(cond, new, old)
+                              for new, old in zip(outs[1:1 + N], carr))
+                return (cond2, carr2), tuple(outs[1 + N:])
+
+            (_, carrf), scans = jax.lax.scan(
+                step, (cond0, carr0), jnp.arange(M_static))
+            return tuple(carrf) + tuple(scans)
+
+        ins = ([m.get(node.inputs[1])] if has_cond else []) + carried + cap_vars
+        outs = m.sd.custom_op(impl, *ins, n_out=N + K,
+                              name=node.name or "loop")
+    else:
+        # static M stays a PYTHON int, clamped to int32 range — torch
+        # exports `while` as Loop with M = INT64_MAX, which would overflow
+        # to a negative under x64-disabled jax and kill the loop
+        dynamic_M = has_M and M_static is None
+
+        def impl(*args):
+            i = 0
+            Mv = None
+            if dynamic_M:
+                Mv = jnp.reshape(args[0], ()).astype(jnp.int32)
+                i = 1
+            elif M_static is not None:
+                Mv = min(M_static, 2**31 - 1)
+            cond0 = jnp.asarray(True)
+            if has_cond:
+                cond0 = jnp.reshape(args[i], ()).astype(bool)
+                i += 1
+            carr0 = tuple(args[i:i + N])
+            capsv = tuple(args[i + N:])
+
+            def cond_fn(st):
+                it, c, _ = st
+                return c & (it < Mv) if Mv is not None else c
+
+            def body_fn(st):
+                it, c, carr = st
+                outs = run(it, c, *carr, *capsv)
+                return (it + 1, jnp.reshape(outs[0], ()).astype(bool),
+                        tuple(outs[1:1 + N]))
+
+            _, _, carrf = jax.lax.while_loop(
+                cond_fn, body_fn,
+                (jnp.asarray(0, jnp.int32), cond0, carr0))
+            return carrf if N > 1 else carrf[0]
+
+        ins = ([m.get(node.inputs[0])] if dynamic_M else []) + \
+            ([m.get(node.inputs[1])] if has_cond else []) + carried + cap_vars
+        outs = m.sd.custom_op(impl, *ins, n_out=N, name=node.name or "loop")
+
+    outs = (outs,) if not isinstance(outs, tuple) else outs
+    for i, o in enumerate(node.outputs):
+        if o:
+            m.set(o, outs[i])
+
+
+@orule("If")
+def _o_if(m, node):
+    import jax
+    import jax.numpy as jnp
+
+    pred = m.get(node.inputs[0])
+    t_run, t_formal, t_caps, nt = _subgraph_fn(m, node.attr("then_branch"))
+    e_run, e_formal, e_caps, ne = _subgraph_fn(m, node.attr("else_branch"))
+    if t_formal or e_formal:
+        raise NotImplementedError("If branches take no formal inputs in ONNX")
+    if nt != ne:
+        raise NotImplementedError("If branch output arity mismatch")
+    caps = list(dict.fromkeys(t_caps + e_caps))
+    t_idx = [caps.index(c) for c in t_caps]
+    e_idx = [caps.index(c) for c in e_caps]
+
+    def impl(p, *a):
+        out = jax.lax.cond(
+            jnp.reshape(p, ()).astype(bool),
+            lambda *xs: tuple(t_run(*[xs[i] for i in t_idx])),
+            lambda *xs: tuple(e_run(*[xs[i] for i in e_idx])),
+            *a)
+        return out if nt > 1 else out[0]
+
+    out = m.sd.custom_op(impl, pred, *[m.get(c) for c in caps], n_out=nt,
+                         name=node.name or "if")
+    out = (out,) if not isinstance(out, tuple) else out
+    for i, o in enumerate(node.outputs):
+        if o:
+            m.set(o, out[i])
+
+
+@orule("Scan")
+def _o_scan(m, node):
+    import jax
+
+    body = node.attr("body")
+    S = int(node.attr("num_scan_inputs"))
+    L = len(node.inputs) - S
+    for a in ("scan_input_axes", "scan_output_axes"):
+        if node.attr(a) and any(int(x) != 0 for x in node.attr(a)):
+            raise NotImplementedError(f"Scan non-zero {a}")
+    for a in ("scan_input_directions", "scan_output_directions"):
+        if node.attr(a) and any(int(x) for x in node.attr(a)):
+            raise NotImplementedError(f"Scan reverse {a}")
+    states = [m.get(i) for i in node.inputs[:L]]
+    scans = [m.get(i) for i in node.inputs[L:]]
+    shapes = [(v.shape, v.dtype) for v in states] + \
+        [((v.shape[1:] if v.shape is not None else None), v.dtype)
+         for v in scans]
+    run, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
+    if len(formal) != L + S:
+        raise NotImplementedError(
+            f"Scan body has {len(formal)} inputs for {L} states + {S} scans")
+    K = n_out - L
+
+    def impl(*args):
+        st0 = tuple(args[:L])
+        sc = tuple(args[L:L + S])
+        capsv = tuple(args[L + S:])
+
+        def step(st, xs):
+            outs = run(*st, *xs, *capsv)
+            return tuple(outs[:L]), tuple(outs[L:])
+
+        stf, ys = jax.lax.scan(step, st0, sc)
+        return tuple(stf) + tuple(ys)
+
+    out = m.sd.custom_op(impl, *states, *scans, *[m.get(c) for c in caps],
+                         n_out=L + K, name=node.name or "scan")
+    out = (out,) if not isinstance(out, tuple) else out
+    for i, o in enumerate(node.outputs):
+        if o:
+            m.set(o, out[i])
+
+
+@orule("Einsum")
+def _o_einsum(m, node):
+    eq = node.attr("equation")
+    if isinstance(eq, bytes):
+        eq = eq.decode()
+    operands = [m.get(i) for i in node.inputs]
+    m.set(node.outputs[0], m.sd._op("einsum_apply", operands,
+                                    attrs=dict(equation=eq),
                                     name=node.outputs[0]))

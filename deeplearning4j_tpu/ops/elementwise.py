@@ -71,6 +71,13 @@ op("mish", "transform_float")(jax.nn.mish)
 op("hard_sigmoid", "transform_float")(lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
 # hardswish (MobileNetV3 / ONNX HardSwish / torch Hardswish): x·relu6(x+3)/6
 op("hardswish", "transform_float", aliases=("hard_swish",))(jax.nn.hard_swish)
+op("celu", "transform_float")(lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+op("thresholded_relu", "transform_float")(
+    lambda x, alpha=1.0: jnp.where(x > alpha, x, 0.0))
+# ONNX Shrink: x < -lambd → x+bias; x > lambd → x-bias; else 0
+op("shrink", "transform_float")(
+    lambda x, lambd=0.5, bias=0.0: jnp.where(
+        x < -lambd, x + bias, jnp.where(x > lambd, x - bias, 0.0)))
 op("hard_tanh", "transform_float", aliases=("hardtanh",))(
     lambda x: jnp.clip(x, -1.0, 1.0)
 )
