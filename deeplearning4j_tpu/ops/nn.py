@@ -697,3 +697,152 @@ def col2im(patches, output_shape, kernel, strides=(1, 1), padding=(0, 0),
     transpose = jax.linear_transpose(
         lambda x: im2col(x, kernel, strides, padding, dilation), shape)
     return transpose(patches)[0]
+
+
+# ------------------------------------------------------------- TF grad ops
+# The reference's *Grad kernels (ReluGrad, FusedBatchNormGrad,
+# Conv2DBackprop*, libnd4j ops/declarable/generic/nn/**_bp.cpp, path-cite)
+# as first-class registry ops, so tf.gradients-exported TRAINING graphs
+# import into serializable SameDiff graphs. The conv backprops are the
+# jax.vjp of this file's own forward ops — XLA emits the same
+# transposed/dilated conv HLO a hand-written kernel would.
+
+
+@op("relu_grad", "transform_float", differentiable=False)
+def relu_grad(dy, f):
+    """TF ReluGrad: f is the relu OUTPUT (y>0 ⟺ x>0, either works)."""
+    return dy * (f > 0).astype(dy.dtype)
+
+
+@op("relu6_grad", "transform_float", differentiable=False)
+def relu6_grad(dy, f):
+    return dy * ((f > 0) & (f < 6)).astype(dy.dtype)
+
+
+@op("tanh_grad", "transform_float", differentiable=False)
+def tanh_grad(y, dy):
+    """TF TanhGrad input order: (y, dy)."""
+    return dy * (1.0 - y * y)
+
+
+@op("sigmoid_grad", "transform_float", differentiable=False)
+def sigmoid_grad(y, dy):
+    return dy * y * (1.0 - y)
+
+
+@op("bias_add_grad", "reduce", differentiable=False)
+def bias_add_grad(dy, data_format="NHWC"):
+    ax = -1 if data_format.endswith("C") else 1
+    red = tuple(i for i in range(dy.ndim) if i != ax % dy.ndim)
+    return jnp.sum(dy, axis=red)
+
+
+@op("conv2d_backprop_input", "conv", differentiable=False)
+def conv2d_backprop_input(w, dy, input_sizes, strides=(1, 1), padding="SAME",
+                          dilation=(1, 1), data_format="NHWC"):
+    x0 = jnp.zeros(tuple(int(s) for s in input_sizes), dy.dtype)
+    _, vjp = jax.vjp(
+        lambda xx: conv2d(xx, w, None, strides=strides, padding=padding,
+                          dilation=dilation, data_format=data_format), x0)
+    return vjp(dy)[0]
+
+
+@op("conv2d_backprop_filter", "conv", differentiable=False)
+def conv2d_backprop_filter(x, dy, filter_sizes, strides=(1, 1),
+                           padding="SAME", dilation=(1, 1),
+                           data_format="NHWC"):
+    w0 = jnp.zeros(tuple(int(s) for s in filter_sizes), dy.dtype)
+    _, vjp = jax.vjp(
+        lambda ww: conv2d(x, ww, None, strides=strides, padding=padding,
+                          dilation=dilation, data_format=data_format), w0)
+    return vjp(dy)[0]
+
+
+@op("maxpool2d_grad", "pooling", differentiable=False)
+def maxpool2d_grad(x, dy, kernel=(2, 2), strides=(2, 2), padding="VALID",
+                   data_format="NHWC"):
+    _, vjp = jax.vjp(
+        lambda xx: max_pool2d(xx, kernel=kernel, strides=strides,
+                              padding=padding, data_format=data_format), x)
+    return vjp(dy)[0]
+
+
+@op("avgpool2d_grad", "pooling", differentiable=False)
+def avgpool2d_grad(x, dy, kernel=(2, 2), strides=(2, 2), padding="VALID",
+                   data_format="NHWC"):
+    _, vjp = jax.vjp(
+        lambda xx: avg_pool2d(xx, kernel=kernel, strides=strides,
+                              padding=padding, data_format=data_format), x)
+    return vjp(dy)[0]
+
+
+@op("fused_batch_norm_grad", "norm", differentiable=False)
+def fused_batch_norm_grad(dy, x, scale, mean_in, var_in, epsilon=1e-3,
+                          is_training=True):
+    """FusedBatchNormGrad(V2/V3) math → (dx, dscale, doffset).
+
+    Training mode recomputes the batch moments from x rather than trusting
+    the reserve-space convention (TF's reserve_space_2 is plain variance on
+    CPU but inverse-stddev on GPU — recomputation sidesteps the split, at
+    one extra fused reduction). Inference mode uses the passed population
+    stats. NHWC; reductions in fp32."""
+    xf = _accf(x)
+    dyf = _accf(dy)
+    red = tuple(range(x.ndim - 1))
+    n = 1.0
+    for i in red:
+        n *= x.shape[i]
+    if is_training:
+        s, s2 = _paired_sums(xf, xf * xf, red)
+        mean = s / n
+        var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    else:
+        mean, var = _accf(mean_in), _accf(var_in)
+    inv = lax.rsqrt(var + epsilon)
+    xhat = (xf - mean) * inv
+    dsum, dxhat_sum = _paired_sums(dyf, dyf * xhat, red)
+    dscale = dxhat_sum
+    doffset = dsum
+    if is_training:
+        dx = (_accf(scale) * inv / n) * (n * dyf - dsum - xhat * dxhat_sum)
+    else:
+        dx = dyf * _accf(scale) * inv
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            doffset.astype(scale.dtype))
+
+
+@op("softmax_cross_entropy_with_logits_grad", "loss", differentiable=False)
+def softmax_cross_entropy_with_logits_grad(logits, labels):
+    """TF SoftmaxCrossEntropyWithLogits: (per-example loss, backprop)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    log_softmax = logits - lse
+    loss = -jnp.sum(labels * log_softmax, axis=-1)
+    backprop = jnp.exp(log_softmax) - labels
+    return loss, backprop
+
+
+@op("strided_slice_grad", "gather_scatter", differentiable=False)
+def strided_slice_grad(dy, shape, spec):
+    """TF StridedSliceGrad: scatter dy into zeros(shape) at the slice the
+    forward took. ``spec`` is the getitem spec format: ("e",) ellipsis,
+    ("n",) new_axis, ("i", i) shrink, ("s", b, e, st) slice."""
+    if any(s[0] == "e" for s in spec) and any(s[0] == "n" for s in spec):
+        raise NotImplementedError("StridedSliceGrad with ellipsis + new_axis")
+    # new_axis entries add a size-1 dim to dy the input never had: squeeze
+    # them (dy axis index = count of preceding dy-producing entries)
+    squeeze = []
+    dy_axis = 0
+    for s in spec:
+        if s[0] == "n":
+            squeeze.append(dy_axis)
+            dy_axis += 1
+        elif s[0] in ("s", "e"):
+            dy_axis += 1
+    if squeeze:
+        dy = jnp.squeeze(dy, axis=tuple(squeeze))
+    idx = tuple(
+        Ellipsis if s[0] == "e"
+        else s[1] if s[0] == "i"
+        else slice(s[1], s[2], s[3])
+        for s in spec if s[0] != "n")
+    return jnp.zeros(tuple(int(d) for d in shape), dy.dtype).at[idx].set(dy)

@@ -24,6 +24,25 @@ IDX = jnp.asarray([0, 1, 1, 0])
 
 # ops whose first argument is not an array (or otherwise special)
 OVERRIDES = {
+    # TF-grad-kernel ops (round 4): (dy, y/x) pairs and conv/pool backprops
+    "relu_grad": lambda f: f(XN, XN),
+    "relu6_grad": lambda f: f(XN, XN),
+    "tanh_grad": lambda f: f(jnp.tanh(XN), XN),
+    "sigmoid_grad": lambda f: f(jax.nn.sigmoid(XN), XN),
+    "bias_add_grad": lambda f: f(IMG),
+    "conv2d_backprop_input": lambda f: f(
+        jnp.ones((2, 2, 6, 3)), jnp.ones((1, 4, 4, 3)),
+        input_sizes=(1, 4, 4, 6)),
+    "conv2d_backprop_filter": lambda f: f(
+        IMG, jnp.ones((1, 4, 4, 3)), filter_sizes=(2, 2, 6, 3)),
+    "maxpool2d_grad": lambda f: f(IMG, jnp.ones((1, 2, 2, 6))),
+    "avgpool2d_grad": lambda f: f(IMG, jnp.ones((1, 2, 2, 6))),
+    "fused_batch_norm_grad": lambda f: f(
+        IMG, IMG, jnp.ones(6), jnp.zeros(6), jnp.ones(6)),
+    "strided_slice_grad": lambda f: f(
+        XN[:2], shape=(4, 6), spec=(("s", 0, 2, 1), ("s", None, None, 1))),
+    "softmax_cross_entropy_with_logits_grad": lambda f: f(
+        XN, jax.nn.one_hot(IDX, 6)),
     "alpha_dropout": lambda f: f(XN, KEY, 0.3, training=True),
     "dropout": lambda f: f(XN, KEY, 0.3, training=True),
     "dropout_inverted": lambda f: f(XN, KEY, 0.3, training=True),
