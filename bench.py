@@ -126,9 +126,19 @@ def throughput(n_dev, global_batch=64, steps=4):
     jax.block_until_ready(jax.tree_util.tree_leaves(net.params)[0])
     return global_batch * steps / (time.perf_counter() - t0)
 
-t1 = throughput(1)
-t8 = throughput(8)
-print(json.dumps({"t1": t1, "t8": t8, "efficiency": t8 / t1}))
+# median-of-3 (VERDICT r3 weak #1): single samples on the 1-core host swing
+# ±15% with scheduler noise — report the median efficiency and the spread
+effs, pairs = [], []
+for _ in range(3):
+    t1 = throughput(1)
+    t8 = throughput(8)
+    effs.append(t8 / t1)
+    pairs.append((t1, t8))
+effs.sort()
+med = effs[1]
+noise = (effs[-1] - effs[0]) / 2.0 / med if med else 0.0
+print(json.dumps({"pairs": pairs, "efficiencies": effs, "efficiency": med,
+                  "noise_frac": round(noise, 4)}))
 """
 
 
@@ -151,7 +161,8 @@ def bench_scaling():
     return {
         "metric": "dp_sharding_efficiency_8dev_virtual_cpu",
         "model": "zoo.ResNet50 32px classes=16 global_batch=64 fp32 (flagship topology, CPU-sized)",
-        "value": round(r["efficiency"], 4),
+        "value": round(r["efficiency"], 4),  # median of 3
+        "noise": f"±{round(100 * r.get('noise_frac', 0), 1)}% (3-sample spread/2, 1-core host)",
         "unit": "fraction",
         "vs_baseline": round(r["efficiency"] / 0.90, 4),  # ≥90% north star
     }
