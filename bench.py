@@ -215,6 +215,51 @@ def bench_attention_2k(batch: int = 4, seq: int = 2048, iters: int = 8):
     }
 
 
+def bench_lstm_char_rnn(batch: int = 128, seq: int = 128, vocab: int = 96,
+                        hidden: int = 512, steps: int = 60):
+    """Tracked metric 4 (BASELINE config #3): GravesLSTM-class char-RNN
+    train-step tokens/sec — 2xLSTM(H) + RnnOutputLayer, one-hot inputs,
+    bf16. Methodology: many steps in flight, completion forced by the final
+    score fetch (the per-step dispatch pipeline amortizes the tunnel
+    latency; XPlane-verified 7.87 ms/step device time at this config,
+    BASELINE.md round-4 table)."""
+    import jax
+
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .compute_dtype("bfloat16").list()
+            .layer(LSTM(n_in=vocab, n_out=hidden))
+            .layer(LSTM(n_in=hidden, n_out=hidden))
+            .layer(RnnOutputLayer(n_in=hidden, n_out=vocab))
+            .set_input_type(InputType.recurrent(vocab, seq))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = jax.device_put(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, seq))])
+    y = jax.device_put(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, seq))])
+    for _ in range(4):
+        net._fit_batch(x, y)
+    float(net.score_value)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net._fit_batch(x, y)
+    float(net.score_value)
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "metric": "lstm_char_rnn_train_tokens_per_sec",
+        "model": f"2xLSTM(H={hidden}) char-RNN B={batch} T={seq} V={vocab} bf16",
+        "value": round(batch * seq / dt),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference number exists (BASELINE.md)
+    }
+
+
 def bench_lenet(batch: int, steps: int):
     import __graft_entry__ as ge
 
@@ -265,6 +310,12 @@ def main():
         except Exception as e:
             print(f"attention bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+    try:
+        extra.append(bench_lstm_char_rnn(
+            batch=128 if on_tpu else 8, seq=128 if on_tpu else 16,
+            hidden=512 if on_tpu else 32, steps=60 if on_tpu else 3))
+    except Exception as e:
+        print(f"lstm bench failed: {type(e).__name__}: {e}", file=sys.stderr)
     result["extra_metrics"] = extra
     print(json.dumps(result))
 

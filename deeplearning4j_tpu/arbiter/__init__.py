@@ -119,6 +119,66 @@ class GridSearchCandidateGenerator:
             yield dict(zip(keys, combo))
 
 
+class GeneticSearchCandidateGenerator:
+    """GeneticSearchCandidateGenerator parity (arbiter-core
+    generator/GeneticSearchCandidateGenerator.java + genetic/* — path-cite,
+    mount empty): population-based search with tournament selection,
+    uniform crossover, and per-gene mutation. The runner feeds scores back
+    through ``report`` (the reference's PopulationModel listener role);
+    each generation after the first is bred from the best of the last."""
+
+    def __init__(self, population_size: int = 8, generations: int = 5,
+                 tournament_k: int = 3, mutation_rate: float = 0.15,
+                 minimize: bool = True, seed: int = 0):
+        self.population_size = population_size
+        self.generations = generations
+        self.tournament_k = tournament_k
+        self.mutation_rate = mutation_rate
+        self.minimize = minimize
+        self.seed = seed
+        self._scored: List["CandidateResult"] = []
+
+    def report(self, result: "CandidateResult"):
+        self._scored.append(result)
+
+    def _select(self, rng, pop_scores):
+        """Tournament selection over (candidate, score) pairs."""
+        picks = [pop_scores[int(rng.integers(0, len(pop_scores)))]
+                 for _ in range(self.tournament_k)]
+        key = (min if self.minimize else max)
+        return key(picks, key=lambda cs: cs[1])[0]
+
+    def _breed(self, rng, a, b, space):
+        child = {}
+        for k in space:
+            child[k] = a[k] if rng.random() < 0.5 else b[k]  # uniform xover
+            if rng.random() < self.mutation_rate:
+                child[k] = space[k].sample(rng)
+        return child
+
+    def candidates(self, space: Dict[str, "ParameterSpace"]):
+        rng = np.random.default_rng(self.seed)
+        population = [{k: s.sample(rng) for k, s in space.items()}
+                      for _ in range(self.population_size)]
+        for gen in range(self.generations):
+            mark = len(self._scored)
+            for cand in population:
+                yield dict(cand)
+            scored = [(r.candidate, r.score) for r in self._scored[mark:]
+                      if not math.isnan(r.score)]
+            if not scored:  # every candidate failed: fresh random restart
+                population = [{k: s.sample(rng) for k, s in space.items()}
+                              for _ in range(self.population_size)]
+                continue
+            # elitism: carry the generation's best through unchanged
+            key = (min if self.minimize else max)
+            elite = key(scored, key=lambda cs: cs[1])[0]
+            population = [dict(elite)] + [
+                self._breed(rng, self._select(rng, scored),
+                            self._select(rng, scored), space)
+                for _ in range(self.population_size - 1)]
+
+
 @dataclasses.dataclass
 class CandidateResult:
     candidate: Dict[str, Any]
@@ -187,6 +247,8 @@ class OptimizationRunner:
                                      error=repr(e))
                 model = None
             results.append(cr)
+            if hasattr(self.generator, "report"):
+                self.generator.report(cr)  # genetic search breeds on scores
             if not math.isnan(cr.score) and (
                 best is None
                 or (self.minimize and cr.score < best.score)

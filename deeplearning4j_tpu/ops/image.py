@@ -249,3 +249,35 @@ def random_crop(key, x, size):
     start[hax], start[wax] = oy, ox
     sizes[hax], sizes[wax] = h, w
     return lax.dynamic_slice(x, start, sizes)
+
+
+@op("ssim", "image", differentiable=False)
+def ssim(a, b, max_val=1.0, filter_size=11, filter_sigma=1.5, k1=0.01,
+         k2=0.03):
+    """Structural similarity, tf.image.ssim semantics (NHWC, gaussian
+    11x11 sigma 1.5 window, per-image mean over space+channels).
+    Reference: generic/parity_ops (image ssim), path-cite."""
+    r = jnp.arange(filter_size, dtype=jnp.float32) - (filter_size - 1) / 2.0
+    g = jnp.exp(-(r ** 2) / (2.0 * filter_sigma ** 2))
+    g = g / jnp.sum(g)
+    win2d = jnp.outer(g, g)                                  # (F, F)
+    c = a.shape[-1]
+    w = jnp.tile(win2d[:, :, None, None], (1, 1, 1, c))      # (F,F,1,C) dw
+
+    def filt(x):
+        return jax.lax.conv_general_dilated(
+            x.astype(jnp.float32), w.astype(jnp.float32), (1, 1), "VALID",
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                x.shape, w.shape, ("NHWC", "HWIO", "NHWC")),
+            feature_group_count=c)
+
+    c1 = (k1 * max_val) ** 2
+    c2 = (k2 * max_val) ** 2
+    mu_a, mu_b = filt(a), filt(b)
+    aa, bb, ab = filt(a * a), filt(b * b), filt(a * b)
+    va = aa - mu_a * mu_a
+    vb = bb - mu_b * mu_b
+    cov = ab - mu_a * mu_b
+    lum = (2.0 * mu_a * mu_b + c1) / (mu_a ** 2 + mu_b ** 2 + c1)
+    cs = (2.0 * cov + c2) / (va + vb + c2)
+    return jnp.mean(lum * cs, axis=(1, 2, 3))

@@ -516,3 +516,26 @@ def matrix_band_part(x, num_lower, num_upper):
     if num_upper >= 0:
         keep = keep & (j - i <= num_upper)
     return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+@op("mergeadd", "pairwise", aliases=("mergesum", "accumulate_n"))
+def mergeadd(*xs):
+    """Elementwise sum of N arrays (generic/broadcastable/mergeadd.cpp,
+    path-cite) — the op form of the MergeVertex 'add' mode."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@op("mergeavg", "pairwise")
+def mergeavg(*xs):
+    return mergeadd(*xs) / float(len(xs))
+
+
+@op("mergemax", "pairwise")
+def mergemax(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out

@@ -26,3 +26,7 @@ from deeplearning4j_tpu.rl4j.a2c import (  # noqa: F401
     A2CDiscreteDense,
     ACPolicy,
 )
+from deeplearning4j_tpu.rl4j.a3c import (  # noqa: F401
+    A3CConfiguration,
+    A3CDiscreteDense,
+)

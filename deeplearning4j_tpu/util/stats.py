@@ -26,13 +26,20 @@ import jax
 import numpy as np
 
 
-def _summary(arr) -> Dict[str, float]:
+def _summary(arr, bins: int = 0) -> Dict[str, float]:
     a = np.asarray(arr, np.float64)
-    return {
+    out = {
         "mean": float(a.mean()), "std": float(a.std()),
         "min": float(a.min()), "max": float(a.max()),
         "l2": float(np.linalg.norm(a)),
     }
+    if bins:
+        # histogram bins for the UI histogram pages (DL4J model-page
+        # parameter/update histograms)
+        counts, edges = np.histogram(a.ravel(), bins=bins)
+        out["hist"] = [int(c) for c in counts]
+        out["hist_range"] = [float(edges[0]), float(edges[-1])]
+    return out
 
 
 class InMemoryStatsStorage:
@@ -82,11 +89,12 @@ class StatsListener:
     StatsStorage every ``frequency`` iterations."""
 
     def __init__(self, storage, frequency: int = 1, session_id: Optional[str] = None,
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True, histogram_bins: int = 30):
         self.storage = storage
         self.frequency = frequency
         self.session_id = session_id or f"session_{int(time.time())}"
         self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
         self._last_ns = None
         self._prev_params = None
 
@@ -113,10 +121,12 @@ class StatsListener:
                 for k, v in p.items():
                     if isinstance(v, dict):
                         continue
-                    params_stats[f"layer{i}.{k}"] = _summary(v)
+                    params_stats[f"layer{i}.{k}"] = _summary(
+                        v, bins=self.histogram_bins)
                     if self._prev_params is not None:
                         update_stats[f"layer{i}.{k}"] = _summary(
-                            np.asarray(v) - self._prev_params[i][k])
+                            np.asarray(v) - self._prev_params[i][k],
+                            bins=self.histogram_bins)
             rec["params"] = params_stats
             if update_stats:
                 rec["updates"] = update_stats

@@ -139,3 +139,76 @@ class TestRL:
         learner = A2CDiscreteDense(lambda: SimpleToyMDP(length=6), conf).train()
         total = learner.get_policy().play(SimpleToyMDP(length=6))
         assert total >= 1.0, total
+
+
+class TestA3C:
+    def test_a3c_async_learns_toy_chain(self):
+        """ASYNC A3C (VERDICT r3 J21 tail): 4 actor-learner threads, stale
+        gradients, shared Adam under a lock — learns the toy chain."""
+        from deeplearning4j_tpu.rl4j import A3CConfiguration, A3CDiscreteDense
+
+        conf = A3CConfiguration(max_updates=400, num_threads=4, n_steps=8,
+                                hidden=(32,), seed=0)
+        learner = A3CDiscreteDense(lambda: SimpleToyMDP(length=6),
+                                   conf).train()
+        assert learner._updates_done >= conf.max_updates
+        total = learner.get_policy().play(SimpleToyMDP(length=6))
+        assert total >= 1.0, total
+
+
+class TestGeneticSearch:
+    def test_genetic_beats_its_first_generation(self):
+        """GeneticSearchCandidateGenerator parity: population breeding must
+        IMPROVE across generations on a smooth objective (and beat plain
+        random search at equal budget)."""
+        from deeplearning4j_tpu.arbiter import (
+            GeneticSearchCandidateGenerator,
+            OptimizationRunner,
+        )
+
+        space = {"x": ContinuousParameterSpace(-4.0, 4.0),
+                 "y": ContinuousParameterSpace(-4.0, 4.0)}
+
+        def objective(c):
+            return (c["x"] - 1.0) ** 2 + (c["y"] + 2.0) ** 2
+
+        gen = GeneticSearchCandidateGenerator(
+            population_size=10, generations=8, seed=3)
+        runner = OptimizationRunner(
+            space, gen, model_builder=lambda c: c,
+            score_fn=objective, minimize=True)
+        res = runner.execute()
+        pop = gen.population_size
+        first_gen_best = min(r.score for r in res.results[:pop])
+        assert res.best_score < first_gen_best, \
+            (res.best_score, first_gen_best)
+        assert res.best_score < 0.15, res.best_score
+
+        rnd = RandomSearchGenerator(num_candidates=pop * 8, seed=3)
+        rnd_runner = OptimizationRunner(
+            space, rnd, model_builder=lambda c: c, score_fn=objective,
+            minimize=True)
+        rnd_best = rnd_runner.execute().best_score
+        assert res.best_score <= rnd_best, (res.best_score, rnd_best)
+
+    def test_genetic_survives_failing_candidates(self):
+        from deeplearning4j_tpu.arbiter import (
+            GeneticSearchCandidateGenerator,
+            OptimizationRunner,
+        )
+
+        space = {"x": ContinuousParameterSpace(-1.0, 1.0)}
+        calls = []
+
+        def flaky(c):
+            calls.append(c)
+            if len(calls) % 3 == 0:
+                raise RuntimeError("boom")
+            return c["x"] ** 2
+
+        gen = GeneticSearchCandidateGenerator(
+            population_size=6, generations=3, seed=0)
+        res = OptimizationRunner(space, gen, model_builder=lambda c: c,
+                                 score_fn=flaky, minimize=True).execute()
+        assert res.best_candidate is not None
+        assert sum(1 for r in res.results if r.error) > 0

@@ -398,3 +398,43 @@ class TestLongTailOps:
                         jnp.asarray([1, 2], jnp.int32))
         assert r.shape == (2,) and r.dtype == jnp.int16
         np.testing.assert_array_equal(np.asarray(r), [2, 4])
+
+
+class TestRound4OpTail:
+    """VERDICT r3 missing #4 / next-round #10: merge ops, ssim, hardswish."""
+
+    def test_merge_family(self, rng):
+        from deeplearning4j_tpu.ops import registry
+
+        a, b, c = (rng.standard_normal((3, 4)).astype(np.float32)
+                   for _ in range(3))
+        np.testing.assert_allclose(
+            np.asarray(registry.exec_op("mergeadd", a, b, c)), a + b + c,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(registry.exec_op("mergeavg", a, b, c)),
+            (a + b + c) / 3, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(registry.exec_op("mergemax", a, b, c)),
+            np.maximum(np.maximum(a, b), c), rtol=1e-6)
+
+    def test_ssim_matches_tf(self, rng):
+        tf = pytest.importorskip("tensorflow")
+        from deeplearning4j_tpu.ops import registry
+
+        a = rng.random((2, 32, 32, 3)).astype(np.float32)
+        b = np.clip(a + rng.normal(size=a.shape).astype(np.float32) * 0.05,
+                    0, 1).astype(np.float32)
+        ours = np.asarray(registry.exec_op("ssim", a, b))
+        golden = tf.image.ssim(tf.constant(a), tf.constant(b),
+                               max_val=1.0).numpy()
+        np.testing.assert_allclose(ours, golden, atol=1e-5)
+
+    def test_hardswish_matches_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        from deeplearning4j_tpu.ops import registry
+
+        x = rng.standard_normal(32).astype(np.float32)
+        ours = np.asarray(registry.exec_op("hardswish", x))
+        golden = torch.nn.functional.hardswish(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(ours, golden, atol=1e-6)
