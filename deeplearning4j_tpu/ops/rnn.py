@@ -367,3 +367,68 @@ def conv_lstm_2d(x, W, U, b=None, h0=None, c0=None, *, stride=(1, 1),
     (h_fin, c_fin), y = lax.scan(body, (h_init, c_init),
                                  jnp.swapaxes(xp, 0, 1))
     return jnp.swapaxes(y, 0, 1), (h_fin, c_fin)
+
+
+def _lstm_block_step(xt, cs_prev, h_prev, W, b, wci, wcf, wco, *,
+                     forget_bias, cell_clip, use_peephole):
+    """One TF-BlockLSTM step. Gate order i, ci(g), f, o; returns the seven
+    per-step tensors the TF kernel exposes."""
+    z = jnp.concatenate([xt, h_prev], axis=1) @ W + b
+    i, ci, f, o = jnp.split(z, 4, axis=-1)
+    if use_peephole:
+        i = i + cs_prev * wci
+        f = f + cs_prev * wcf
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    ci = jnp.tanh(ci)
+    cs = ci * i + cs_prev * f
+    if cell_clip > 0:
+        cs = jnp.clip(cs, -cell_clip, cell_clip)
+    if use_peephole:
+        o = o + cs * wco
+    o = jax.nn.sigmoid(o)
+    co = jnp.tanh(cs)
+    h = co * o
+    return i, cs, f, o, ci, co, h
+
+
+@op("lstm_block_cell", "rnn", aliases=("lstmBlockCell",))
+def lstm_block_cell(x, cs_prev, h_prev, W, wci, wcf, wco, b, *,
+                    forget_bias=1.0, cell_clip=-1.0, use_peephole=False):
+    """Fused single-step LSTM cell, TF LSTMBlockCell / libnd4j lstmBlockCell
+    contract (ops/declarable/generic/recurrent/lstmBlockCell.cpp, path-cite
+    — mount empty): x (B,I); W ((I+H),4H) with gate order i,c,f,o; optional
+    peepholes. Returns (i, cs, f, o, ci, co, h)."""
+    return _lstm_block_step(x, cs_prev, h_prev, W, b, wci, wcf, wco,
+                            forget_bias=forget_bias, cell_clip=cell_clip,
+                            use_peephole=use_peephole)
+
+
+@op("lstm_block", "rnn", aliases=("lstmBlock", "block_lstm"))
+def lstm_block(seq_len_max, x, cs_prev, h_prev, W, wci, wcf, wco, b, *,
+               forget_bias=1.0, cell_clip=-1.0, use_peephole=False):
+    """Fused whole-sequence LSTM, TF BlockLSTM(V2) / libnd4j lstmBlock
+    contract (recurrent/lstmBlock.cpp, path-cite): x (T,B,I); one scan with
+    the projection fused per step; steps at or past ``seq_len_max`` emit
+    zeros and carry the state through unchanged (the TF kernel's
+    sequence-length semantics). Returns seven (T,B,H) stacks
+    (i, cs, f, o, ci, co, h)."""
+    T = x.shape[0]
+    limit = jnp.asarray(seq_len_max, jnp.int32)
+
+    def body(carry, inp):
+        cs_p, h_p = carry
+        xt, t = inp
+        outs = _lstm_block_step(xt, cs_p, h_p, W, b, wci, wcf, wco,
+                                forget_bias=forget_bias,
+                                cell_clip=cell_clip,
+                                use_peephole=use_peephole)
+        active = (t < limit)
+        zeros = tuple(jnp.where(active, v, jnp.zeros_like(v)) for v in outs)
+        cs_new = jnp.where(active, outs[1], cs_p)
+        h_new = jnp.where(active, outs[6], h_p)
+        return (cs_new, h_new), zeros
+
+    (_, _), ys = lax.scan(body, (cs_prev, h_prev),
+                          (x, jnp.arange(T, dtype=jnp.int32)))
+    return ys

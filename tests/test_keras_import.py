@@ -457,3 +457,45 @@ def test_keras_v3_format_bidirectional(rng, tmp_path):
     ])
     x = rng.normal(size=(2, 6, 4)).astype(np.float32)
     _roundtrip_v3(m, x, tmp_path)
+
+
+class TestKerasFullModelCorpus:
+    """Architecture-scale corpus for the KERAS importer (J13) — the
+    keras-side analogue of tests/test_import_corpus.py: full
+    keras.applications functional graphs saved as .keras v3 zips, imported
+    into ComputationGraphs, golden-matched against keras itself. Covers
+    residual adds (ResNet50), inverted residuals + BN6 (MobileNetV2),
+    depthwise-separable towers (Xception), dense concat blocks
+    (DenseNet121)."""
+
+    RES = 64
+
+    def _builders(self):
+        return {
+            "ResNet50": lambda: tf.keras.applications.ResNet50(
+                weights=None, include_top=False,
+                input_shape=(self.RES, self.RES, 3), pooling="avg"),
+            "MobileNetV2": lambda: tf.keras.applications.MobileNetV2(
+                weights=None, include_top=False,
+                input_shape=(self.RES, self.RES, 3), pooling="avg"),
+            "Xception": lambda: tf.keras.applications.Xception(
+                weights=None, include_top=False, input_shape=(96, 96, 3),
+                pooling="avg"),
+            "DenseNet121": lambda: tf.keras.applications.DenseNet121(
+                weights=None, include_top=False,
+                input_shape=(self.RES, self.RES, 3), pooling="avg"),
+        }
+
+    @pytest.mark.parametrize("name", ["ResNet50", "MobileNetV2", "Xception",
+                                      "DenseNet121"])
+    def test_applications_golden(self, name, tmp_path, rng):
+        tf.keras.utils.set_random_seed(7)
+        model = self._builders()[name]()
+        path = str(tmp_path / f"{name}.keras")
+        model.save(path)
+        shp = model.input_shape[1:]
+        x = rng.normal(size=(2,) + shp).astype(np.float32)
+        golden = model(x, training=False).numpy()
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        out = np.asarray(net.output(x))
+        np.testing.assert_allclose(out, golden, atol=1e-4, rtol=1e-4)

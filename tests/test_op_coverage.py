@@ -26,6 +26,14 @@ IDX = jnp.asarray([0, 1, 1, 0])
 OVERRIDES = {
     "ssim": lambda f: f(jnp.ones((1, 16, 16, 3)), jnp.ones((1, 16, 16, 3)) * 0.5,
                         filter_size=5),
+    "lstm_block": lambda f: f(
+        3, jnp.ones((4, 2, 3)), jnp.zeros((2, 5)), jnp.zeros((2, 5)),
+        jnp.ones((8, 20)) * 0.1, jnp.zeros(5), jnp.zeros(5), jnp.zeros(5),
+        jnp.zeros(20)),
+    "lstm_block_cell": lambda f: f(
+        jnp.ones((2, 3)), jnp.zeros((2, 5)), jnp.zeros((2, 5)),
+        jnp.ones((8, 20)) * 0.1, jnp.zeros(5), jnp.zeros(5), jnp.zeros(5),
+        jnp.zeros(20)),
     "mergeadd": lambda f: f(XN, XN, XN),
     "mergeavg": lambda f: f(XN, XN, XN),
     "mergemax": lambda f: f(XN, XN, XN),
