@@ -359,3 +359,10 @@ def clip_by_avg_norm(x, clip_value, axes=None):
     scale = jnp.where(avg > clip_value, clip_value / jnp.maximum(avg, 1e-12),
                       1.0)
     return x * scale
+
+# round-4 tail (generic/parity_ops stragglers, path-cite — mount empty)
+op("expint", "transform_float")(jax.scipy.special.expi)
+# legacy PowDerivative transform: d/dx x^p = p·x^(p-1)
+op("pow_derivative", "scalar")(lambda x, p=2.0: p * jnp.power(x, p - 1.0))
+op("fill_like", "transform_same", aliases=("full_like",))(
+    lambda x, value=0.0: jnp.full_like(x, value))

@@ -179,3 +179,16 @@ def triu(x, k=0):
 @op("tril", "linalg")
 def tril(x, k=0):
     return jnp.tril(x, k)
+
+
+op("kron", "linalg")(jnp.kron)
+op("vander", "linalg", differentiable=False)(
+    lambda x, n=None, increasing=False: jnp.vander(x, N=n,
+                                                   increasing=increasing))
+
+
+@op("toeplitz", "linalg", differentiable=False)
+def toeplitz(c, r=None):
+    import jax.scipy.linalg as jsl
+
+    return jsl.toeplitz(c) if r is None else jsl.toeplitz(c, r)

@@ -846,3 +846,28 @@ def strided_slice_grad(dy, shape, spec):
         else slice(s[1], s[2], s[3])
         for s in spec if s[0] != "n")
     return jnp.zeros(tuple(int(d) for d in shape), dy.dtype).at[idx].set(dy)
+
+
+@op("normalize_moments", "norm", differentiable=False)
+def normalize_moments(counts, mean_ss, variance_ss, shift=None):
+    """TF NormalizeMoments: sufficient statistics → (mean, variance)."""
+    divisor = 1.0 / counts
+    if shift is not None:
+        shifted_mean = mean_ss * divisor
+        mean = shifted_mean + shift
+    else:
+        shifted_mean = mean = mean_ss * divisor
+    variance = variance_ss * divisor - shifted_mean * shifted_mean
+    return mean, variance
+
+
+@op("log_poisson_loss", "loss")
+def log_poisson_loss(log_input, targets, compute_full_loss=False):
+    """TF nn.log_poisson_loss: exp(c) − z·c (+ Stirling when full)."""
+    loss = jnp.exp(log_input) - targets * log_input
+    if compute_full_loss:
+        stirling = (targets * jnp.log(jnp.maximum(targets, 1e-12))
+                    - targets + 0.5 * jnp.log(2.0 * jnp.pi
+                                              * jnp.maximum(targets, 1.0)))
+        loss = loss + jnp.where(targets >= 1.0, stirling, 0.0)
+    return loss

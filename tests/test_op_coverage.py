@@ -26,6 +26,12 @@ IDX = jnp.asarray([0, 1, 1, 0])
 OVERRIDES = {
     "ssim": lambda f: f(jnp.ones((1, 16, 16, 3)), jnp.ones((1, 16, 16, 3)) * 0.5,
                         filter_size=5),
+    "kron": lambda f: f(XN[:2, :2], XN[:3, :3]),
+    "vander": lambda f: f(jnp.asarray([1.0, 2.0, 3.0])),
+    "normalize_moments": lambda f: f(
+        jnp.float32(8.0), jnp.asarray([4.0, 8.0]), jnp.asarray([10.0, 40.0])),
+    "log_poisson_loss": lambda f: f(XN, jnp.abs(XN)),
+    "toeplitz": lambda f: f(jnp.asarray([1.0, 2.0, 3.0])),
     "lstm_block": lambda f: f(
         3, jnp.ones((4, 2, 3)), jnp.zeros((2, 5)), jnp.zeros((2, 5)),
         jnp.ones((8, 20)) * 0.1, jnp.zeros(5), jnp.zeros(5), jnp.zeros(5),
