@@ -13,6 +13,7 @@ from deeplearning4j_tpu.zoo.bert import Bert  # noqa: F401
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet,
     Darknet19,
+    FaceNetNN4Small2,
     LeNet,
     ResNet50,
     SimpleCNN,

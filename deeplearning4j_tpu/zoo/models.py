@@ -646,3 +646,83 @@ class InceptionResNetV1(ZooModel):
         gb.set_outputs("output")
         gb.set_input_types(InputType.convolutional(h, w, c))
         return gb.build()
+
+
+@dataclasses.dataclass
+class FaceNetNN4Small2(ZooModel):
+    """zoo/model/FaceNetNN4Small2.java — the OpenFace nn4.small2 inception
+    face-embedding net (path-cite, mount empty): 7×7/2 stem, inception-2
+    3a/3b/3c/4a/4e/5a/5b mixed modules (1×1 + reduced 3×3 + reduced 5×5 +
+    pool-projection branches), avg pool, 128-d L2-normalized embedding,
+    softmax head for classifier training."""
+
+    input_shape: Tuple[int, int, int] = (96, 96, 3)
+    embedding_size: int = 128
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.vertices import L2NormalizeVertex
+
+        h, w, c = self.input_shape
+        gb = self._builder().graph_builder().add_inputs("input")
+        uid = [0]
+
+        def conv_bn(inp, n_out, k, stride=(1, 1), pad="SAME"):
+            uid[0] += 1
+            name = f"f{uid[0]}"
+            gb.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel_size=(k, k) if isinstance(k, int) else k,
+                stride=stride, padding=pad, has_bias=False), inp)
+            gb.add_layer(f"{name}_b", BatchNormalization(), f"{name}_c")
+            gb.add_layer(f"{name}_r", ActivationLayer(activation="relu"),
+                         f"{name}_b")
+            return f"{name}_r"
+
+        def inception(inp, c1, r3, c3, r5, c5, pool_proj, stride=(1, 1)):
+            """nn4.small2 mixed module; any branch with 0 channels is
+            omitted (the reference's 3c/4e reduction modules)."""
+            uid[0] += 1
+            name = f"inc{uid[0]}"
+            branches = []
+            if c1:
+                branches.append(conv_bn(inp, c1, 1))
+            if c3:
+                branches.append(conv_bn(conv_bn(inp, r3, 1), c3, 3,
+                                        stride=stride))
+            if c5:
+                branches.append(conv_bn(conv_bn(inp, r5, 1), c5, 5,
+                                        stride=stride))
+            pname = f"{name}_pool"
+            gb.add_layer(pname, SubsamplingLayer(
+                kernel_size=(3, 3), stride=stride, padding="SAME"), inp)
+            branches.append(conv_bn(pname, pool_proj, 1)
+                            if pool_proj else pname)
+            gb.add_vertex(name, MergeVertex(), *branches)
+            return name
+
+        x = conv_bn("input", 64, 7, stride=(2, 2))
+        gb.add_layer("p1", SubsamplingLayer(kernel_size=(3, 3),
+                                            stride=(2, 2), padding="SAME"), x)
+        x = conv_bn("p1", 64, 1)
+        x = conv_bn(x, 192, 3)
+        gb.add_layer("p2", SubsamplingLayer(kernel_size=(3, 3),
+                                            stride=(2, 2), padding="SAME"), x)
+        # nn4.small2 channel table
+        x = inception("p2", 64, 96, 128, 16, 32, 32)       # 3a
+        x = inception(x, 64, 96, 128, 32, 64, 64)          # 3b
+        x = inception(x, 0, 128, 256, 32, 64, 0,
+                      stride=(2, 2))                       # 3c (reduction)
+        x = inception(x, 256, 96, 192, 32, 64, 128)        # 4a
+        x = inception(x, 0, 160, 256, 64, 128, 0,
+                      stride=(2, 2))                       # 4e (reduction)
+        x = inception(x, 256, 96, 384, 0, 0, 96)           # 5a
+        x = inception(x, 256, 96, 384, 0, 0, 96)           # 5b
+        gb.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        gb.add_layer("embedding", DenseLayer(
+            n_in=736, n_out=self.embedding_size), "gap")
+        gb.add_vertex("embed_norm", L2NormalizeVertex(), "embedding")
+        gb.add_layer("output", OutputLayer(n_in=self.embedding_size,
+                                           n_out=self.num_classes),
+                     "embed_norm")
+        gb.set_outputs("output")
+        gb.set_input_types(InputType.convolutional(h, w, c))
+        return gb.build()

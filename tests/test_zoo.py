@@ -90,3 +90,18 @@ def test_resnet50_learns():
     for _ in range(15):
         net.fit(x, y)
     assert net.score(x=x, y=y) < s0
+
+
+def test_facenet_nn4_small2():
+    from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+
+    model = FaceNetNN4Small2(num_classes=11, input_shape=(96, 96, 3))
+    net, out = _fwd(model)
+    assert out.shape == (2, 11)
+    # the embedding the model exists for: 128-d and L2-normalized
+    h, w, c = model.input_shape
+    x = np.random.default_rng(0).normal(size=(2, h, w, c)).astype(np.float32)
+    acts = net.feed_forward(x)
+    emb = np.asarray(acts["embed_norm"])
+    assert emb.shape == (2, 128)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-5)
