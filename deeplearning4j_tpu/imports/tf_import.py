@@ -1729,3 +1729,23 @@ def _lstm_block_cell(m, node):
         name=node.name)
     for i, v in enumerate(outs):
         m.set(node.name, v, slot=i)
+
+
+@rule("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_softmax_ce_grad(m, node):
+    # (features, int labels) → (loss, backprop); lower via onehot + the
+    # dense kernel so both outputs stay a single fused pair
+    ins = m.inputs(node)
+    logits = m.get(ins[0])
+    labels = m.get(ins[1])
+    depth = logits.shape[-1] if logits.shape else None
+    if depth is None or depth < 0:
+        raise UnsupportedOpError(
+            "SparseSoftmaxCrossEntropyWithLogits with unknown class count")
+    onehot = m.sd._op("onehot", [labels], attrs=dict(
+        depth=int(depth), on_value=1.0, off_value=0.0, axis=-1))
+    loss, backprop = m.sd._op(
+        "softmax_cross_entropy_with_logits_grad", [logits, onehot],
+        n_out=2, name=node.name)
+    m.set(node.name, loss, slot=0)
+    m.set(node.name, backprop, slot=1)

@@ -77,6 +77,8 @@ _TF_APPS = {
         weights=None, include_top=False, input_shape=(RES, RES, 3), pooling="avg"),
     "Xception": lambda: tf.keras.applications.Xception(
         weights=None, include_top=False, input_shape=(96, 96, 3), pooling="avg"),
+    "NASNetMobile": lambda: tf.keras.applications.NASNetMobile(
+        weights=None, include_top=False, input_shape=(RES, RES, 3), pooling="avg"),
 }
 
 

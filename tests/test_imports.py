@@ -1382,3 +1382,81 @@ class TestONNXScan:
         )
         with pytest.raises(NotImplementedError, match="reverse"):
             import_onnx(model)
+
+
+class TestONNXNestedControlFlow:
+    """If nested inside a Loop body, with BOTH branches referencing
+    enclosing-MODEL initializers by name (ONNX cross-scope capture) — the
+    recursive capture collection in _external_refs/_subgraph_fn."""
+
+    def test_if_inside_loop_with_outer_captures(self):
+        then_g = _onnx_graph(
+            nodes=[_onnx_node("Identity", ["one"], ["branch_out"])],
+            initializers=[], inputs=[], outputs=["branch_out"], name="then")
+        else_g = _onnx_graph(
+            nodes=[_onnx_node("Identity", ["two"], ["branch_out"])],
+            initializers=[], inputs=[], outputs=["branch_out"], name="else")
+        body = _onnx_graph(
+            nodes=[
+                _onnx_node("Identity", ["cond_in"], ["cond_out"]),
+                _onnx_node("Less", ["s_in", "thresh"], ["small"]),
+                _onnx_node("If", ["small"], ["delta"],
+                           _onnx_attr_graph("then_branch", then_g),
+                           _onnx_attr_graph("else_branch", else_g)),
+                _onnx_node("Add", ["s_in", "delta"], ["s_out"]),
+            ],
+            initializers=[],
+            inputs=[_onnx_input("iter", ()), _onnx_input("cond_in", ()),
+                    _onnx_input("s_in", ())],
+            outputs=["cond_out", "s_out"], name="body")
+        model = _onnx_model(
+            nodes=[_onnx_node("Loop", ["M", "", "s0"], ["s_final"],
+                              _onnx_attr_graph("body", body))],
+            initializers=[
+                _onnx_tensor("M", np.asarray(4, np.int64)),
+                _onnx_tensor("one", np.float32(1.0).reshape(())),
+                _onnx_tensor("two", np.float32(2.0).reshape(())),
+                _onnx_tensor("thresh", np.float32(2.5).reshape(())),
+            ],
+            inputs=[_onnx_input("s0", ())],
+            outputs=["s_final"])
+        sd = import_onnx(model)
+        out = np.asarray(sd.output({"s0": np.float32(0.0)}, ["s_final"])
+                         ["s_final"])
+        # 0 →+1→ 1 →+1→ 2 →+1→ 3 →+2→ 5  (s<2.5 adds one, else two)
+        assert out == np.float32(5.0), out
+
+
+class TestSparseSoftmaxCEImport:
+    def test_sparse_ce_training_graph(self, rng):
+        """tf.gradients graph using SPARSE (int-label) cross entropy — the
+        other loss form real training exports use."""
+        w = tf.Variable(tf.random.normal((6, 3), stddev=0.4, seed=5))
+
+        def step(x, y):
+            with tf.GradientTape() as tape:
+                logits = tf.matmul(x, w)
+                loss = tf.reduce_mean(
+                    tf.nn.sparse_softmax_cross_entropy_with_logits(
+                        labels=y, logits=logits))
+            return [loss, tape.gradient(loss, w)]
+
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        conc = tf.function(step).get_concrete_function(
+            tf.TensorSpec((8, 6), tf.float32),
+            tf.TensorSpec((8,), tf.int32))
+        frozen = convert_variables_to_constants_v2(conc)
+        x = rng.normal(size=(8, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=8).astype(np.int32)
+        golden = [np.asarray(t) for t in frozen(tf.constant(x),
+                                                tf.constant(y))]
+        sd = import_graph_def(frozen.graph.as_graph_def())
+        in_names = [i.name.split(":")[0] for i in frozen.inputs]
+        keys = [sd.tf_name_map[o.name] for o in frozen.outputs]
+        res = sd.output({in_names[0]: x, in_names[1]: y}, keys)
+        for key, g in zip(keys, golden):
+            np.testing.assert_allclose(np.asarray(res[key]), g, atol=1e-5,
+                                       rtol=1e-4)
