@@ -1228,29 +1228,25 @@ def _subgraph_fn(m, gattr: _GraphAttr, input_shapes=None):
         sub.set(n, sub.sd.placeholder(n, shape=shp, dtype=dt or np.float32))
     sub.build()
     outnames = [sub.vars[o].name for o in sub.graph_outputs]
-    ph = formal + runtime_caps
+    from deeplearning4j_tpu.samediff.core import make_subgraph_spec
 
-    def run(*arrays):
-        vals = dict(sub.sd._arrays)
-        vals.update(zip(ph, arrays))
-        return sub.sd._trace(vals, outnames)
-
-    return run, formal, runtime_caps, len(outnames)
+    spec = make_subgraph_spec(sub.sd, formal + runtime_caps, outnames)
+    return spec, formal, runtime_caps, len(outnames)
 
 
 @orule("Loop")
 def _o_loop(m, node):
-    """ONNX Loop → lax.while_loop (loop-carried only) or lax.scan (with
-    scan outputs; needs a static trip count M for XLA-static shapes).
+    """ONNX Loop → ONE serializable ``__cf_loop__`` node: lax.while_loop
+    (loop-carried only) or lax.scan (with scan outputs; needs a static trip
+    count M for XLA-static shapes) — execution in samediff.core._exec_cf.
 
     Early-exit deviation on the scan path: lax.scan always runs M
     iterations — loop-carried values freeze exactly at the ONNX exit point
     (masked updates), but scan-output rows PAST the exit hold the frozen
     state's computation instead of being truncated (ONNX returns a
-    dynamically shorter tensor, which XLA cannot represent)."""
-    import jax
-    import jax.numpy as jnp
-
+    dynamically shorter tensor, which XLA cannot represent). A static M
+    stays a PYTHON int clamped to int32 (torch exports `while` as Loop with
+    M=INT64_MAX, which would overflow under x64-disabled jax)."""
     body = node.attr("body")
     has_M = m.has_input(node, 0)
     has_cond = m.has_input(node, 1)
@@ -1258,7 +1254,7 @@ def _o_loop(m, node):
     N = len(carried)
     shapes = [((), np.int64), ((), np.bool_)] + \
         [(v.shape, v.dtype) for v in carried]
-    run, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
+    spec, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
     if len(formal) != 2 + N:
         raise NotImplementedError(
             f"Loop body has {len(formal)} inputs for {N} carried vars")
@@ -1271,76 +1267,17 @@ def _o_loop(m, node):
             M_static = int(np.asarray(m.const(node.inputs[0])))
         except NotImplementedError:
             M_static = None
+    if K > 0 and M_static is None:
+        raise NotImplementedError(
+            "Loop with scan outputs needs a static trip count M")
+    dynamic_M = has_M and M_static is None
 
-    if K > 0:
-        if M_static is None:
-            raise NotImplementedError(
-                "Loop with scan outputs needs a static trip count M")
-
-        def impl(*args):
-            i = 0
-            cond0 = jnp.asarray(True)
-            if has_cond:
-                cond0 = jnp.reshape(args[0], ()).astype(bool)
-                i = 1
-            carr0 = tuple(args[i:i + N])
-            capsv = tuple(args[i + N:])
-
-            def step(state, it):
-                cond, carr = state
-                outs = run(jnp.asarray(it, jnp.int64), cond, *carr, *capsv)
-                cond2 = cond & jnp.reshape(outs[0], ()).astype(bool)
-                carr2 = tuple(jnp.where(cond, new, old)
-                              for new, old in zip(outs[1:1 + N], carr))
-                return (cond2, carr2), tuple(outs[1 + N:])
-
-            (_, carrf), scans = jax.lax.scan(
-                step, (cond0, carr0), jnp.arange(M_static))
-            return tuple(carrf) + tuple(scans)
-
-        ins = ([m.get(node.inputs[1])] if has_cond else []) + carried + cap_vars
-        outs = m.sd.custom_op(impl, *ins, n_out=N + K,
-                              name=node.name or "loop")
-    else:
-        # static M stays a PYTHON int, clamped to int32 range — torch
-        # exports `while` as Loop with M = INT64_MAX, which would overflow
-        # to a negative under x64-disabled jax and kill the loop
-        dynamic_M = has_M and M_static is None
-
-        def impl(*args):
-            i = 0
-            Mv = None
-            if dynamic_M:
-                Mv = jnp.reshape(args[0], ()).astype(jnp.int32)
-                i = 1
-            elif M_static is not None:
-                Mv = min(M_static, 2**31 - 1)
-            cond0 = jnp.asarray(True)
-            if has_cond:
-                cond0 = jnp.reshape(args[i], ()).astype(bool)
-                i += 1
-            carr0 = tuple(args[i:i + N])
-            capsv = tuple(args[i + N:])
-
-            def cond_fn(st):
-                it, c, _ = st
-                return c & (it < Mv) if Mv is not None else c
-
-            def body_fn(st):
-                it, c, carr = st
-                outs = run(it, c, *carr, *capsv)
-                return (it + 1, jnp.reshape(outs[0], ()).astype(bool),
-                        tuple(outs[1:1 + N]))
-
-            _, _, carrf = jax.lax.while_loop(
-                cond_fn, body_fn,
-                (jnp.asarray(0, jnp.int32), cond0, carr0))
-            return carrf if N > 1 else carrf[0]
-
-        ins = ([m.get(node.inputs[0])] if dynamic_M else []) + \
-            ([m.get(node.inputs[1])] if has_cond else []) + carried + cap_vars
-        outs = m.sd.custom_op(impl, *ins, n_out=N, name=node.name or "loop")
-
+    ins = ([m.get(node.inputs[0])] if dynamic_M else []) + \
+        ([m.get(node.inputs[1])] if has_cond else []) + carried + cap_vars
+    outs = m.sd._op("__cf_loop__", ins, attrs=dict(
+        body_spec=spec, n_carried=N, n_scan_out=K, has_cond=has_cond,
+        m_static=M_static, dynamic_m=dynamic_M), n_out=N + K,
+        name=node.name or "loop")
     outs = (outs,) if not isinstance(outs, tuple) else outs
     for i, o in enumerate(node.outputs):
         if o:
@@ -1349,30 +1286,20 @@ def _o_loop(m, node):
 
 @orule("If")
 def _o_if(m, node):
-    import jax
-    import jax.numpy as jnp
-
     pred = m.get(node.inputs[0])
-    t_run, t_formal, t_caps, nt = _subgraph_fn(m, node.attr("then_branch"))
-    e_run, e_formal, e_caps, ne = _subgraph_fn(m, node.attr("else_branch"))
+    t_spec, t_formal, t_caps, nt = _subgraph_fn(m, node.attr("then_branch"))
+    e_spec, e_formal, e_caps, ne = _subgraph_fn(m, node.attr("else_branch"))
     if t_formal or e_formal:
         raise NotImplementedError("If branches take no formal inputs in ONNX")
     if nt != ne:
         raise NotImplementedError("If branch output arity mismatch")
     caps = list(dict.fromkeys(t_caps + e_caps))
-    t_idx = [caps.index(c) for c in t_caps]
-    e_idx = [caps.index(c) for c in e_caps]
-
-    def impl(p, *a):
-        out = jax.lax.cond(
-            jnp.reshape(p, ()).astype(bool),
-            lambda *xs: tuple(t_run(*[xs[i] for i in t_idx])),
-            lambda *xs: tuple(e_run(*[xs[i] for i in e_idx])),
-            *a)
-        return out if nt > 1 else out[0]
-
-    out = m.sd.custom_op(impl, pred, *[m.get(c) for c in caps], n_out=nt,
-                         name=node.name or "if")
+    out = m.sd._op("__cf_if__", [pred] + [m.get(c) for c in caps],
+                   attrs=dict(then_spec=t_spec, else_spec=e_spec,
+                              t_idx=[caps.index(c) for c in t_caps],
+                              e_idx=[caps.index(c) for c in e_caps],
+                              n_out=nt),
+                   n_out=nt, name=node.name or "if")
     out = (out,) if not isinstance(out, tuple) else out
     for i, o in enumerate(node.outputs):
         if o:
@@ -1381,8 +1308,6 @@ def _o_if(m, node):
 
 @orule("Scan")
 def _o_scan(m, node):
-    import jax
-
     body = node.attr("body")
     S = int(node.attr("num_scan_inputs"))
     L = len(node.inputs) - S
@@ -1397,26 +1322,15 @@ def _o_scan(m, node):
     shapes = [(v.shape, v.dtype) for v in states] + \
         [((v.shape[1:] if v.shape is not None else None), v.dtype)
          for v in scans]
-    run, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
+    spec, formal, caps, n_out = _subgraph_fn(m, body, input_shapes=shapes)
     if len(formal) != L + S:
         raise NotImplementedError(
             f"Scan body has {len(formal)} inputs for {L} states + {S} scans")
     K = n_out - L
-
-    def impl(*args):
-        st0 = tuple(args[:L])
-        sc = tuple(args[L:L + S])
-        capsv = tuple(args[L + S:])
-
-        def step(st, xs):
-            outs = run(*st, *xs, *capsv)
-            return tuple(outs[:L]), tuple(outs[L:])
-
-        stf, ys = jax.lax.scan(step, st0, sc)
-        return tuple(stf) + tuple(ys)
-
-    out = m.sd.custom_op(impl, *states, *scans, *[m.get(c) for c in caps],
-                         n_out=L + K, name=node.name or "scan")
+    out = m.sd._op("__cf_scan__",
+                   states + scans + [m.get(c) for c in caps],
+                   attrs=dict(body_spec=spec, n_state=L, n_scan=S),
+                   n_out=L + K, name=node.name or "scan")
     out = (out,) if not isinstance(out, tuple) else out
     for i, o in enumerate(node.outputs):
         if o:
