@@ -820,14 +820,15 @@ def _detect_frames(m):
 
 
 def _subgraph_callable(m, member_names, seeds, targets, frame_name=None):
-    """Compile frame member nodes into fn(list-of-arrays)->list-of-arrays.
+    """Compile frame member nodes into a scratch SameDiff:
+    returns (sub_sd, placeholder_names, target_names).
 
-    ``seeds``: tensor keys pre-bound to the function's array arguments;
+    ``seeds``: tensor keys pre-bound to the subgraph's array arguments;
     ``targets``: tensor keys to return. Member nodes are re-imported into a
-    scratch SameDiff via the ordinary rules, then traced array-level (the
-    closure is jax-traceable, so it works inside lax.while_loop/cond).
+    scratch SameDiff via the ordinary rules; the caller serializes it into
+    a __cf_while__ spec (round 4 — the closure form could not save).
     ``frame_name``: the frame whose body/cond this is — frames nested
-    directly inside it are recursively emitted as lax.while_loop nodes of
+    directly inside it are recursively emitted as __cf_while__ nodes of
     the scratch graph when a member reads one of their Exit tensors."""
     sub = TFGraphMapper(type(m.gd)())
     sub.functions = m.functions
@@ -895,15 +896,8 @@ def _subgraph_callable(m, member_names, seeds, targets, frame_name=None):
             raise UnsupportedOpError(
                 f"no import rule for TF op {node.op!r} inside while frame")
         fn(sub, node)
-    sd = sub.sd
     tnames = [sub.get(t).name for t in targets]
-
-    def run(arrays):
-        vals = dict(sd._arrays)
-        vals.update(zip(ph_names, arrays))
-        return sd._trace(vals, tnames)
-
-    return run
+    return sub.sd, ph_names, tnames
 
 
 def _emit_frame(defs, ctx, fr):
@@ -929,27 +923,23 @@ def _emit_frame(defs, ctx, fr):
             seeds_body.append(e.name + ":0")
     n_merge = len(fr.merges)
     n_carry = len(init_vars)
-    cond_run = _subgraph_callable(defs, fr.members, seeds_cond,
-                                  [fr.loopcond.input[0]], frame_name=fr.name)
+    from deeplearning4j_tpu.samediff.core import make_subgraph_spec
+
+    cond_sd, cond_phs, cond_ts = _subgraph_callable(
+        defs, fr.members, seeds_cond, [fr.loopcond.input[0]],
+        frame_name=fr.name)
+    cond_spec = make_subgraph_spec(cond_sd, cond_phs, cond_ts)
     body_targets = [fr.nextiter_of[mg.name].input[0] for mg in fr.merges]
-    body_run = _subgraph_callable(defs, fr.members, seeds_body, body_targets,
-                                  frame_name=fr.name)
-
-    def while_impl(*vs):
-        def cond(c):
-            return jnp.reshape(cond_run(list(c))[0], ()).astype(bool)
-
-        def body(c):
-            new = body_run(list(c))
-            return tuple(new) + tuple(c[n_merge:])
-
-        vs, _ = _fix_list_carries(lambda *c: body(c), vs)
-        out = jax.lax.while_loop(cond, body, tuple(vs))
-        return out[:n_merge] if n_merge > 1 else out[0]
-
-    out = ctx.sd.custom_op(while_impl, *init_vars, n_out=n_merge,
-                           name=f"while_{fr.name.rsplit('/', 1)[-1]}")
-    outs = (out,) if n_merge == 1 else out
+    body_sd, body_phs, body_ts = _subgraph_callable(
+        defs, fr.members, seeds_body, body_targets, frame_name=fr.name)
+    # loop invariants pass through: the body outputs its own seed
+    # placeholders for them, keeping the carry arity uniform
+    body_spec = make_subgraph_spec(body_sd, body_phs,
+                                   body_ts + body_phs[n_merge:])
+    out = ctx.sd._op("__cf_while__", init_vars, attrs=dict(
+        cond_spec=cond_spec, body_spec=body_spec, n_carried=n_carry),
+        n_out=n_carry, name=f"while_{fr.name.rsplit('/', 1)[-1]}")
+    outs = (out,) if n_carry == 1 else out
     for i, mg in enumerate(fr.merges):
         for ex in fr.exits_of.get(mg.name, ()):
             ctx.set(ex.name, outs[i])
@@ -1050,8 +1040,17 @@ def _fdef_graph(m, func_attr):
     return fdef, sub_gd, nested_to_flat
 
 
-def _func_callable(m, func_attr):
-    """FunctionDef -> jax-traceable fn(*arrays) -> list of arrays."""
+def _set_multi(m, node, outs):
+    for i, v in enumerate(outs):
+        m.set(node.name, v, slot=i)
+
+
+def _func_spec(m, func_attr):
+    """FunctionDef → (serializable subgraph spec, n_outputs) — the
+    structured-control-flow form of _func_callable (round 4: TF While/If
+    nodes serialize like the ONNX Loop/If/Scan ones)."""
+    from deeplearning4j_tpu.samediff.core import make_subgraph_spec
+
     fdef, sub_gd, nested_to_flat = _fdef_graph(m, func_attr)
     sub = TFGraphMapper(sub_gd)
     sub.functions = dict(m.functions)
@@ -1062,40 +1061,22 @@ def _func_callable(m, func_attr):
     rets = [nested_to_flat[fdef.ret[o.name]]
             for o in fdef.signature.output_arg]
     tnames = [sub.get(r).name for r in rets]
-
-    def run(*arrays):
-        vals = dict(sub_sd._arrays)
-        vals.update(zip(ph_names, arrays))
-        return sub_sd._trace(vals, tnames)
-
-    return run, len(tnames)
-
-
-def _set_multi(m, node, outs):
-    for i, v in enumerate(outs):
-        m.set(node.name, v, slot=i)
+    return make_subgraph_spec(sub_sd, ph_names, tnames), len(tnames)
 
 
 @rule("While", "StatelessWhile")
 def _while_v2(m, node):
     ops = [m.get(i) for i in m.inputs(node)]
-    cond_run, _ = _func_callable(m, node.attr["cond"])
-    body_run, n_body = _func_callable(m, node.attr["body"])
+    cond_spec, _ = _func_spec(m, node.attr["cond"])
+    body_spec, n_body = _func_spec(m, node.attr["body"])
     if n_body != len(ops):
         raise UnsupportedOpError(
             f"While {node.name!r}: body returns {n_body} values for "
             f"{len(ops)} loop vars")
     n = len(ops)
-
-    def impl(*vs):
-        vs, _ = _fix_list_carries(body_run, vs)
-        out = jax.lax.while_loop(
-            lambda c: jnp.reshape(cond_run(*c)[0], ()).astype(bool),
-            lambda c: tuple(body_run(*c)),
-            tuple(vs))
-        return out if n > 1 else out[0]
-
-    out = m.sd.custom_op(impl, *ops, n_out=n, name=node.name)
+    out = m.sd._op("__cf_while__", ops, attrs=dict(
+        cond_spec=cond_spec, body_spec=body_spec, n_carried=n), n_out=n,
+        name=node.name)
     _set_multi(m, node, (out,) if n == 1 else out)
 
 
@@ -1104,18 +1085,14 @@ def _if_v2(m, node):
     ins = m.inputs(node)
     pred = m.get(ins[0])
     ops = [m.get(i) for i in ins[1:]]
-    then_run, n_t = _func_callable(m, node.attr["then_branch"])
-    else_run, n_e = _func_callable(m, node.attr["else_branch"])
+    then_spec, n_t = _func_spec(m, node.attr["then_branch"])
+    else_spec, n_e = _func_spec(m, node.attr["else_branch"])
     if n_t != n_e:
         raise UnsupportedOpError(f"If {node.name!r}: branch arity mismatch")
-
-    def impl(p, *a):
-        out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
-                           lambda *xs: tuple(then_run(*xs)),
-                           lambda *xs: tuple(else_run(*xs)), *a)
-        return out if n_t > 1 else out[0]
-
-    out = m.sd.custom_op(impl, pred, *ops, n_out=n_t, name=node.name)
+    idx = list(range(len(ops)))  # TF branches take the SAME explicit args
+    out = m.sd._op("__cf_if__", [pred] + ops, attrs=dict(
+        then_spec=then_spec, else_spec=else_spec, t_idx=idx, e_idx=idx,
+        n_out=n_t), n_out=n_t, name=node.name)
     _set_multi(m, node, (out,) if n_t == 1 else out)
 
 
@@ -1192,23 +1169,6 @@ def _tensorlist_stack(m, node):
 def _tensorlist_length(m, node):
     m.set(node.name, m.sd._op("tensorlist_length", [m.get(m.inputs(node)[0])],
                               name=node.name))
-
-
-def _fix_list_carries(body, init):
-    """Freshly reserved TensorLists enter the loop as (N, 0) placeholders;
-    the body's first set_item materializes the real element shape at trace
-    time. lax.while_loop needs shape-invariant carries, so re-seed any such
-    init with zeros of the body's OUTPUT shape (one abstract evaluation)."""
-    out_shapes = jax.eval_shape(lambda *a: tuple(body(*a)), *init)
-    fixed = []
-    changed = False
-    for v, s in zip(init, out_shapes):
-        if tuple(v.shape) != tuple(s.shape) and 0 in v.shape:
-            fixed.append(jnp.zeros(s.shape, s.dtype))
-            changed = True
-        else:
-            fixed.append(v)
-    return tuple(fixed), changed
 
 
 @rule("Range")

@@ -1268,4 +1268,23 @@ def _exec_cf(node: Node, args):
             cond_fn, body_fn, (jnp.asarray(0, jnp.int32), cond0, carr0))
         return carrf if N > 1 else carrf[0]
 
+    if node.op == "__cf_while__":
+        # TF2 functional While: separate cond/body graphs, explicit args
+        cond_run, _ = _cf_runner(node, "cond_spec")
+        body_run, _ = _cf_runner(node, "body_spec")
+        n = int(a["n_carried"])
+        vs = tuple(args)
+        # TensorList carries: freshly reserved lists enter as (N, 0)
+        # placeholders; re-seed with the body's OUTPUT shape so the while
+        # carry is shape-invariant (one abstract evaluation)
+        out_shapes = jax.eval_shape(lambda *aa: tuple(body_run(*aa)), *vs)
+        vs = tuple(
+            jnp.zeros(s.shape, s.dtype)
+            if tuple(v.shape) != tuple(s.shape) and 0 in v.shape else v
+            for v, s in zip(vs, out_shapes))
+        out = jax.lax.while_loop(
+            lambda c: jnp.reshape(cond_run(*c)[0], ()).astype(bool),
+            lambda c: tuple(body_run(*c)), vs)
+        return out if n > 1 else out[0]
+
     raise ValueError(f"unknown control-flow op {node.op!r}")

@@ -1460,3 +1460,60 @@ class TestSparseSoftmaxCEImport:
         for key, g in zip(keys, golden):
             np.testing.assert_allclose(np.asarray(res[key]), g, atol=1e-5,
                                        rtol=1e-4)
+
+
+class TestTFControlFlowSerialization:
+    """Round-4: TF-imported control flow serializes too (__cf_while__/
+    __cf_if__ structured nodes) — both the V2 functional ops and the V1
+    dataflow-frame lowering."""
+
+    @pytest.mark.parametrize("lower", [True, False],
+                             ids=["v1-frames", "v2-functional"])
+    def test_while_roundtrip(self, rng, lower, tmp_path):
+        def fn(x):
+            i = tf.constant(0)
+            acc = x
+
+            def cond(i, acc):
+                return i < 4
+
+            def body(i, acc):
+                return i + 1, acc * 1.5 + 0.1
+
+            i, acc = tf.while_loop(cond, body, [i, acc])
+            return acc
+
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        gd, golden, in_names, out_names = _freeze_cf(fn, [x], lower)
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0]]
+        ref = np.asarray(sd.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_allclose(ref, golden[0], atol=1e-6)
+
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        p = str(tmp_path / f"tfwhile{lower}.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        out = np.asarray(sd2.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_array_equal(out, ref)
+
+    def test_functional_if_roundtrip(self, rng, tmp_path):
+        def fn(x):
+            return tf.cond(tf.reduce_sum(x) > 0,
+                           lambda: x * 2.0, lambda: x - 1.0)
+
+        x = rng.normal(size=(2, 3)).astype(np.float32) + 3.0
+        gd, golden, in_names, out_names = _freeze_cf(fn, [x], lower=False)
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0]]
+        ref = np.asarray(sd.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_allclose(ref, golden[0], atol=1e-6)
+
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        p = str(tmp_path / "tfif.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        out = np.asarray(sd2.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_array_equal(out, ref)
