@@ -710,12 +710,34 @@ class SameDiff:
 
     def while_loop(self, cond_fn, body_fn, *loop_vars, name="while"):
         """lax.while_loop over array-level functions (Enter/Exit/LoopCond parity).
-        loop_vars are SDVariables; returns final values as a tuple."""
+        loop_vars are SDVariables; returns final values as a tuple.
+        NOT serializable (python closures) — use while_loop_graph for a
+        graph that must save()."""
         n = len(loop_vars)
         return self.custom_op(
             lambda *vs: jax.lax.while_loop(
                 lambda c: cond_fn(*c), lambda c: tuple(body_fn(*c)), tuple(vs)),
             *loop_vars, n_out=n, name=name)
+
+    def while_loop_graph(self, cond_sd: "SameDiff", cond_inputs, cond_output,
+                         body_sd: "SameDiff", body_inputs, body_outputs,
+                         *loop_vars, name="while"):
+        """SERIALIZABLE while loop (SameDiff.whileLoop parity: the reference
+        serializes its loop bodies in the .fb graph). ``cond_sd``/``body_sd``
+        are sub-SameDiff graphs whose named placeholders receive the carried
+        values; the node saves/loads with the enclosing graph like imported
+        control flow."""
+        def names(xs):
+            return [x.name if isinstance(x, SDVariable) else x for x in xs]
+
+        cond_spec = make_subgraph_spec(cond_sd, names(cond_inputs),
+                                       names([cond_output]))
+        body_spec = make_subgraph_spec(body_sd, names(body_inputs),
+                                       names(body_outputs))
+        n = len(loop_vars)
+        return self._op("__cf_while__", list(loop_vars), attrs=dict(
+            cond_spec=cond_spec, body_spec=body_spec, n_carried=n),
+            n_out=n, name=name)
 
     def _rename(self, old, new):
         if new in self._vars:

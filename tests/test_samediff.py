@@ -309,3 +309,42 @@ class TestRound2Namespaces:
         idx = sd.image.nonMaxSuppression(boxes, scores, 3, iou_threshold=0.5)
         res = sd.output({}, [idx.name])
         np.testing.assert_array_equal(res[idx.name], [0, 2, -1])
+
+
+class TestSerializableWhileLoopAPI:
+    def test_while_loop_graph_saves_and_matches(self, tmp_path, rng):
+        """SameDiff.whileLoop parity (round 4): user-authored loops built
+        from sub-SameDiff graphs serialize with the model — the
+        closure-based while_loop cannot."""
+        from deeplearning4j_tpu.samediff import SameDiff
+
+        # cond: i < 5 ; body: (i+1, acc*2)
+        cond_sd = SameDiff()
+        ci = cond_sd.placeholder("i", shape=(), dtype=np.int32)
+        ca = cond_sd.placeholder("acc", shape=(2,), dtype=np.float32)
+        cout = cond_sd._op("less", [ci, cond_sd.constant(
+            np.int32(5), name="limit")])
+        body_sd = SameDiff()
+        bi = body_sd.placeholder("i", shape=(), dtype=np.int32)
+        ba = body_sd.placeholder("acc", shape=(2,), dtype=np.float32)
+        i2 = body_sd._op("add", [bi, body_sd.constant(np.int32(1),
+                                                      name="one")])
+        a2 = body_sd._op("multiply", [ba, body_sd.constant(
+            np.float32(2.0), name="two")])
+
+        sd = SameDiff()
+        x = sd.placeholder("x", shape=(2,), dtype=np.float32)
+        i0 = sd.constant(np.int32(0), name="i0")
+        fi, facc = sd.while_loop_graph(
+            cond_sd, [ci, ca], cout, body_sd, [bi, ba], [i2, a2],
+            i0, x, name="w")
+        out_name = facc.name
+        xv = rng.normal(size=(2,)).astype(np.float32)
+        ref = np.asarray(sd.output({"x": xv}, [out_name])[out_name])
+        np.testing.assert_allclose(ref, xv * 32.0, rtol=1e-6)  # 2^5
+
+        p = str(tmp_path / "uwhile.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        out = np.asarray(sd2.output({"x": xv}, [out_name])[out_name])
+        np.testing.assert_array_equal(out, ref)
