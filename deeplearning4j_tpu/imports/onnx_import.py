@@ -667,9 +667,18 @@ def _o_mod(m, node):
 
 @orule("Shape")
 def _o_shape(m, node):
+    # static under XLA. Dims that depend on a dynamic (-1) placeholder dim
+    # (torch dynamic_axes exports) fold as the -1 sentinel, which survives
+    # Gather/Concat const chains into Reshape targets (jnp.reshape resolves
+    # one -1 per target at runtime); consumers that cannot express a
+    # dynamic dim (Expand, Range...) reject the sentinel loudly instead of
+    # silently baking batch=1
     v = m.get(node.inputs[0])
-    shp = v.shape
-    if shp is None or any(s is None or s < 0 for s in shp):
+    from deeplearning4j_tpu.samediff.core import VariableType
+
+    shp = m.sd._infer(v.name, "shape", mark_dynamic=True) \
+        if v.vtype is VariableType.ARRAY else v.shape
+    if shp is None or any(s is None for s in shp):
         raise NotImplementedError("Shape of dynamically-shaped tensor")
     arr = np.asarray(shp, np.int64)
     m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
@@ -863,6 +872,13 @@ def _o_expand(m, node):
     if xs is not None and len(xs) == len(shape):
         shape = [int(a) if s in (1, -1) and a not in (None, -1) else int(s)
                  for s, a in zip(shape, xs)]
+    if any(s < 0 for s in shape):
+        # the Shape rule's dynamic-dim sentinel: a broadcast target cannot
+        # be dynamic under XLA (dynamic_axes exports building runtime state
+        # shapes, e.g. torch RNN initial states, land here)
+        raise NotImplementedError(
+            "Expand target derived from a dynamic dim (export without "
+            "dynamic_axes, or pass explicit initial states)")
     m.set(node.outputs[0], m.sd._op("broadcast_to", [x],
                                     attrs=dict(shape=tuple(shape)),
                                     name=node.outputs[0]))
@@ -871,6 +887,10 @@ def _o_expand(m, node):
 @orule("ConstantOfShape")
 def _o_const_of_shape(m, node):
     shape = tuple(int(v) for v in m.const(node.inputs[0]))
+    if any(s < 0 for s in shape):
+        raise NotImplementedError(
+            "ConstantOfShape target derived from a dynamic dim (export "
+            "without dynamic_axes, or pass explicit initial states)")
     val = node.attr("value")
     v = float(np.asarray(val).reshape(-1)[0]) if val is not None else 0.0
     dt = np.asarray(val).dtype if val is not None else np.float32

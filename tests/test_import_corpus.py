@@ -540,3 +540,47 @@ class TestControlFlowSerialization:
         sd2 = SameDiff.load(p)
         out = np.asarray(sd2.output({"x0": x.numpy()}, ["y"])["y"])
         np.testing.assert_array_equal(out, ref)
+
+
+class TestONNXDynamicBatch:
+    """torch dynamic_axes exports (round 4): feed-forward architectures
+    import once and run at ANY batch size (the Shape rule folds dynamic
+    dims as -1 sentinels that resolve in Reshape targets); graphs that
+    build runtime STATE shapes from a dynamic dim (torch RNN initial
+    states) are rejected loudly at import instead of silently baking
+    batch=1."""
+
+    def _export_dynamic(self, model, x):
+        from torch.onnx._internal.torchscript_exporter import (
+            onnx_proto_utils,
+        )
+
+        orig = onnx_proto_utils._add_onnxscript_fn
+        onnx_proto_utils._add_onnxscript_fn = lambda mb, co: mb
+        try:
+            buf = io.BytesIO()
+            torch.onnx.export(
+                model, (x,), buf, input_names=["x"], output_names=["y"],
+                dynamic_axes={"x": {0: "batch"}, "y": {0: "batch"}},
+                dynamo=False)
+            return buf.getvalue()
+        finally:
+            onnx_proto_utils._add_onnxscript_fn = orig
+
+    def test_resnet18_runs_at_two_batch_sizes(self):
+        torch.manual_seed(0)
+        m = _ResNet18().eval()
+        sd = import_onnx(self._export_dynamic(m, torch.randn(2, 3, 64, 64)))
+        for b in (2, 5):
+            x = torch.randn(b, 3, 64, 64)
+            out = np.asarray(sd.output({"x": x.numpy()}, ["y"])["y"])
+            with torch.no_grad():
+                golden = m(x).numpy()
+            np.testing.assert_allclose(out, golden, atol=1e-4, rtol=1e-4)
+
+    def test_rnn_state_from_dynamic_dim_rejected_loudly(self):
+        torch.manual_seed(0)
+        m = _LSTMSeq().eval()
+        data = self._export_dynamic(m, torch.randint(0, 50, (2, 12)))
+        with pytest.raises(NotImplementedError, match="dynamic dim"):
+            import_onnx(data)
