@@ -394,6 +394,7 @@ class MultiLayerNetwork:
             self._rng_key, x, y, mask=mask, label_mask=label_mask,
         )
         self.score_value = loss  # fetched lazily; float() forces transfer
+        self.last_features = x   # for listeners collecting activation stats
         self.iteration += 1
         self._it_sync = self.iteration
         for lst in self.listeners:

@@ -89,12 +89,16 @@ class StatsListener:
     StatsStorage every ``frequency`` iterations."""
 
     def __init__(self, storage, frequency: int = 1, session_id: Optional[str] = None,
-                 collect_histograms: bool = True, histogram_bins: int = 30):
+                 collect_histograms: bool = True, histogram_bins: int = 30,
+                 collect_activations: bool = False):
         self.storage = storage
         self.frequency = frequency
         self.session_id = session_id or f"session_{int(time.time())}"
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
+        # DL4J's model page also charts per-layer ACTIVATION stats; costs an
+        # extra forward per logged iteration, so opt-in
+        self.collect_activations = collect_activations
         self._last_ns = None
         self._prev_params = None
 
@@ -131,6 +135,14 @@ class StatsListener:
             if update_stats:
                 rec["updates"] = update_stats
             self._prev_params = cur
+        if self.collect_activations and \
+                getattr(model, "last_features", None) is not None \
+                and hasattr(model, "feed_forward"):
+            acts = model.feed_forward(model.last_features)
+            rec["activations"] = {
+                f"layer{i}": _summary(np.asarray(a),
+                                      bins=self.histogram_bins)
+                for i, a in enumerate(acts[1:])}
         self.storage.put(rec)
 
 

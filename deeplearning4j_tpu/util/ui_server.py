@@ -234,7 +234,8 @@ per-layer <a href="/train/histograms{qs}">parameter/update histograms</a></p>
         else:
             blocks = []
             for title, key in (("Parameters", "params"),
-                               ("Updates", "updates")):
+                               ("Updates", "updates"),
+                               ("Activations", "activations")):
                 charts = []
                 for name, s in sorted((latest.get(key) or {}).items()):
                     if "hist" in s:

@@ -216,7 +216,8 @@ class TestUIHistograms:
                 .build())
         net = MultiLayerNetwork(conf).init()
         storage = InMemoryStatsStorage()
-        net.add_listener(StatsListener(storage, session_id="histsess"))
+        net.add_listener(StatsListener(storage, session_id="histsess",
+                                       collect_activations=True))
         rng = np.random.default_rng(0)
         from deeplearning4j_tpu.data import ArrayDataSetIterator
 
@@ -235,6 +236,7 @@ class TestUIHistograms:
                 f"{base}/train/histograms").read().decode()
             assert "<rect" in page, "no histogram bars rendered"
             assert "Parameters" in page and "Updates" in page
+            assert "Activations" in page  # DL4J model-page parity
             assert "layer0.W" in page
         finally:
             ui.stop()
