@@ -333,6 +333,7 @@ class MultiLayerNetwork:
             self.iteration += 1
             losses.append(loss)
         self.score_value = float(jnp.mean(jnp.stack(losses)))
+        self.last_features = x  # full sequence, not the last TBPTT segment
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -139,10 +139,14 @@ class StatsListener:
                 getattr(model, "last_features", None) is not None \
                 and hasattr(model, "feed_forward"):
             acts = model.feed_forward(model.last_features)
+            bins = self.histogram_bins if self.collect_histograms else 0
+            if isinstance(acts, dict):  # ComputationGraph: vertex name map
+                named = acts.items()
+            else:                       # MLN: list, [0] is the input itself
+                named = ((f"layer{i}", a) for i, a in enumerate(acts[1:]))
             rec["activations"] = {
-                f"layer{i}": _summary(np.asarray(a),
-                                      bins=self.histogram_bins)
-                for i, a in enumerate(acts[1:])}
+                str(k): _summary(np.asarray(a), bins=bins)
+                for k, a in named}
         self.storage.put(rec)
 
 

@@ -225,7 +225,9 @@ per-layer <a href="/train/histograms{qs}">parameter/update histograms</a></p>
         recs = self._records(session)
         latest = None
         for r in reversed(recs):
-            if any(("hist" in s) for s in (r.get("params") or {}).values()):
+            if any("hist" in s
+                   for key in ("params", "updates", "activations")
+                   for s in (r.get(key) or {}).values()):
                 latest = r
                 break
         if latest is None:
