@@ -844,6 +844,7 @@ class ComputationGraph:
             mask=_as_mask(mask), label_mask=_as_mask(label_mask),
         )
         self.score_value = loss
+        self.last_features = tuple(features)  # for activation-stats listeners
         self.iteration += 1
         self._it_sync = self.iteration
         for lst in self.listeners:

@@ -121,24 +121,33 @@ class StatsListener:
             params_stats = {}
             update_stats = {}
             cur = jax.tree_util.tree_map(np.asarray, model.params)
-            for i, p in enumerate(cur):
+            # MLN: list of per-layer dicts; ComputationGraph: name → dict
+            named = cur.items() if isinstance(cur, dict) else \
+                ((f"layer{i}", p) for i, p in enumerate(cur))
+            flat = {}
+            for lname, p in named:
+                if not isinstance(p, dict):
+                    continue
                 for k, v in p.items():
                     if isinstance(v, dict):
                         continue
-                    params_stats[f"layer{i}.{k}"] = _summary(
-                        v, bins=self.histogram_bins)
-                    if self._prev_params is not None:
-                        update_stats[f"layer{i}.{k}"] = _summary(
-                            np.asarray(v) - self._prev_params[i][k],
-                            bins=self.histogram_bins)
+                    flat[f"{lname}.{k}"] = np.asarray(v)
+            for key, v in flat.items():
+                params_stats[key] = _summary(v, bins=self.histogram_bins)
+                if self._prev_params is not None and key in self._prev_params:
+                    update_stats[key] = _summary(
+                        v - self._prev_params[key], bins=self.histogram_bins)
             rec["params"] = params_stats
             if update_stats:
                 rec["updates"] = update_stats
-            self._prev_params = cur
+            self._prev_params = flat
         if self.collect_activations and \
                 getattr(model, "last_features", None) is not None \
                 and hasattr(model, "feed_forward"):
-            acts = model.feed_forward(model.last_features)
+            lf = model.last_features
+            # ComputationGraph stores its (possibly multi-) input tuple
+            acts = model.feed_forward(*lf) if isinstance(lf, tuple) \
+                else model.feed_forward(lf)
             bins = self.histogram_bins if self.collect_histograms else 0
             if isinstance(acts, dict):  # ComputationGraph: vertex name map
                 named = acts.items()

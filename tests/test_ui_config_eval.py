@@ -241,3 +241,38 @@ class TestUIHistograms:
         finally:
             ui.stop()
         del recs
+
+
+class TestCGActivationStats:
+    def test_graph_activation_histograms(self):
+        """collect_activations on a ComputationGraph: vertex-name keyed
+        activation summaries with histograms."""
+        import numpy as np
+
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        from deeplearning4j_tpu.nn import (ComputationGraph, InputType,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        from deeplearning4j_tpu.util.stats import StatsListener
+
+        gb = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+              .graph_builder().add_inputs("in"))
+        gb.add_layer("h", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+        gb.add_layer("out", OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                                        activation="softmax"), "h")
+        gb.set_outputs("out")
+        gb.set_input_types(InputType.feed_forward(4))
+        net = ComputationGraph(gb.build()).init()
+        storage = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(storage, session_id="cgact",
+                                        collect_activations=True))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net.fit(ArrayDataSetIterator(x, y, batch=8), epochs=2)
+        recs = [r for r in storage.records if r.get("activations")]
+        assert recs, "no activation records"
+        acts = recs[-1]["activations"]
+        assert "h" in acts and "out" in acts
+        assert "hist" in acts["h"]
