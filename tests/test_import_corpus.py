@@ -584,3 +584,25 @@ class TestONNXDynamicBatch:
         data = self._export_dynamic(m, torch.randint(0, 50, (2, 12)))
         with pytest.raises(NotImplementedError, match="dynamic dim"):
             import_onnx(data)
+
+
+class TestTFDynamicBatch:
+    def test_imported_graph_runs_at_two_batch_sizes(self, rng):
+        """TF frozen graphs traced with batch=None import once and run at
+        any batch size (the keras Pack/StridedSlice reshape pattern folds
+        the dynamic dim as -1)."""
+        tf.keras.utils.set_random_seed(7)
+        model = tf.keras.applications.MobileNetV2(
+            weights=None, include_top=False, input_shape=(64, 64, 3),
+            pooling="avg")
+        gd, frozen, in_name, out_name = _freeze_keras(model)
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_name]
+        for b in (2, 5):
+            x = rng.normal(size=(b, 64, 64, 3)).astype(np.float32)
+            golden = frozen(tf.constant(x))
+            if isinstance(golden, (list, tuple)):
+                golden = golden[0]
+            res = np.asarray(sd.output({in_name: x}, [key])[key])
+            np.testing.assert_allclose(res, np.asarray(golden), atol=1e-4,
+                                       rtol=1e-4)
