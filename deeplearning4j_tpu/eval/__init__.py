@@ -9,6 +9,7 @@ stays on device).
 
 from deeplearning4j_tpu.eval.classification import (  # noqa: F401
     EvaluationBinary,
+    ROCBinary,
     Evaluation,
     EvaluationCalibration,
     ROC,

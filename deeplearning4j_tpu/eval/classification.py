@@ -316,3 +316,55 @@ class EvaluationBinary:
                 for i in range(self.num_outputs())]
         return "EvaluationBinary ({} outputs)\n{}".format(
             self.num_outputs(), "\n".join(rows))
+
+
+class ROCBinary:
+    """Per-output binary ROC/AUC for multi-label sigmoid outputs
+    (org/nd4j/evaluation/classification/ROCBinary.java, path-cite, mount
+    empty) — the ROC companion to EvaluationBinary. Labels/scores are
+    [batch, n_outputs]; an optional same-shape mask excludes entries."""
+
+    def __init__(self):
+        self._rocs: "list[ROC]" = []
+
+    def _ensure(self, n: int):
+        if not self._rocs:
+            self._rocs = [ROC() for _ in range(n)]
+        elif len(self._rocs) != n:
+            raise ValueError(
+                f"ROCBinary was accumulated with {len(self._rocs)} outputs; "
+                f"this batch has {n}")
+
+    def eval(self, labels, scores, mask=None):
+        labels = np.asarray(labels)
+        scores = np.asarray(scores)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            scores = scores[:, None]
+        self._ensure(labels.shape[-1])
+        for i, roc in enumerate(self._rocs):
+            li, si = labels[:, i], scores[:, i]
+            if mask is not None:
+                keep = np.asarray(mask)[:, i] > 0
+                li, si = li[keep], si[keep]
+            if li.size:
+                roc.eval(li, si)
+
+    def num_outputs(self) -> int:
+        return len(self._rocs)
+
+    def calculate_auc(self, output: int) -> float:
+        return self._rocs[output].calculate_auc()
+
+    def calculate_auprc(self, output: int) -> float:
+        return self._rocs[output].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+    def stats(self) -> str:
+        rows = [f"ROCBinary ({len(self._rocs)} outputs)"]
+        for i, r in enumerate(self._rocs):
+            rows.append(f"  output {i}: AUC {r.calculate_auc():.4f}  "
+                        f"AUPRC {r.calculate_auprc():.4f}")
+        return "\n".join(rows)

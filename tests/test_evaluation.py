@@ -128,3 +128,36 @@ class TestEvaluationBinary:
                 getattr(EvaluationBinary(), meth)(0)
         with pytest.raises(ValueError, match="shape"):
             EvaluationBinary().eval(np.zeros(4), np.zeros((2, 2)))
+
+
+class TestROCBinary:
+    def test_per_output_auc_with_mask(self, rng):
+        from deeplearning4j_tpu.eval import ROCBinary
+
+        n = 400
+        # output 0: strongly separable; output 1: anti-correlated (AUC→0);
+        # output 2: random (AUC≈0.5)
+        y = rng.integers(0, 2, size=(n, 3)).astype(np.float32)
+        s = np.empty((n, 3), np.float32)
+        s[:, 0] = y[:, 0] * 0.8 + rng.random(n) * 0.2
+        s[:, 1] = (1 - y[:, 1]) * 0.8 + rng.random(n) * 0.2
+        s[:, 2] = rng.random(n)
+        mask = np.ones((n, 3), np.float32)
+        mask[: n // 4, 2] = 0.0  # excluded entries must not change AUC much
+        roc = ROCBinary()
+        # two accumulation calls (merge semantics)
+        roc.eval(y[: n // 2], s[: n // 2], mask=mask[: n // 2])
+        roc.eval(y[n // 2:], s[n // 2:], mask=mask[n // 2:])
+        assert roc.num_outputs() == 3
+        assert roc.calculate_auc(0) > 0.95
+        assert roc.calculate_auc(1) < 0.05
+        assert 0.35 < roc.calculate_auc(2) < 0.65
+        assert "AUC" in roc.stats()
+
+    def test_output_count_mismatch_raises(self, rng):
+        from deeplearning4j_tpu.eval import ROCBinary
+
+        roc = ROCBinary()
+        roc.eval(np.zeros((4, 2)), np.zeros((4, 2)))
+        with pytest.raises(ValueError, match="2 outputs"):
+            roc.eval(np.zeros((4, 3)), np.zeros((4, 3)))
