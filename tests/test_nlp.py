@@ -183,3 +183,39 @@ class TestHierarchicalSoftmax:
     def test_negative_zero_implies_hs(self):
         assert Word2Vec(negative=0).use_hierarchic_softmax
         assert not Word2Vec(negative=5).use_hierarchic_softmax
+
+
+class TestFastText:
+    CORPUS = [
+        ("the cat sat on the mat with another cat", "animals"),
+        ("dogs chase cats and cats chase mice", "animals"),
+        ("my dog loves long walks in the park", "animals"),
+        ("a kitten and a puppy played together", "animals"),
+        ("the horse galloped across the green field", "animals"),
+        ("stock markets rallied as rates fell", "finance"),
+        ("the bank raised interest rates again", "finance"),
+        ("investors bought shares after the earnings report", "finance"),
+        ("the fund managers hedged their currency exposure", "finance"),
+        ("bond yields dropped on inflation news", "finance"),
+    ]
+
+    def test_supervised_classification_and_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import FastText
+
+        texts = [t for t, _ in self.CORPUS]
+        labels = [l for _, l in self.CORPUS]
+        ft = FastText(dim=32, epoch=60, lr=0.5, word_ngrams=2,
+                      bucket=1 << 12, seed=1).fit(texts, labels)
+        correct = sum(ft.predict(t) == l for t, l in self.CORPUS)
+        assert correct >= 9, correct
+        # generalization to unseen word combinations from the same fields
+        assert ft.predict("the puppy chased the kitten") == "animals"
+        assert ft.predict("rates and shares and yields") == "finance"
+        probs = ft.predict_probabilities("dogs and cats")
+        assert abs(sum(probs.values()) - 1.0) < 1e-5
+
+        p = str(tmp_path / "ft.npz")
+        ft.save(p)
+        ft2 = FastText.load(p)
+        for t, _ in self.CORPUS:
+            assert ft2.predict(t) == ft.predict(t)
