@@ -1223,30 +1223,46 @@ def _external_refs(gf, scope=()) -> List[str]:
 
 
 def _subgraph_fn(m, gattr: _GraphAttr, input_shapes=None):
-    """GraphProto attr → (run, formal_input_names, runtime_captures,
-    n_outputs). ``run(*arrays)`` is jax-traceable and takes the formal
-    inputs followed by the runtime captures. ``input_shapes`` overrides
-    formal-input (shape, dtype) pairs — subgraph value_infos often omit
-    them, but the enclosing rule knows the carried shapes."""
-    sub = OnnxImporter(graph_buf=gattr.buf)
+    """GraphProto attr → (spec, formal_input_names, runtime_captures,
+    n_outputs). The spec's callable takes the formal inputs followed by the
+    runtime captures. ``input_shapes`` overrides formal-input (shape,
+    dtype) pairs — subgraph value_infos often omit them, but the enclosing
+    rule knows the carried shapes.
+
+    Captured constants are passed as RUNTIME captures when the body builds
+    without their static values — so a captured weight converted to a
+    VARIABLE outside still receives gradients (trainable imported loops).
+    Bodies that need a capture statically (shape/axis args) fall back to
+    folding every const capture into the sub-graph."""
     gf = pm.decode(gattr.buf)
-    formal = [n for n, _, _ in sub.graph_inputs]
-    runtime_caps: List[str] = []
-    for c in _external_refs(gf):
-        if c in formal:
-            continue
-        if c in m.const_vals:
-            arr = np.asarray(m.const_vals[c])
-            sub.set(c, sub.sd.constant(arr, name=c), const_val=arr)
-        else:
-            ov = m.get(c)
-            sub.set(c, sub.sd.placeholder(c, shape=ov.shape, dtype=ov.dtype))
-            runtime_caps.append(c)
-    for idx, (n, shp, dt) in enumerate(sub.graph_inputs):
-        if input_shapes is not None and idx < len(input_shapes):
-            shp, dt = input_shapes[idx]
-        sub.set(n, sub.sd.placeholder(n, shape=shp, dtype=dt or np.float32))
-    sub.build()
+
+    def build(fold_consts):
+        sub = OnnxImporter(graph_buf=gattr.buf)
+        formal = [n for n, _, _ in sub.graph_inputs]
+        runtime_caps: List[str] = []
+        for c in _external_refs(gf):
+            if c in formal:
+                continue
+            if fold_consts and c in m.const_vals:
+                arr = np.asarray(m.const_vals[c])
+                sub.set(c, sub.sd.constant(arr, name=c), const_val=arr)
+            else:
+                ov = m.get(c)
+                sub.set(c, sub.sd.placeholder(c, shape=ov.shape,
+                                              dtype=ov.dtype))
+                runtime_caps.append(c)
+        for idx, (n, shp, dt) in enumerate(sub.graph_inputs):
+            if input_shapes is not None and idx < len(input_shapes):
+                shp, dt = input_shapes[idx]
+            sub.set(n, sub.sd.placeholder(n, shape=shp,
+                                          dtype=dt or np.float32))
+        sub.build()
+        return sub, formal, runtime_caps
+
+    try:
+        sub, formal, runtime_caps = build(fold_consts=False)
+    except NotImplementedError:
+        sub, formal, runtime_caps = build(fold_consts=True)
     outnames = [sub.vars[o].name for o in sub.graph_outputs]
     from deeplearning4j_tpu.samediff.core import make_subgraph_spec
 

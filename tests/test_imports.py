@@ -1517,3 +1517,37 @@ class TestTFControlFlowSerialization:
         sd2 = SameDiff.load(p)
         out = np.asarray(sd2.output({in_names[0]: x}, [key])[key])
         np.testing.assert_array_equal(out, ref)
+
+
+class TestTrainableImportedScan:
+    def test_gradient_through_imported_scan_matches_analytic(self):
+        """Captured constants stay RUNTIME inputs of control-flow nodes
+        when the body builds without their static values — so an imported
+        recurrent weight converted to a VARIABLE receives gradients
+        (fine-tunable imported loops; lax.scan is reverse-differentiable)."""
+        body = _onnx_graph(
+            nodes=[_onnx_node("Add", ["st_in", "elem"], ["st_mid"]),
+                   _onnx_node("Mul", ["st_mid", "w"], ["st_out"])],
+            initializers=[],
+            inputs=[_onnx_input("st_in", (4,)), _onnx_input("elem", (4,))],
+            outputs=["st_out"])
+        model = _onnx_model(
+            nodes=[_onnx_node("Scan", ["st0", "xs"], ["st_final"],
+                              _onnx_attr_i("num_scan_inputs", 1),
+                              _onnx_attr_graph("body", body))],
+            initializers=[_onnx_tensor("w", np.float32(0.9).reshape(()))],
+            inputs=[_onnx_input("st0", (4,)), _onnx_input("xs", (5, 4))],
+            outputs=["st_final"])
+        sd = import_onnx(model)
+        sd.convert_to_variable("w")
+        loss = sd._op("sum", [sd.get_variable("st_final")])
+        sd.set_loss_variables(loss)
+        grads = sd.calculate_gradients(
+            {"st0": np.zeros(4, np.float32),
+             "xs": np.ones((5, 4), np.float32)}, "w")
+        dw = float(np.asarray(grads["w"]))
+        w, st, d = 0.9, np.zeros(4), np.zeros(4)
+        for _ in range(5):
+            d = (st + 1.0) + w * d
+            st = (st + 1.0) * w
+        np.testing.assert_allclose(dw, 4 * d[0], rtol=1e-5)
