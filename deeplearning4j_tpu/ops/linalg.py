@@ -13,6 +13,7 @@ mixed-precision GEMM paths.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -192,3 +193,29 @@ def toeplitz(c, r=None):
     import jax.scipy.linalg as jsl
 
     return jsl.toeplitz(c) if r is None else jsl.toeplitz(c, r)
+
+
+# round-4 linalg tail (generic/parity_ops + nd4j linalg namespace
+# stragglers, path-cite — mount empty)
+op("pinv", "linalg", differentiable=False)(jnp.linalg.pinv)
+op("slogdet", "linalg", differentiable=False)(jnp.linalg.slogdet)
+op("matrix_power", "linalg", differentiable=False)(
+    lambda a, n: jnp.linalg.matrix_power(a, int(n)))
+op("matrix_rank", "linalg", differentiable=False)(jnp.linalg.matrix_rank)
+op("expm", "linalg", aliases=("matrix_exp",), differentiable=False)(
+    lambda a: jax.scipy.linalg.expm(a))
+op("sqrtm", "linalg", differentiable=False)(
+    lambda a: jax.scipy.linalg.sqrtm(a))
+op("adjoint", "linalg")(lambda a: jnp.conjugate(jnp.swapaxes(a, -1, -2)))
+
+
+@op("logdet", "linalg", differentiable=False)
+def logdet(a):
+    """log|det(a)| for SPD inputs (reference logdet op contract)."""
+    sign, ld = jnp.linalg.slogdet(a)
+    return ld
+
+
+@op("cond_number", "linalg", differentiable=False)
+def cond_number(a, p=None):
+    return jnp.linalg.cond(a, p=p)

@@ -27,6 +27,15 @@ OVERRIDES = {
     "ssim": lambda f: f(jnp.ones((1, 16, 16, 3)), jnp.ones((1, 16, 16, 3)) * 0.5,
                         filter_size=5),
     "kron": lambda f: f(XN[:2, :2], XN[:3, :3]),
+    "matrix_power": lambda f: f(SQ, 3),
+    "pinv": lambda f: f(SQ),
+    "slogdet": lambda f: f(SQ),
+    "matrix_rank": lambda f: f(SQ),
+    "expm": lambda f: f(SQ * 0.1),
+    "sqrtm": lambda f: f(SQ),
+    "adjoint": lambda f: f(SQ),
+    "logdet": lambda f: f(SQ),
+    "cond_number": lambda f: f(SQ),
     "vander": lambda f: f(jnp.asarray([1.0, 2.0, 3.0])),
     "normalize_moments": lambda f: f(
         jnp.float32(8.0), jnp.asarray([4.0, 8.0]), jnp.asarray([10.0, 40.0])),
