@@ -43,3 +43,15 @@ def rng():
 @pytest.fixture
 def key():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound the number of live compiled executables: a full-suite process
+    accumulates ~1000 XLA:CPU executables, after which the compiler was
+    observed to segfault on a trivial program (flaky, end-of-suite, not
+    host OOM — 123 GB free at the time). Clearing per module keeps the
+    working set small; per-module recompiles are already the norm since
+    shapes differ between files."""
+    yield
+    jax.clear_caches()
