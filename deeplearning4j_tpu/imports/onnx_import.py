@@ -1373,6 +1373,16 @@ def _o_scan(m, node):
             m.set(o, out[i])
 
 
+@orule("ReverseSequence")
+def _o_reverse_sequence(m, node):
+    x, lens = m.get(node.inputs[0]), m.get(node.inputs[1])
+    m.set(node.outputs[0], m.sd._op(
+        "reverse_sequence", [x, lens],
+        attrs=dict(seq_axis=int(node.attr("time_axis", 0)),
+                   batch_axis=int(node.attr("batch_axis", 1))),
+        name=node.outputs[0]))
+
+
 @orule("Einsum")
 def _o_einsum(m, node):
     eq = node.attr("equation")

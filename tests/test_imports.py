@@ -1551,3 +1551,22 @@ class TestTrainableImportedScan:
             d = (st + 1.0) + w * d
             st = (st + 1.0) * w
         np.testing.assert_allclose(dw, 4 * d[0], rtol=1e-5)
+
+
+class TestONNXReverseSequence:
+    def test_reverse_sequence_matches_numpy(self, rng):
+        model = _onnx_model(
+            nodes=[_onnx_node("ReverseSequence", ["x", "lens"], ["y"],
+                              _onnx_attr_i("time_axis", 1),
+                              _onnx_attr_i("batch_axis", 0))],
+            initializers=[_onnx_tensor("lens",
+                                       np.asarray([3, 1, 4], np.int64))],
+            inputs=[_onnx_input("x", (3, 4))],
+            outputs=["y"])
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        sd = import_onnx(model)
+        out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+        ref = x.copy()
+        for b, n in enumerate([3, 1, 4]):
+            ref[b, :n] = x[b, :n][::-1]
+        np.testing.assert_allclose(out, ref, atol=1e-6)
