@@ -338,14 +338,19 @@ class ROCBinary:
     def eval(self, labels, scores, mask=None):
         labels = np.asarray(labels)
         scores = np.asarray(scores)
+        if mask is not None:
+            mask = np.asarray(mask)
         if labels.ndim == 1:
             labels = labels[:, None]
             scores = scores[:, None]
+        if mask is not None and mask.ndim == 1:
+            # per-example mask: applies to every output column
+            mask = np.broadcast_to(mask[:, None], labels.shape)
         self._ensure(labels.shape[-1])
         for i, roc in enumerate(self._rocs):
             li, si = labels[:, i], scores[:, i]
             if mask is not None:
-                keep = np.asarray(mask)[:, i] > 0
+                keep = mask[:, i] > 0
                 li, si = li[keep], si[keep]
             if li.size:
                 roc.eval(li, si)

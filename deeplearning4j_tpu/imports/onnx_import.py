@@ -146,6 +146,14 @@ class OnnxImporter:
         self.sd = SameDiff()
         self.vars: Dict[str, SDVariable] = {}
         self.const_vals: Dict[str, np.ndarray] = {}
+        # sd-var names of Shape-fold constants carrying the -1 dynamic-dim
+        # sentinel (torch dynamic_axes exports) — const() refuses values
+        # derived from these unless the calling rule opts in, so the
+        # sentinel can never silently reach Slice/Tile/arithmetic as a
+        # plain -1 (only Reshape targets express a dynamic dim under XLA).
+        # Shared with the graph's poison set: output() additionally refuses
+        # targets whose runtime ancestors include one of these constants.
+        self.dyn_vars = self.sd._poison_vars
 
     def get(self, name: str) -> SDVariable:
         return self.vars[name]
@@ -155,20 +163,34 @@ class OnnxImporter:
         """ONNX optional-input convention: empty-string name = omitted."""
         return len(node.inputs) > i and node.inputs[i] != ""
 
-    def const(self, name: str) -> np.ndarray:
+    def const(self, name: str, *, allow_dynamic: bool = False) -> np.ndarray:
         if name not in self.const_vals:
             # eager-eval fallback: shape chains (Shape→Gather→Unsqueeze→
             # Concat…, torch LSTM/attention exports build state shapes and
             # masks this way) are placeholder-free once Shape folds — run
             # the producing subgraph now and record the value
             try:
-                val = np.asarray(self.vars[name].eval({}))
+                v = self.vars[name]
+                val = np.asarray(
+                    self.sd.output({}, [v.name], _allow_poison=True)[v.name])
             except Exception as e:
                 raise NotImplementedError(
                     f"input {name!r} must be an initializer/Constant (static "
                     f"shapes under XLA); eager eval failed: {e!r}") from e
             self.const_vals[name] = val
+        if not allow_dynamic and self._derives_dynamic(name):
+            raise NotImplementedError(
+                f"const input {name!r} derives from a dynamic (-1) "
+                "placeholder dim (torch dynamic_axes export) — only a "
+                "Reshape target can carry a dynamic dim under XLA; export "
+                "without dynamic_axes or feed static shapes")
         return self.const_vals[name]
+
+    def _derives_dynamic(self, name: str) -> bool:
+        """True if `name`'s value derives (through the recorded graph) from
+        a Shape fold that contained the -1 dynamic-dim sentinel."""
+        v = self.vars.get(name)
+        return v is not None and self.sd.derives_poisoned(v.name)
 
     def set(self, name: str, var, const_val=None):
         self.vars[name] = var
@@ -197,6 +219,18 @@ class OnnxImporter:
             v = self.vars.get(out)
             if v is not None and v.name != out:
                 self.vars[out] = self.sd._op("identity", [v], name=out)
+        # import-time version of the output() poison check: if any graph
+        # output's runtime ancestors include a dynamic-dim sentinel constant
+        # (it slipped past const() into real arithmetic), fail now — not at
+        # the first inference call
+        bad = self.sd.poisoned_ancestor(
+            [self.vars[o].name for o in self.graph_outputs
+             if o in self.vars])
+        if bad is not None:
+            raise NotImplementedError(
+                f"graph output computes with {bad!r}, a shape constant "
+                "carrying the -1 dynamic-dim sentinel (torch dynamic_axes "
+                "export) — re-export with static shapes")
         self.sd.onnx_outputs = list(self.graph_outputs)
         return self.sd
 
@@ -296,7 +330,9 @@ def _o_log_softmax(m, node):
 @orule("Reshape")
 def _o_reshape(m, node):
     x = m.get(node.inputs[0])
-    shape = [int(s) for s in m.const(node.inputs[1])]
+    # jnp.reshape resolves one -1 at runtime — the one consumer where the
+    # dynamic-dim sentinel is expressible, so it opts in
+    shape = [int(s) for s in m.const(node.inputs[1], allow_dynamic=True)]
     if 0 in shape and not node.attr("allowzero", 0):
         # ONNX: dim 0 = copy the corresponding input dim (torch RNN exports
         # emit e.g. [0, 0, -1])
@@ -681,8 +717,10 @@ def _o_shape(m, node):
     if shp is None or any(s is None for s in shp):
         raise NotImplementedError("Shape of dynamically-shaped tensor")
     arr = np.asarray(shp, np.int64)
-    m.set(node.outputs[0], m.sd.constant(arr, name=node.outputs[0]),
-          const_val=arr)
+    cvar = m.sd.constant(arr, name=node.outputs[0])
+    m.set(node.outputs[0], cvar, const_val=arr)
+    if (arr == -1).any():
+        m.dyn_vars.add(cvar.name)
 
 
 # ------------------------------------------------------------ recurrent ops
@@ -866,7 +904,8 @@ def _o_tile(m, node):
 @orule("Expand")
 def _o_expand(m, node):
     x = m.get(node.inputs[0])
-    shape = [int(v) for v in m.const(node.inputs[1])]
+    # opts in to keep its own (more specific) dynamic-dim guard below
+    shape = [int(v) for v in m.const(node.inputs[1], allow_dynamic=True)]
     # ONNX Expand: dim value 1 broadcasts; other values must match or x is 1
     xs = x.shape
     if xs is not None and len(xs) == len(shape):
@@ -886,7 +925,8 @@ def _o_expand(m, node):
 
 @orule("ConstantOfShape")
 def _o_const_of_shape(m, node):
-    shape = tuple(int(v) for v in m.const(node.inputs[0]))
+    # opts in to keep its own (more specific) dynamic-dim guard below
+    shape = tuple(int(v) for v in m.const(node.inputs[0], allow_dynamic=True))
     if any(s < 0 for s in shape):
         raise NotImplementedError(
             "ConstantOfShape target derived from a dynamic dim (export "
@@ -917,21 +957,6 @@ def _o_argminmax(m, node):
     if kd:
         y = m.sd._op("expand_dims", [y], attrs=dict(axis=axis))
     m.set(node.outputs[0], m.sd._op("identity", [y], name=node.outputs[0]))
-
-
-@orule("ReduceProd", "ReduceL2")
-def _o_reduce2(m, node):
-    x = m.get(node.inputs[0])
-    axes = node.attr("axes")
-    if axes is None and m.has_input(node, 1):
-        axes = [int(a) for a in m.const(node.inputs[1])]
-    kd = bool(node.attr("keepdims", 1))
-    attrs = dict(keepdims=kd)
-    if axes:
-        attrs["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
-    opname = "prod" if node.op_type == "ReduceProd" else "norm2"
-    m.set(node.outputs[0], m.sd._op(opname, [x], attrs=attrs,
-                                    name=node.outputs[0]))
 
 
 @orule("CumSum")
@@ -1245,7 +1270,10 @@ def _subgraph_fn(m, gattr: _GraphAttr, input_shapes=None):
                 continue
             if fold_consts and c in m.const_vals:
                 arr = np.asarray(m.const_vals[c])
-                sub.set(c, sub.sd.constant(arr, name=c), const_val=arr)
+                cvar = sub.sd.constant(arr, name=c)
+                sub.set(c, cvar, const_val=arr)
+                if m._derives_dynamic(c):  # taint crosses the subgraph edge
+                    sub.dyn_vars.add(cvar.name)
             else:
                 ov = m.get(c)
                 sub.set(c, sub.sd.placeholder(c, shape=ov.shape,

@@ -59,6 +59,13 @@ class TFGraphMapper:
             if graph_def.HasField("library") else {}
         # V1 cond support: tensor key -> (pred SDVariable, is_true_branch)
         self.branch_tag: Dict[str, tuple] = {}
+        # sd-var names of Shape-fold constants carrying the -1 dynamic-dim
+        # sentinel — const() refuses values derived from these unless the
+        # calling rule opts in (Reshape, and rules with their own guards),
+        # so the sentinel can never reach shape/axis math as a plain -1.
+        # Shared with the graph's poison set: output() additionally refuses
+        # targets whose runtime ancestors include one of these constants.
+        self.dyn_vars = self.sd._poison_vars
 
     # ------------------------------------------------------------- plumbing
     @staticmethod
@@ -69,7 +76,7 @@ class TFGraphMapper:
     def get(self, name: str) -> SDVariable:
         return self.vars[self._canon(name)]
 
-    def const(self, name: str) -> np.ndarray:
+    def const(self, name: str, *, allow_dynamic: bool = False) -> np.ndarray:
         """Import-time value of a const input (shape args etc.)."""
         key = self._canon(name)
         if key not in self.const_vals:
@@ -78,14 +85,27 @@ class TFGraphMapper:
             # softmax_cross_entropy_with_logits wrapper) are placeholder-
             # free — evaluate the producing subgraph now
             try:
-                val = np.asarray(self.vars[key].eval({}))
+                v = self.vars[key]
+                val = np.asarray(
+                    self.sd.output({}, [v.name], _allow_poison=True)[v.name])
             except Exception as e:
                 raise UnsupportedOpError(
                     f"input {name!r} must be a constant (shape/axis "
                     "arguments are static under XLA); dynamic shape tensors "
                     f"are not importable (eager eval failed: {e!r})") from e
             self.const_vals[key] = val
+        if not allow_dynamic and self._derives_dynamic(key):
+            raise UnsupportedOpError(
+                f"const input {name!r} derives from a dynamic (-1) "
+                "placeholder dim — only a Reshape target can carry a "
+                "dynamic dim under XLA; freeze with static shapes instead")
         return self.const_vals[key]
+
+    def _derives_dynamic(self, key: str) -> bool:
+        """True if `key`'s value derives (through the recorded graph) from
+        a Shape fold that contained the -1 dynamic-dim sentinel."""
+        v = self.vars.get(key)
+        return v is not None and self.sd.derives_poisoned(v.name)
 
     def set(self, node_name: str, var, slot: int = 0, const_val=None):
         self.vars[f"{node_name}:{slot}"] = var
@@ -368,7 +388,10 @@ def _select(m, node):
 @rule("Reshape")
 def _reshape(m, node):
     x = m.get(m.inputs(node)[0])
-    shape = tuple(int(s) for s in m.const(m.inputs(node)[1]))
+    # jnp.reshape resolves one -1 at runtime — the keras
+    # Pack(StridedSlice(Shape(x)),…) dynamic-batch pattern lands here
+    shape = tuple(int(s)
+                  for s in m.const(m.inputs(node)[1], allow_dynamic=True))
     m.set(node.name, m.sd._op("reshape", [x], attrs=dict(shape=shape),
                               name=node.name))
 
@@ -532,7 +555,9 @@ def _pad(m, node):
 @rule("Tile")
 def _tile(m, node):
     x = m.get(m.inputs(node)[0])
-    reps = tuple(int(v) for v in m.const(m.inputs(node)[1]))
+    # opts in to keep its own (more specific) dynamic-dim guard below
+    reps = tuple(int(v)
+                 for v in m.const(m.inputs(node)[1], allow_dynamic=True))
     if any(r < 0 for r in reps):
         # -1 = the Shape rule's dynamic-dim sentinel; tiling by it is not
         # expressible statically
@@ -542,7 +567,9 @@ def _tile(m, node):
 
 @rule("Fill")
 def _fill(m, node):
-    shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    # opts in to keep its own (more specific) dynamic-dim guard below
+    shape = tuple(int(v)
+                  for v in m.const(m.inputs(node)[0], allow_dynamic=True))
     if any(s < 0 for s in shape):
         raise UnsupportedOpError("Fill shape derived from a dynamic dim")
     val = m.const(m.inputs(node)[1])
@@ -675,7 +702,10 @@ def _shape(m, node):
     if shp is None or any(s is None for s in shp):
         raise UnsupportedOpError("Shape of dynamically-shaped tensor")
     arr = np.asarray(shp, np.int32)
-    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+    cvar = m.sd.constant(arr, name=node.name)
+    m.set(node.name, cvar, const_val=arr)
+    if (arr == -1).any():
+        m.dyn_vars.add(cvar.name)
 
 
 # ---------------------------------------------------------------------------
@@ -1174,17 +1204,18 @@ def _tensorlist_length(m, node):
 @rule("Range")
 def _range(m, node):
     ins = m.inputs(node)
+    # provenance guard on ALL THREE bounds (a sentinel -1 start/delta would
+    # bake a wrong constant just as silently as a -1 limit) — negative
+    # LITERALS stay legal (countdown ranges)
+    if any(m._derives_dynamic(m._canon(i)) for i in ins):
+        raise UnsupportedOpError(
+            f"Range {node.name!r} bounds derived from a dynamic dim")
     try:  # static limits → constant (shape math stays static)
         start, limit, delta = (int(np.asarray(m.const(i))) for i in ins)
     except UnsupportedOpError:
         raise UnsupportedOpError(
             f"Range {node.name!r} with non-constant bounds (dynamic shapes "
             "are not XLA-traceable)")
-    if limit < 0:
-        # -1 = the Shape rule's dynamic-dim sentinel: np.arange would
-        # silently produce an empty array
-        raise UnsupportedOpError(
-            f"Range {node.name!r} limit derived from a dynamic dim")
     arr = np.arange(start, limit, delta,
                     dtype=_tf_dtype(node.attr["Tidx"].type))
     m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
@@ -1488,7 +1519,7 @@ def _conv_grad_attrs(m, node):
 @rule("Conv2DBackpropInput")
 def _conv2d_backprop_input(m, node):
     ins = m.inputs(node)  # (input_sizes, filter, out_backprop)
-    sizes = tuple(int(s) for s in m.const(ins[0]))
+    sizes = tuple(int(s) for s in m.const(ins[0], allow_dynamic=True))
     if any(s < 0 for s in sizes):
         raise UnsupportedOpError(
             "Conv2DBackpropInput with dynamic input_sizes")
@@ -1540,7 +1571,7 @@ def _max_pool_grad(m, node):
 @rule("AvgPoolGrad")
 def _avg_pool_grad(m, node):
     ins = m.inputs(node)  # (orig_input_shape, grad)
-    sizes = tuple(int(s) for s in m.const(ins[0]))
+    sizes = tuple(int(s) for s in m.const(ins[0], allow_dynamic=True))
     if any(s < 0 for s in sizes):
         raise UnsupportedOpError("AvgPoolGrad with dynamic input shape")
     dy = m.get(ins[1])
@@ -1640,7 +1671,7 @@ def _strided_spec(m, node, begin, end, strides):
 @rule("StridedSliceGrad")
 def _strided_slice_grad(m, node):
     ins = m.inputs(node)  # (shape, begin, end, strides, dy)
-    shape = tuple(int(v) for v in m.const(ins[0]))
+    shape = tuple(int(v) for v in m.const(ins[0], allow_dynamic=True))
     if any(s < 0 for s in shape):
         raise UnsupportedOpError("StridedSliceGrad with dynamic shape")
     begin = [int(v) for v in m.const(ins[1])]

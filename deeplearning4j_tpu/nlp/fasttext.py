@@ -87,8 +87,10 @@ class FastText:
         for t in texts:
             for tok in self._tokens(t):
                 counts[tok] = counts.get(tok, 0) + 1
-        self.vocab = {t: i for i, (t, c) in enumerate(sorted(counts.items()))
-                      if c >= self.min_count}
+        # ids must be contiguous AFTER min_count filtering — the n-gram
+        # bucket range starts at len(vocab) and the pad row is sized off it
+        self.vocab = {t: i for i, t in enumerate(
+            sorted(t for t, c in counts.items() if c >= self.min_count))}
         self.labels = sorted(set(labels))
         lab_idx = {l: i for i, l in enumerate(self.labels)}
         C = len(self.labels)

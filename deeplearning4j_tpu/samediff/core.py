@@ -528,6 +528,13 @@ class SameDiff:
         self._rng_counter = 0
         self._device_cache: Optional[Dict[str, Any]] = None
         self._grad_fn_cache: Dict[Any, Any] = {}
+        # names of constants carrying the importers' -1 dynamic-dim
+        # sentinel (torch dynamic_axes / TF batch=None Shape folds).
+        # Harmless while dead (the usual case: the chain was folded into a
+        # Reshape target attr), but output() refuses to compute any target
+        # whose ancestor set contains one — a -1 posing as a batch size
+        # must never reach runtime arithmetic silently.
+        self._poison_vars: set = set()
 
     # -- namespaces ---------------------------------------------------------
     @property
@@ -809,9 +816,66 @@ class SameDiff:
         if missing:
             raise ValueError(f"placeholders not fed: {missing}")
 
-    def output(self, feeds: Dict[str, Any], outputs: Sequence[str]):
+    def poisoned_ancestor(self, targets: Sequence[str]) -> Optional[str]:
+        """First dynamic-dim-sentinel constant in the ancestor set of
+        `targets`, or None. See _poison_vars."""
+        if not self._poison_vars:
+            return None
+        needed = set(targets)
+        for node in reversed(self._nodes):
+            if any(o in needed for o in node.outputs):
+                needed.update(i for i in node.inputs if isinstance(i, str))
+        hit = needed & self._poison_vars
+        return next(iter(hit)) if hit else None
+
+    def derives_poisoned(self, var_name: str) -> bool:
+        """True if `var_name`'s VALUE actually depends on a dynamic-dim
+        sentinel. Provenance (ancestor reaches a poison constant) is
+        necessary but not sufficient: shape chains routinely extract STATIC
+        dims from a dynamic-batch Shape fold (x.shape[1]//2 under torch
+        dynamic_axes). So a provenance hit is refined by probing — evaluate
+        the chain twice with the -1 entries substituted by two values; only
+        a differing result truly depends on the dynamic dim. This also
+        catches arithmetic that maps the batch dim to a plausible
+        nonnegative (batch+5), which a value-sign test would miss."""
+        if self.poisoned_ancestor([var_name]) is None:
+            return False
+        try:
+            r2, r3 = (self._probe_poison_eval(var_name, p) for p in (2, 3))
+        except Exception:
+            return True  # un-evaluable chain: stay conservative
+        return r2.shape != r3.shape or bool((r2 != r3).any())
+
+    def _check_loss_poison(self):
+        """Gradient-path counterpart of output()'s poison check: refuse to
+        build a grad/train function whose loss ancestors include a
+        dynamic-dim sentinel constant (compile-time only, not per-step)."""
+        bad = self.poisoned_ancestor(self._loss_vars)
+        if bad is not None:
+            raise NotImplementedError(
+                f"loss depends on {bad!r}, a shape constant carrying the -1 "
+                "dynamic-dim sentinel (graph imported with a dynamic batch "
+                "dim) — training would silently compute with -1; re-export "
+                "with static shapes")
+
+    def _probe_poison_eval(self, var_name: str, probe: int) -> np.ndarray:
+        """Eagerly evaluate `var_name` with every poison constant's -1
+        entries replaced by `probe` (the chain must be placeholder-free)."""
+        vals: Dict[str, Any] = {}
+        for k, a in self._arrays.items():
+            if k in self._poison_vars:
+                a = np.where(np.asarray(a) == -1, probe, np.asarray(a))
+            vals[k] = a
+        return np.asarray(self._trace(vals, [var_name])[0])
+
+    def output(self, feeds: Dict[str, Any], outputs: Sequence[str],
+               *, _allow_poison: bool = False):
         """batchOutput()/exec() parity: compile the graph for these outputs and
-        input shapes (cached) and run it — one XLA launch."""
+        input shapes (cached) and run it — one XLA launch.
+
+        ``_allow_poison`` is internal to the importers' import-time eager
+        const evaluation, where sentinel-derived shape math is evaluated on
+        purpose and then vetted by ``const()``."""
         outputs = list(outputs)
         self._missing_check(feeds, outputs)
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
@@ -822,6 +886,18 @@ class SameDiff:
         )
         fn = self._jit_cache.get(sig)
         if fn is None:
+            # poison check only on cache miss: the verdict is stable per
+            # (outputs, node-count) signature, and the ancestor scan must
+            # stay off the per-dispatch hot path
+            if not _allow_poison:
+                bad = self.poisoned_ancestor(outputs)
+                if bad is not None:
+                    raise NotImplementedError(
+                        f"output depends on {bad!r}, a shape constant "
+                        "carrying the -1 dynamic-dim sentinel (graph "
+                        "imported with a dynamic batch dim) — its value "
+                        "would silently reach runtime arithmetic as -1; "
+                        "re-export with static shapes")
             def run(arrays, phs):
                 vals = dict(arrays)
                 vals.update(phs)
@@ -936,6 +1012,7 @@ class SameDiff:
         sig = (tuple(sorted(diff)), tuple(sorted(rest)), tuple(sorted(phs)))
         gfn = self._grad_fn_cache.get(sig)
         if gfn is None:
+            self._check_loss_poison()
             gfn = jax.jit(jax.grad(lossfn))
             self._grad_fn_cache[sig] = gfn
         grads = gfn(diff, rest, phs)
@@ -995,6 +1072,7 @@ class SameDiff:
             k: jnp.asarray(v) for k, v in self._arrays.items() if k not in trainables
         }
         if self._train_step is None:
+            self._check_loss_poison()
             self._train_step = self._build_train_step()
         if self._opt_state is None:
             # kept separate from _train_step: load() restores _opt_state with
@@ -1070,6 +1148,8 @@ class SameDiff:
             "training_config": self.training_config.to_dict()
             if self.training_config else None,
             "it_count": self._it_count,
+            **({"poison_vars": sorted(self._poison_vars)}
+               if self._poison_vars else {}),
         }
         buf = io.BytesIO()
         np.savez(buf, **self._arrays)
@@ -1101,6 +1181,7 @@ class SameDiff:
                     sd._producer[o] = node
             sd._loss_vars = meta["loss_vars"]
             sd._it_count = meta.get("it_count", 0)
+            sd._poison_vars = set(meta.get("poison_vars", ()))
             if meta.get("training_config"):
                 sd.training_config = TrainingConfig.from_dict(meta["training_config"])
             if "updater.npz" in zf.namelist() and sd.training_config:

@@ -161,3 +161,18 @@ class TestROCBinary:
         roc.eval(np.zeros((4, 2)), np.zeros((4, 2)))
         with pytest.raises(ValueError, match="2 outputs"):
             roc.eval(np.zeros((4, 3)), np.zeros((4, 3)))
+
+    def test_1d_inputs_with_1d_mask(self, rng):
+        """Round-5 regression: a 1-D mask must be expanded alongside 1-D
+        labels/scores (previously IndexError on mask[:, i])."""
+        from deeplearning4j_tpu.eval import ROCBinary
+
+        n = 200
+        y = rng.integers(0, 2, size=n).astype(np.float32)
+        s = y * 0.8 + rng.random(n).astype(np.float32) * 0.2
+        mask = np.ones(n, np.float32)
+        mask[: n // 4] = 0.0
+        roc = ROCBinary()
+        roc.eval(y, s, mask=mask)
+        assert roc.num_outputs() == 1
+        assert roc.calculate_auc(0) > 0.95

@@ -585,6 +585,39 @@ class TestONNXDynamicBatch:
         with pytest.raises(NotImplementedError, match="dynamic dim"):
             import_onnx(data)
 
+    def test_slice_end_from_dynamic_dim_rejected_loudly(self):
+        """Round-5 regression (advisor repro): x[:x.shape[0]] exported with
+        dynamic_axes folds the batch dim as the -1 sentinel, which reached
+        Slice `ends` as a plain negative index and silently dropped the
+        last row. const() now rejects sentinel-derived values for every
+        consumer except Reshape."""
+
+        class _SliceByShape(torch.nn.Module):
+            def forward(self, x):
+                return x[: x.shape[0]] + 1.0
+
+        data = self._export_dynamic(
+            _SliceByShape().eval(), torch.randn(2, 4))
+        with pytest.raises(NotImplementedError, match="dynamic"):
+            import_onnx(data)
+
+    def test_static_dim_extracted_from_dynamic_shape_still_imports(self):
+        """Provenance taint alone would over-reject: x.shape[1]//2 derives
+        from the dynamic-batch Shape fold but its VALUE is static. The
+        dependence probe (evaluate with two sentinel substitutions) keeps
+        this importable while still rejecting true batch-dependence."""
+
+        class _HalfSlice(torch.nn.Module):
+            def forward(self, x):
+                return x[:, : x.shape[1] // 2] * 2.0
+
+        m = _HalfSlice().eval()
+        sd = import_onnx(self._export_dynamic(m, torch.randn(2, 6)))
+        for b in (2, 5):
+            x = torch.randn(b, 6)
+            out = np.asarray(sd.output({"x": x.numpy()}, ["y"])["y"])
+            np.testing.assert_allclose(out, m(x).numpy(), atol=1e-6)
+
 
 class TestTFDynamicBatch:
     def test_imported_graph_runs_at_two_batch_sizes(self, rng):

@@ -219,3 +219,19 @@ class TestFastText:
         ft2 = FastText.load(p)
         for t, _ in self.CORPUS:
             assert ft2.predict(t) == ft.predict(t)
+
+    def test_min_count_ids_contiguous(self):
+        """Round-5 regression: with min_count>1 vocab ids were assigned
+        before filtering — non-contiguous, overlapping the n-gram bucket
+        range and able to exceed the embedding row count."""
+        from deeplearning4j_tpu.nlp import FastText
+
+        texts = [t for t, _ in self.CORPUS]
+        labels = [l for _, l in self.CORPUS]
+        ft = FastText(dim=8, epoch=2, min_count=2, bucket=64,
+                      seed=0).fit(texts, labels)
+        ids = sorted(ft.vocab.values())
+        assert ids == list(range(len(ft.vocab)))
+        # every id must index below the n-gram bucket range
+        assert max(ids) < len(ft.vocab)
+        ft.predict(texts[0])  # exercises the embedding lookup end-to-end
