@@ -168,11 +168,15 @@ def bench_scaling():
     }
 
 
-def bench_attention_2k(batch: int = 4, seq: int = 2048, iters: int = 8):
+def bench_attention_2k(batch: int = 4, seq: int = 2048, k_lo: int = 8,
+                       k_hi: int = 40):
     """Extra metric (VERDICT r2 #5): seq-2048 flash-attention fwd+bwd token
     throughput — the regime where the Pallas kernel earns its keep (measured
-    crossover table in BASELINE.md). K iterations inside ONE jit to amortize
-    the tunnel dispatch."""
+    crossover table in BASELINE.md). TWO-POINT FIT (BASELINE.md round-4
+    methodology): time K-iteration scans at two K inside one jit each and
+    take (wall(K_hi) - wall(K_lo)) / (K_hi - K_lo), cancelling the
+    session-variable tunnel round-trip latency (measured 4-135 ms across
+    sessions) that a single-call timing would fold into every iteration."""
     import jax
     import jax.numpy as jnp
 
@@ -190,22 +194,42 @@ def bench_attention_2k(batch: int = 4, seq: int = 2048, iters: int = 8):
 
     g = jax.value_and_grad(loss, argnums=(0, 1, 2))
 
-    @jax.jit
-    def many(q, k, v):
-        def body(c, s):
-            val, grads = g(q, k, v, s.astype(jnp.bfloat16))
-            return c + val + sum(jnp.sum(x).astype(jnp.float32)
-                                 for x in grads), None
+    def make_many(iters):
+        @jax.jit
+        def many(q, k, v):
+            def body(c, s):
+                val, grads = g(q, k, v, s.astype(jnp.bfloat16))
+                return c + val + sum(jnp.sum(x).astype(jnp.float32)
+                                     for x in grads), None
 
-        out, _ = jax.lax.scan(
-            body, jnp.float32(0),
-            jnp.arange(iters, dtype=jnp.float32) * 1e-6)
-        return out
+            out, _ = jax.lax.scan(
+                body, jnp.float32(0),
+                jnp.arange(iters, dtype=jnp.float32) * 1e-6)
+            return out
+        return many
 
-    float(many(q, k, v))  # compile + warm
-    t0 = time.perf_counter()
-    float(many(q, k, v))
-    dt = (time.perf_counter() - t0) / iters
+    def timed(fn):
+        float(fn(q, k, v))  # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(fn(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lo_fn, hi_fn = make_many(k_lo), make_many(k_hi)
+    dt = None
+    for _ in range(3):  # jitter can make t_hi <= t_lo; retry, never clamp
+        t_lo = timed(lo_fn)
+        t_hi = timed(hi_fn)
+        if t_hi > t_lo:
+            dt = (t_hi - t_lo) / (k_hi - k_lo)
+            break
+    if dt is None:
+        raise RuntimeError(
+            f"two-point fit invalid after retries (t_lo={t_lo:.4f}s >= "
+            f"t_hi={t_hi:.4f}s): session latency noise exceeds the "
+            "device-time delta; not reporting a corrupted number")
     return {
         "metric": "flash_attention_seq2048_tokens_per_sec",
         "model": f"flash fwd+bwd B={batch} H={H} S={seq} D={D} bf16",

@@ -1,0 +1,19 @@
+"""Clustering + nearest-neighbour search.
+
+Reference parity: the ``deeplearning4j-nearestneighbors-parent`` module
+family (org.deeplearning4j.clustering.kmeans.KMeansClustering,
+clustering.vptree.VPTree, clustering.kdtree.KDTree,
+clustering.lsh.RandomProjectionLSH — path-cites, mount empty this round).
+
+TPU-native design: KMeans runs its Lloyd iterations as ONE jitted XLA
+program (distance matrix on the MXU, lax.fori_loop over iterations) instead
+of the reference's threaded JVM loop; the tree structures (VPTree/KDTree)
+are host-side index structures exactly as in the reference — they serve
+CPU-bound nearest-neighbour queries (the nearest-neighbors-server use case),
+not device compute. LSH hashes with one device matmul and queries host-side.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeans  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH  # noqa: F401

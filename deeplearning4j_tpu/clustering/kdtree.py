@@ -1,0 +1,69 @@
+"""KD-tree for low-dimensional nearest-neighbour search.
+
+Reference parity: org.deeplearning4j.clustering.kdtree.KDTree (path-cite,
+mount empty this round). Host-side pointer structure like the reference;
+the box-pruning bound is the same quantity as the registered
+``knn_mindistance`` op.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+
+class _KDNode:
+    __slots__ = ("index", "axis", "left", "right")
+
+    def __init__(self, index, axis):
+        self.index = index
+        self.axis = axis
+        self.left = None
+        self.right = None
+
+
+class KDTree:
+    def __init__(self, items):
+        self.items = np.asarray(items, np.float64)
+        if self.items.ndim != 2:
+            raise ValueError("items must be (N, D)")
+        self.dims = self.items.shape[1]
+        self.root = self._build(list(range(len(self.items))), 0)
+
+    def _build(self, idx, depth):
+        if not idx:
+            return None
+        axis = depth % self.dims
+        idx.sort(key=lambda i: self.items[i, axis])
+        mid = len(idx) // 2
+        node = _KDNode(idx[mid], axis)
+        node.left = self._build(idx[:mid], depth + 1)
+        node.right = self._build(idx[mid + 1:], depth + 1)
+        return node
+
+    def query(self, x, k: int = 1):
+        """(indices, distances) of the k nearest, euclidean, ascending."""
+        x = np.asarray(x, np.float64)
+        heap = []  # max-heap of (-dist, index)
+
+        def search(node):
+            if node is None:
+                return
+            p = self.items[node.index]
+            d = float(np.linalg.norm(x - p))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.index))
+            diff = x[node.axis] - p[node.axis]
+            near, far = (node.left, node.right) if diff <= 0 else \
+                (node.right, node.left)
+            search(near)
+            tau = -heap[0][0] if len(heap) == k else np.inf
+            if abs(diff) < tau:   # hypersphere crosses the splitting plane
+                search(far)
+
+        search(self.root)
+        out = sorted(((-nd, i) for nd, i in heap))
+        return ([i for _, i in out], [d for d, _ in out])

@@ -1,0 +1,72 @@
+"""Random-projection LSH for approximate cosine nearest neighbours.
+
+Reference parity: org.deeplearning4j.clustering.lsh.RandomProjectionLSH
+(path-cite, mount empty this round): sign-of-random-projection hashing for
+cosine similarity. TPU-native: the (N, bits) projection is one device
+matmul; bucket lookup is host-side.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RandomProjectionLSH:
+    def __init__(self, hash_bits: int = 16, seed: int = 0):
+        self.hash_bits = int(hash_bits)
+        self.seed = int(seed)
+        self._planes = None
+        self._buckets = None
+        self.items = None
+
+    def _project(self, x):
+        return np.asarray(jnp.asarray(x, jnp.float32) @ self._planes) > 0
+
+    def fit(self, items):
+        self.items = np.asarray(items, np.float32)
+        d = self.items.shape[1]
+        key = jax.random.PRNGKey(self.seed)
+        self._planes = jnp.asarray(
+            jax.random.normal(key, (d, self.hash_bits), jnp.float32))
+        signs = self._project(self.items)            # (N, bits) bool
+        self._codes = np.packbits(signs, axis=1)
+        self._buckets = {}
+        for i, code in enumerate(map(bytes, self._codes)):
+            self._buckets.setdefault(code, []).append(i)
+        return self
+
+    def query(self, x, k: int = 1, max_probes: int = 8, oversample: int = 4):
+        """Approximate k nearest by cosine: probe buckets in code-Hamming
+        order until ``oversample * k`` candidates are gathered or
+        ``max_probes`` distinct buckets were searched (a cap, not a floor —
+        a dense first bucket satisfies a small query immediately). Returns
+        (indices, cosine_distances)."""
+        if self._buckets is None:
+            raise RuntimeError("fit() first")
+        x = np.asarray(x, np.float32)
+        sign = self._project(x[None, :])[0]
+        cands = []
+        # rank stored codes by hamming distance to the query code
+        q = np.unpackbits(np.packbits(sign))[:self.hash_bits]
+        codes_bits = np.unpackbits(self._codes, axis=1)[:, :self.hash_bits]
+        ham = np.sum(codes_bits != q[None, :], axis=1)
+        order = np.argsort(ham, kind="stable")
+        seen_codes = set()
+        for i in order:
+            code = bytes(self._codes[i])
+            if code in seen_codes:
+                continue
+            seen_codes.add(code)
+            cands.extend(self._buckets[code])
+            if (len(cands) >= max(k, 1) * max(oversample, 1)
+                    or len(seen_codes) >= max_probes):
+                break
+        if not cands:
+            cands = list(range(len(self.items)))
+        cand_arr = self.items[cands]
+        na = np.linalg.norm(cand_arr, axis=1) * np.linalg.norm(x)
+        cos = 1.0 - (cand_arr @ x) / np.maximum(na, 1e-12)
+        top = np.argsort(cos, kind="stable")[:k]
+        return [cands[i] for i in top], [float(cos[i]) for i in top]

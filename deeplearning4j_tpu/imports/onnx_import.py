@@ -223,7 +223,7 @@ class OnnxImporter:
         # output's runtime ancestors include a dynamic-dim sentinel constant
         # (it slipped past const() into real arithmetic), fail now — not at
         # the first inference call
-        bad = self.sd.poisoned_ancestor(
+        bad = self.sd.poisoned_ancestor_refined(
             [self.vars[o].name for o in self.graph_outputs
              if o in self.vars])
         if bad is not None:

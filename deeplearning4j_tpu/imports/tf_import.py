@@ -1629,8 +1629,10 @@ def _shape_n(m, node):
         if shp is None or any(s is None for s in shp):
             raise UnsupportedOpError("ShapeN of dynamically-shaped tensor")
         arr = np.asarray(shp, np.int32)
-        m.set(node.name, m.sd.constant(arr, name=f"{node.name}_{i}"),
-              slot=i, const_val=arr)
+        cvar = m.sd.constant(arr, name=f"{node.name}_{i}")
+        m.set(node.name, cvar, slot=i, const_val=arr)
+        if (arr == -1).any():  # same dynamic-dim taint as the Shape rule
+            m.dyn_vars.add(cvar.name)
 
 
 @rule("DynamicStitch", "ParallelDynamicStitch")

@@ -1,0 +1,7 @@
+"""Manifold learning (t-SNE).
+
+Reference parity: deeplearning4j-manifold / BarnesHutTsne
+(org.deeplearning4j.plot.BarnesHutTsne, path-cite, mount empty this round).
+"""
+
+from deeplearning4j_tpu.manifold.tsne import Tsne  # noqa: F401

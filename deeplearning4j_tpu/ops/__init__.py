@@ -31,9 +31,21 @@ from deeplearning4j_tpu.ops import (  # noqa: F401
     elementwise,
     image,
     linalg,
+    nlp_ops,
     nn,
     random,
     reduce,
     rnn,
     shape_ops,
+    updater_ops,
 )
+
+# Reference spellings for ops registered under their canonical names here
+# (libnd4j loss-op names; OpRegistrator multi-name parity).
+from deeplearning4j_tpu.ops.registry import add_alias as _add_alias  # noqa: E402
+
+_add_alias("sigm_cross_entropy_loss", "sigmoid_cross_entropy")
+_add_alias("softmax_cross_entropy_loss_with_logits", "softmax_cross_entropy")
+_add_alias("sparse_softmax_cross_entropy_loss_with_logits",
+           "sparse_softmax_cross_entropy")
+_add_alias("lrelu", "leakyrelu")

@@ -366,3 +366,129 @@ op("expint", "transform_float")(jax.scipy.special.expi)
 op("pow_derivative", "scalar")(lambda x, p=2.0: p * jnp.power(x, p - 1.0))
 op("fill_like", "transform_same", aliases=("full_like",))(
     lambda x, value=0.0: jnp.full_like(x, value))
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tail: rotate-right, hamming distance, fake-quantization,
+# compare_and_bitpack, zero_fraction, check_numerics (libnd4j
+# generic/parity_ops: cyclic_rshift_bits.cpp, bits_hamming_distance.cpp,
+# fake_quant_with_min_max_vars.cpp (+_per_channel), compare_and_bitpack.cpp,
+# zero_fraction.cpp, check_numerics.cpp — path-cites, mount empty).
+# ---------------------------------------------------------------------------
+
+@op("cyclic_rshift_bits", "pairwise_bool", aliases=("rotr",),
+    differentiable=False)
+def cyclic_rshift_bits(x, n):
+    """Rotate-right of integer bits — rotl with the complementary count
+    (same unsigned-view care as cyclic_shift_bits)."""
+    x = jnp.asarray(x)
+    bits = x.dtype.itemsize * 8
+    n = jnp.asarray(n) % bits
+    return cyclic_shift_bits(x, (bits - n) % bits)
+
+
+@op("bits_hamming_distance", "reduce_long", differentiable=False)
+def bits_hamming_distance(x, y):
+    """Total popcount of x XOR y over all elements (reference
+    bits_hamming_distance) — a scalar int."""
+    x = jnp.asarray(x)
+    v = jnp.bitwise_xor(x, jnp.asarray(y, x.dtype))
+    bits = x.dtype.itemsize * 8
+    u = v.view(jnp.dtype(f"uint{bits}"))
+    # SWAR popcount on the unsigned view (XLA has no popcnt HLO)
+    ones = jnp.asarray(1, u.dtype)
+    cnt = jnp.zeros_like(u)
+    for i in range(bits):
+        cnt = cnt + ((u >> i) & ones)
+    return jnp.sum(cnt.astype(jnp.int32))
+
+
+def _fake_quant(x, qmin, qmax, minv, maxv):
+    """Shared nudged-range fake quantization (TF semantics): the zero point
+    is nudged onto the integer grid, x is clamped to the nudged range,
+    quantized, and dequantized. Gradient: straight-through inside the
+    nudged range, zero outside (TF's FakeQuantWithMinMaxVarsGradient)."""
+    scale = (maxv - minv) / (qmax - qmin)
+    scale = jnp.where(scale == 0, 1e-8, scale)
+    zero_f = qmin - minv / scale
+    nudged_zero = jnp.clip(jnp.round(zero_f), qmin, qmax)
+    nmin = (qmin - nudged_zero) * scale
+    nmax = (qmax - nudged_zero) * scale
+
+    @jax.custom_vjp
+    def q(x):
+        clamped = jnp.clip(x, nmin, nmax)
+        return jnp.round((clamped - nmin) / scale) * scale + nmin
+
+    def fwd(x):
+        return q(x), (x,)
+
+    def bwd(res, g):
+        (x,) = res
+        return (jnp.where((x >= nmin) & (x <= nmax), g, 0.0),)
+
+    q.defvjp(fwd, bwd)
+    return q(x)
+
+
+@op("fake_quant_with_min_max_vars", "transform_float",
+    aliases=("fake_quant_with_min_max_args",))
+def fake_quant_with_min_max_vars(x, min=-6.0, max=6.0, num_bits=8,
+                                 narrow_range=False):
+    """TF FakeQuantWithMinMaxVars: quantize-dequantize through a nudged
+    [min, max] range with straight-through gradients."""
+    x = jnp.asarray(x)
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = float(2 ** int(num_bits) - 1)
+    return _fake_quant(x, qmin, qmax, jnp.asarray(min, x.dtype),
+                       jnp.asarray(max, x.dtype))
+
+
+@op("fake_quant_with_min_max_vars_per_channel", "transform_float")
+def fake_quant_with_min_max_vars_per_channel(x, min, max, num_bits=8,
+                                             narrow_range=False):
+    """Per-channel variant: min/max are vectors over the LAST axis."""
+    x = jnp.asarray(x)
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = float(2 ** int(num_bits) - 1)
+    return _fake_quant(x, qmin, qmax,
+                       jnp.asarray(min, x.dtype), jnp.asarray(max, x.dtype))
+
+
+@op("compare_and_bitpack", "transform_bool", differentiable=False)
+def compare_and_bitpack(x, threshold):
+    """Pack (x > threshold) into uint8, 8 lanes per byte, MSB first (TF
+    compare_and_bitpack / reference op). Innermost dim must be a multiple
+    of 8; output innermost dim is /8."""
+    x = jnp.asarray(x)
+    bits = (x > jnp.asarray(threshold, x.dtype)).astype(jnp.uint8)
+    if x.shape[-1] % 8:
+        raise ValueError("compare_and_bitpack: last dim must be divisible "
+                         f"by 8, got {x.shape[-1]}")
+    b = bits.reshape(x.shape[:-1] + (x.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint8)
+
+
+@op("zero_fraction", "summarystats", differentiable=False)
+def zero_fraction(x):
+    """Fraction of zero entries (reference zero_fraction) — scalar fp32."""
+    x = jnp.asarray(x)
+    return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("check_numerics", "transform_same", differentiable=False)
+def check_numerics(x, message="check_numerics failed"):
+    """Identity that rejects NaN/Inf. Eager calls raise immediately; under
+    jit the check folds into the profiler's NaN-panic path
+    (util.profiler.ProfilerConfig(check_for_nan=True)) — XLA programs
+    cannot raise mid-graph, same design as the reference's executioner-level
+    nanPanic rather than its per-op CUDA assert."""
+    x = jnp.asarray(x)
+    import jax.core as _core
+
+    finite = jnp.all(jnp.isfinite(x))
+    if not isinstance(finite, _core.Tracer):  # eager: enforce now
+        if not bool(finite):
+            raise FloatingPointError(message)
+    return x

@@ -219,3 +219,82 @@ def logdet(a):
 @op("cond_number", "linalg", differentiable=False)
 def cond_number(a, p=None):
     return jnp.linalg.cond(a, p=p)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tail (libnd4j generic/parity_ops & blas: lup.cpp,
+# matrix_set_diag.cpp, lstsq.cpp solve_ls mode, sufficient_statistics.cpp —
+# path-cites, mount empty this round).
+# ---------------------------------------------------------------------------
+
+@op("lup", "linalg", differentiable=False)
+def lup(a):
+    """LU with explicit permutation: returns (L, U, p) where a[p] = L @ U —
+    the reference's lup op returns the permutation alongside the factors
+    (its plain lu packs LU into one matrix)."""
+    import jax.scipy.linalg as jsl
+
+    lu_mat, piv = jsl.lu_factor(a)
+    n = a.shape[-1]
+    l = jnp.tril(lu_mat, -1) + jnp.eye(n, dtype=a.dtype)
+    u = jnp.triu(lu_mat)
+    # pivot sequence -> permutation vector
+    perm = jnp.arange(n)
+
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+
+    perm = lax.fori_loop(0, piv.shape[0], body, perm)
+    return l, u, perm
+
+
+@op("matrix_set_diag", "linalg")
+def matrix_set_diag(x, diagonal):
+    """Replace the main diagonal of the innermost 2-D matrices (reference
+    matrix_set_diag / TF raw op)."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    eye = (jnp.arange(m)[:, None] == jnp.arange(n)[None, :])
+    d = jnp.asarray(diagonal, x.dtype)
+    dmat = jnp.zeros(x.shape, x.dtype).at[
+        ..., jnp.arange(k), jnp.arange(k)].set(d)
+    return jnp.where(eye, dmat, x)
+
+
+@op("solve_ls", "linalg", differentiable=False)
+def solve_ls(a, b, l2_regularizer=0.0, fast=True):
+    """Regularized least-squares solve (TF matrix_solve_ls / reference
+    lstsq's solve_ls mode): argmin_x |ax - b|^2 + l2 |x|^2. ``fast`` uses
+    the normal equations (a^T a + l2 I) x = a^T b on the MXU; the slow path
+    falls back to SVD-based lstsq (exact minimum-norm at l2=0)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if fast:
+        at = jnp.swapaxes(a, -1, -2)
+        g = at @ a + l2_regularizer * jnp.eye(a.shape[-1], dtype=a.dtype)
+        return jnp.linalg.solve(g, at @ b)
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("sufficient_statistics", "summarystats", differentiable=False)
+def sufficient_statistics(x, axes, shift=None):
+    """(count, mean_ss, variance_ss, shift) per TF nn.sufficient_statistics
+    (reference sufficient_statistics op): the streaming-moment building
+    blocks consumed by ``normalize_moments``."""
+    x = jnp.asarray(x)
+    axes = tuple(axes)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    count = jnp.asarray(float(n), jnp.float32)
+    if shift is not None:
+        shifted = x - shift
+        m_ss = jnp.sum(shifted, axis=axes)
+        v_ss = jnp.sum(shifted * shifted, axis=axes)
+    else:
+        m_ss = jnp.sum(x, axis=axes)
+        v_ss = jnp.sum(x * x, axis=axes)
+    return count, m_ss, v_ss, shift

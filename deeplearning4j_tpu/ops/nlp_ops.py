@@ -1,0 +1,155 @@
+"""NLP / manifold native-helper ops.
+
+Reference parity: libnd4j implements the hot loops of two JVM modules as
+native declarable ops (path-cites, mount empty this round):
+
+- Word2Vec training: ``skipgram`` / ``cbow``
+  (libnd4j/include/ops/declarable/generic/nn/embeddings/, invoked from
+  nd4j's Word2Vec trainer) — one in-place embedding-table update per call.
+- Barnes-Hut t-SNE + nearest-neighbour search (deeplearning4j-manifold /
+  deeplearning4j-nearestneighbors-parent): ``barnes_symmetrized``,
+  ``barnes_edge_forces``, ``barnes_gains``, ``cell_contains``,
+  ``knn_mindistance`` (libnd4j/include/ops/declarable/generic/parity_ops/ and
+  helpers/knn_mindistance.cpp).
+
+TPU-native design: all ops are pure functions over static shapes (the COO
+edge lists keep their length; "in-place" table updates return the new table —
+under jit XLA turns ``table.at[idx].add`` into an in-place scatter via buffer
+donation). The consumers live in ``nlp/word2vec.py`` and ``manifold/tsne.py``;
+these registry entries are the by-name/native-op-parity surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+@op("skipgram", "nlp")
+def skipgram(syn0, syn1, target, samples, labels, lr=0.025):
+    """One skip-gram update against sampled output rows.
+
+    ``syn0``: (V, D) input embeddings; ``syn1``: (V', D) output weights
+    (negative-sampling table syn1neg, or the hierarchical-softmax inner-node
+    table — the math is identical, reference skipgram.cpp handles both the
+    same way); ``target``: scalar int — the center-word row of ``syn0``;
+    ``samples``: (K,) int rows of ``syn1`` (positive context / tree path +
+    negatives); ``labels``: (K,) float targets (1 for positive / 1-code, 0
+    otherwise). Returns ``(new_syn0, new_syn1, loss)`` with the standard
+    sigmoid-binary update: g = lr * (label - sigmoid(w·h)).
+    """
+    syn0 = jnp.asarray(syn0)
+    syn1 = jnp.asarray(syn1)
+    labels = jnp.asarray(labels, syn0.dtype)
+    h = syn0[target]                         # (D,)
+    w = syn1[samples]                        # (K, D)
+    logits = w @ h                           # (K,)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * jnp.asarray(lr, syn0.dtype)
+    new_syn0 = syn0.at[target].add(g @ w)
+    new_syn1 = syn1.at[samples].add(g[:, None] * h[None, :])
+    eps = jnp.asarray(1e-7, syn0.dtype)
+    loss = -jnp.sum(labels * jnp.log(p + eps)
+                    + (1 - labels) * jnp.log(1 - p + eps))
+    return new_syn0, new_syn1, loss
+
+
+@op("cbow", "nlp")
+def cbow(syn0, syn1, context, samples, labels, lr=0.025,
+         context_mask=None):
+    """One CBOW update: like ``skipgram`` but the hidden vector is the mean
+    of the context rows of ``syn0``, and its gradient is spread back over
+    them (reference cbow.cpp). ``context``: (C,) int rows; ``context_mask``:
+    optional (C,) float 0/1 mask for padded context slots."""
+    syn0 = jnp.asarray(syn0)
+    syn1 = jnp.asarray(syn1)
+    labels = jnp.asarray(labels, syn0.dtype)
+    ctx = syn0[context]                      # (C, D)
+    if context_mask is None:
+        denom = jnp.asarray(ctx.shape[0], syn0.dtype)
+        h = jnp.sum(ctx, axis=0) / denom
+        mask = None
+    else:
+        mask = jnp.asarray(context_mask, syn0.dtype)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        h = jnp.sum(ctx * mask[:, None], axis=0) / denom
+    w = syn1[samples]
+    p = jax.nn.sigmoid(w @ h)
+    g = (labels - p) * jnp.asarray(lr, syn0.dtype)
+    dh = (g @ w) / denom                     # shared by every context word
+    dctx = jnp.broadcast_to(dh, ctx.shape)
+    if mask is not None:
+        dctx = dctx * mask[:, None]
+    new_syn0 = syn0.at[context].add(dctx)
+    new_syn1 = syn1.at[samples].add(g[:, None] * h[None, :])
+    eps = jnp.asarray(1e-7, syn0.dtype)
+    loss = -jnp.sum(labels * jnp.log(p + eps)
+                    + (1 - labels) * jnp.log(1 - p + eps))
+    return new_syn0, new_syn1, loss
+
+
+@op("barnes_symmetrized", "nlp", differentiable=False)
+def barnes_symmetrized(rows, cols, vals):
+    """Symmetrize a COO affinity list: P_sym = (P + P^T)/2 expressed as the
+    2E-edge concatenation of (i,j,v/2) and (j,i,v/2) — static shapes, no
+    sparse machinery (reference BarnesHutSymmetrize → barnes_symmetrized,
+    path-cite). Duplicate coordinates are legal COO and every consumer here
+    (``barnes_edge_forces``) scatter-adds."""
+    rows = jnp.asarray(rows)
+    cols = jnp.asarray(cols)
+    vals = jnp.asarray(vals)
+    return (jnp.concatenate([rows, cols]), jnp.concatenate([cols, rows]),
+            jnp.concatenate([vals, vals]) * 0.5)
+
+
+@op("barnes_edge_forces", "nlp")
+def barnes_edge_forces(rows, cols, vals, y):
+    """Attractive t-SNE edge forces from a COO affinity list.
+
+    F[i] += v_ij * (y_i - y_j) / (1 + |y_i - y_j|^2) for each edge — the
+    exact per-edge kernel of reference barnes_edge_forces (path-cite),
+    accumulated with one segment-sum instead of the reference's per-row
+    loop."""
+    y = jnp.asarray(y)
+    rows = jnp.asarray(rows)
+    cols = jnp.asarray(cols)
+    vals = jnp.asarray(vals, y.dtype)
+    diff = y[rows] - y[cols]                            # (E, d)
+    w = vals / (1.0 + jnp.sum(diff * diff, axis=1))     # (E,)
+    contrib = diff * w[:, None]
+    return jax.ops.segment_sum(contrib, rows, num_segments=y.shape[0])
+
+
+@op("barnes_gains", "nlp", differentiable=False)
+def barnes_gains(gains, gradient, y_incs, min_gain=0.01):
+    """t-SNE adaptive per-dimension gains: +0.2 where the gradient flips the
+    direction of travel, x0.8 where it persists, floored at ``min_gain``
+    (reference barnes_gains, path-cite — same constants)."""
+    gains = jnp.asarray(gains)
+    same_sign = jnp.sign(jnp.asarray(gradient)) == jnp.sign(jnp.asarray(y_incs))
+    out = jnp.where(same_sign, gains * 0.8, gains + 0.2)
+    return jnp.maximum(out, min_gain)
+
+
+@op("cell_contains", "nlp", differentiable=False)
+def cell_contains(corner, width, point):
+    """Whether ``point`` lies inside the quad/oct-tree cell centred at
+    ``corner`` with half-width ``width`` per dimension (reference
+    cell_contains, path-cite). Returns a scalar bool."""
+    corner = jnp.asarray(corner)
+    return jnp.all(jnp.abs(jnp.asarray(point) - corner)
+                   <= jnp.asarray(width))
+
+
+@op("knn_mindistance", "nlp", differentiable=False)
+def knn_mindistance(point, lowest, highest):
+    """Minimum Euclidean distance from ``point`` to the axis-aligned box
+    [lowest, highest] — the KD/VP-tree pruning bound (reference
+    helpers/knn_mindistance.cpp, path-cite). Zero when the point is inside."""
+    point = jnp.asarray(point)
+    gap = jnp.maximum(jnp.asarray(lowest) - point,
+                      point - jnp.asarray(highest))
+    gap = jnp.maximum(gap, 0.0)
+    return jnp.sqrt(jnp.sum(gap * gap))

@@ -871,3 +871,209 @@ def log_poisson_loss(log_input, targets, compute_full_loss=False):
                                               * jnp.maximum(targets, 1.0)))
         loss = loss + jnp.where(targets >= 1.0, stirling, 0.0)
     return loss
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tail: morphological / argmax pooling / 3-D transposed conv
+# (reference: libnd4j generic/nn/convo dilation2d.cpp, deconv3d.cpp,
+#  max_pool_with_argmax.cpp, upsampling3d.cpp, relu_layer.cpp — path-cites,
+#  mount empty this round).
+# ---------------------------------------------------------------------------
+
+def _patches2d(x, kh, kw, strides, rates, padding):
+    """(B,Ho,Wo,kh*kw,C) window view via static shifted slices — XLA folds
+    these into one gather; no im2col materialization at conv time."""
+    sh, sw = strides
+    rh, rw = rates
+    b, h, w, c = x.shape
+    eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+    if padding == "SAME":
+        ho = -(-h // sh)
+        wo = -(-w // sw)
+        pad_h = max((ho - 1) * sh + eff_kh - h, 0)
+        pad_w = max((wo - 1) * sw + eff_kw - w, 0)
+        pads = ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                (pad_w // 2, pad_w - pad_w // 2), (0, 0))
+    else:
+        ho = (h - eff_kh) // sh + 1
+        wo = (w - eff_kw) // sw + 1
+        pads = ((0, 0), (0, 0), (0, 0), (0, 0))
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, pads, constant_values=neg)
+    cols = []
+    for dy in range(kh):
+        for dx in range(kw):
+            y0, x0 = dy * rh, dx * rw
+            cols.append(lax.slice(
+                xp, (0, y0, x0, 0),
+                (b, y0 + (ho - 1) * sh + 1, x0 + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1)))
+    return jnp.stack(cols, axis=3), pads  # (B,Ho,Wo,kh*kw,C)
+
+
+@op("dilation2d", "conv")
+def dilation2d(x, filter, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (TF nn.dilation2d / reference
+    dilation2d op): out = max over window of (x + filter). x: NHWC,
+    filter: (kh, kw, C)."""
+    filter = jnp.asarray(filter, x.dtype)
+    kh, kw, _ = filter.shape
+    pat, _ = _patches2d(x, kh, kw, _pair(strides), _pair(rates), padding)
+    return jnp.max(pat + filter.reshape(1, 1, 1, kh * kw, -1), axis=3)
+
+
+@op("erosion2d", "conv")
+def erosion2d(x, filter, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Morphological erosion: min over window of (x - filter) — the TF
+    duality erosion(x, f) = -dilation(-x, reverse(f))."""
+    filter = jnp.asarray(filter, x.dtype)
+    rev = filter[::-1, ::-1, :]
+    return -dilation2d(-x, rev, strides=strides, rates=rates,
+                       padding=padding)
+
+
+@op("max_pool_with_argmax", "pooling", differentiable=False)
+def max_pool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID",
+                         include_batch_in_index=False):
+    """Max pooling returning (values, argmax) with TF's flat-index
+    convention: idx = ((b*H + y)*W + x)*C + c (b term only when
+    ``include_batch_in_index``). Reference max_pool_with_argmax, path-cite."""
+    kh, kw = _pair(kernel)
+    strides = _pair(strides if strides is not None else kernel)
+    b, h, w, c = x.shape
+    pat, pads = _patches2d(x, kh, kw, strides, (1, 1), padding)
+    vals = jnp.max(pat, axis=3)
+    arg = jnp.argmax(pat, axis=3)                       # window-local k
+    ho, wo = arg.shape[1], arg.shape[2]
+    ky, kx = arg // kw, arg % kw
+    oy = jnp.arange(ho).reshape(1, ho, 1, 1) * strides[0] - pads[1][0]
+    ox = jnp.arange(wo).reshape(1, 1, wo, 1) * strides[1] - pads[2][0]
+    iy = jnp.clip(oy + ky, 0, h - 1)
+    ix = jnp.clip(ox + kx, 0, w - 1)
+    ci = jnp.arange(c).reshape(1, 1, 1, c)
+    flat = (iy * w + ix) * c + ci
+    if include_batch_in_index:
+        flat = flat + jnp.arange(b).reshape(b, 1, 1, 1) * (h * w * c)
+    return vals, flat
+
+
+@op("deconv3d", "conv", aliases=("conv3d_transpose",))
+def deconv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME"):
+    """3-D transposed convolution, NDHWC; w: [kD,kH,kW,C,Cout] (DHWIO with
+    I = x's channel count, the forward conv's output channels) — reference
+    deconv3d, path-cite."""
+    if isinstance(strides, int):
+        strides = (strides,) * 3
+    strides = tuple(strides)
+    if len(strides) != 3:
+        raise ValueError(f"deconv3d strides must be length 3, got {strides}")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    out = lax.conv_transpose(
+        x, w, strides=tuple(strides),
+        padding=padding if isinstance(padding, str)
+        else [(p, p) for p in padding],
+        dimension_numbers=dn,
+    ).astype(x.dtype)
+    if b is not None:
+        out = out + b.reshape(1, 1, 1, 1, -1).astype(out.dtype)
+    return out
+
+
+@op("upsampling3d", "conv")
+def upsampling3d(x, scale=2):
+    """Nearest-neighbour 3-D upsampling, NDHWC (reference upsampling3d)."""
+    if isinstance(scale, int):
+        scale = (scale,) * 3
+    sd, sh, sw = scale
+    return jnp.repeat(jnp.repeat(jnp.repeat(x, sd, axis=1), sh, axis=2),
+                      sw, axis=3)
+
+
+@op("relu_layer", "nn_misc")
+def relu_layer(x, w, b=None):
+    """relu(x @ w + b) — the reference's fused relu_layer op (path-cite)."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return jax.nn.relu(y)
+
+
+@op("mean_pairwssqerr_loss", "loss")
+def mean_pairwssqerr_loss(predictions, labels, weights=None):
+    """Mean pairwise squared error (TF losses.mean_pairwise_squared_error /
+    reference mean_pairwssqerr_loss): per sample, the mean over ordered
+    element pairs (i != j) of (d_i - d_j)^2 / 2 where d = prediction - label,
+    computed via the identity sum_{i,j}(d_i-d_j)^2 = 2n*sum d^2 - 2(sum d)^2
+    (verified against the explicit O(n^2) loop in tests)."""
+    d = (_accf(predictions) - _accf(labels)).reshape(predictions.shape[0], -1)
+    n = d.shape[1]
+    if n < 2:
+        return jnp.zeros(())
+    sum_sq = jnp.sum(d * d, axis=1)
+    sq_sum = jnp.square(jnp.sum(d, axis=1))
+    per = (n * sum_sq - sq_sum) / (n * (n - 1))
+    return _weighted_mean(per, weights)
+
+
+@op("ctc_beam_search_decoder", "decoder", differentiable=False)
+def ctc_beam_search_decoder(log_probs, sequence_lengths=None, beam_width=16,
+                            top_paths=1, blank_index=0):
+    """CTC prefix beam search (reference ctc_beam op / TF
+    ctc_beam_search_decoder). Host-side numpy — decoding is a serving-path
+    utility, not a training op (the training op is the registered
+    ``ctc_loss``). log_probs: (B, T, C) log-softmax outputs. Returns
+    (decoded, log_prob): a length-B list of up-to-``top_paths`` label lists,
+    and a (B, top_paths) array of path log-probabilities."""
+    import numpy as _np
+
+    lp = _np.asarray(log_probs, _np.float64)
+    bsz, tmax, _ = lp.shape
+    if sequence_lengths is None:
+        sequence_lengths = [tmax] * bsz
+    sequence_lengths = _np.asarray(sequence_lengths)
+    NEG = -_np.inf
+
+    def lse(a, b):
+        if a == NEG:
+            return b
+        if b == NEG:
+            return a
+        m = max(a, b)
+        return m + _np.log(_np.exp(a - m) + _np.exp(b - m))
+
+    all_paths, all_logp = [], []
+    for b in range(bsz):
+        # prefix -> (log p ending in blank, log p ending in non-blank)
+        beams = {(): (0.0, NEG)}
+        for t in range(int(sequence_lengths[b])):
+            step = lp[b, t]
+            new = {}
+            for prefix, (pb, pnb) in beams.items():
+                total = lse(pb, pnb)
+                # extend with blank: prefix unchanged
+                nb, nn = new.get(prefix, (NEG, NEG))
+                new[prefix] = (lse(nb, total + step[blank_index]), nn)
+                # repeat last symbol: only the non-blank mass collapses
+                if prefix:
+                    last = prefix[-1]
+                    nb, nn = new.get(prefix, (NEG, NEG))
+                    new[prefix] = (nb, lse(nn, pnb + step[last]))
+                for s in _np.argsort(step)[::-1][:beam_width]:
+                    s = int(s)
+                    if s == blank_index:
+                        continue
+                    ext = prefix + (s,)
+                    nb, nn = new.get(ext, (NEG, NEG))
+                    if prefix and s == prefix[-1]:
+                        new[ext] = (nb, lse(nn, pb + step[s]))
+                    else:
+                        new[ext] = (nb, lse(nn, total + step[s]))
+            ranked = sorted(new.items(), key=lambda kv: -lse(*kv[1]))
+            beams = dict(ranked[:beam_width])
+        ranked = sorted(beams.items(), key=lambda kv: -lse(*kv[1]))[:top_paths]
+        all_paths.append([list(p) for p, _ in ranked])
+        row = [lse(*v) for _, v in ranked]
+        row += [NEG] * (top_paths - len(row))
+        all_logp.append(row)
+    return all_paths, _np.asarray(all_logp, _np.float32)

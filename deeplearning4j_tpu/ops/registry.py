@@ -103,6 +103,14 @@ def op(
     return wrap
 
 
+def add_alias(alias: str, name: str) -> None:
+    """Register an extra name for an existing op (reference parity: libnd4j
+    ops declare multiple names via OpRegistrator aliases, path-cite)."""
+    if name not in _REGISTRY:
+        raise OpNotFoundError(name)
+    _ALIASES[alias] = name
+
+
 def get_op(name: str) -> OpDef:
     key = name if name in _REGISTRY else _ALIASES.get(name, name)
     try:

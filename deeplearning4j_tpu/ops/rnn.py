@@ -432,3 +432,121 @@ def lstm_block(seq_len_max, x, cs_prev, h_prev, W, wci, wcf, wco, b, *,
     (_, _), ys = lax.scan(body, (cs_prev, h_prev),
                           (x, jnp.arange(T, dtype=jnp.int32)))
     return ys
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tail: libnd4j generic/recurrent static/dynamic RNN ops + sru_bi
+# (static_rnn.cpp, dynamic_rnn.cpp, static_bidirectional_rnn.cpp,
+#  dynamic_bidirectional_rnn.cpp, sru_bi — path-cites, mount empty).
+# Reference signature: simple-RNN cell with Wx (I,H), Wh (H,H), b (H,).
+# "static" unrolls the loop in the graph, "dynamic" iterates — under XLA
+# both compile to one program; we keep BOTH shapes (unrolled HLO vs scan)
+# because compile time and fusion behaviour genuinely differ (BASELINE.md
+# round-4 LSTM A/B: same speed, 3.4x compile-time gap).
+# ---------------------------------------------------------------------------
+
+def _simple_rnn_scan(x, Wx, Wh, b, h0, seq_lens, unroll):
+    """x: (T,B,I) -> (ys (T,B,H), h_final). tanh cell, zero-padded past
+    seq_lens (TF compat: outputs beyond length are zeros, state freezes)."""
+    T, B = x.shape[0], x.shape[1]
+    H = Wx.shape[1]
+    Wx = Wx.astype(x.dtype)
+    Wh = Wh.astype(x.dtype)
+    bias = jnp.zeros((H,), x.dtype) if b is None else b.astype(x.dtype)
+    h = jnp.zeros((B, H), x.dtype) if h0 is None else h0.astype(x.dtype)
+
+    def step(h, xt, t):
+        h_new = jnp.tanh(xt @ Wx + h @ Wh + bias)
+        if seq_lens is not None:
+            alive = (t < jnp.asarray(seq_lens))[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            y = jnp.where(alive, h_new, jnp.zeros_like(h_new))
+        else:
+            y = h_new
+        return h_new, y
+
+    if unroll:
+        ys = []
+        for t in range(T):
+            h, y = step(h, x[t], t)
+            ys.append(y)
+        return jnp.stack(ys), h
+    h, ys = lax.scan(lambda c, tx: step(c, tx[1], tx[0]),
+                     h, (jnp.arange(T), x))
+    return ys, h
+
+
+@op("static_rnn", "rnn", aliases=("staticRNN",))
+def static_rnn(x, Wx, Wh, b=None, h0=None, seq_lens=None):
+    """Unrolled simple-RNN over (T, B, I). Returns (h_seq, h_final)."""
+    return _simple_rnn_scan(x, Wx, Wh, b, h0, seq_lens, unroll=True)
+
+
+@op("dynamic_rnn", "rnn", aliases=("dynamicRNN",))
+def dynamic_rnn(x, Wx, Wh, b=None, h0=None, seq_lens=None, time_major=True):
+    """Scan-based simple-RNN; ``time_major=False`` takes (B, T, I)."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    ys, h = _simple_rnn_scan(x, Wx, Wh, b, h0, seq_lens, unroll=False)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, h
+
+
+def _bidir_rnn(x, fw, bw, seq_lens, unroll):
+    ys_f, h_f = _simple_rnn_scan(x, *fw, seq_lens, unroll)
+    if seq_lens is None:
+        xr = x[::-1]
+        ys_b, h_b = _simple_rnn_scan(xr, *bw, None, unroll)
+        ys_b = ys_b[::-1]
+    else:
+        # reverse each sequence within its own length (TF reverse_sequence)
+        T = x.shape[0]
+        idx = jnp.arange(T)[:, None]                      # (T, 1)
+        lens = jnp.asarray(seq_lens)[None, :]             # (1, B)
+        rev = jnp.where(idx < lens, lens - 1 - idx, idx)  # (T, B)
+        xr = jnp.take_along_axis(x, rev[:, :, None], axis=0)
+        ys_b, h_b = _simple_rnn_scan(xr, *bw, seq_lens, unroll)
+        ys_b = jnp.take_along_axis(ys_b, rev[:, :, None], axis=0)
+    return jnp.concatenate([ys_f, ys_b], axis=-1), (h_f, h_b)
+
+
+@op("static_bidirectional_rnn", "rnn", aliases=("staticBidirectionalRNN",))
+def static_bidirectional_rnn(x, Wx_f, Wh_f, b_f, Wx_b, Wh_b, b_b,
+                             h0_f=None, h0_b=None, seq_lens=None):
+    """Bidirectional unrolled simple-RNN: (h_seq (T,B,2H), (h_fw, h_bw))."""
+    return _bidir_rnn(x, (Wx_f, Wh_f, b_f, h0_f), (Wx_b, Wh_b, b_b, h0_b),
+                      seq_lens, unroll=True)
+
+
+@op("dynamic_bidirectional_rnn", "rnn", aliases=("dynamicBidirectionalRNN",))
+def dynamic_bidirectional_rnn(x, Wx_f, Wh_f, b_f, Wx_b, Wh_b, b_b,
+                              h0_f=None, h0_b=None, seq_lens=None,
+                              time_major=True):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    ys, hs = _bidir_rnn(x, (Wx_f, Wh_f, b_f, h0_f), (Wx_b, Wh_b, b_b, h0_b),
+                        seq_lens, unroll=False)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, hs
+
+
+@op("sru_bi", "rnn", aliases=("sruBI",))
+def sru_bi(x, W, b, c0=None, mask=None):
+    """Bidirectional SRU (generic/recurrent/sru.cpp sru_bi, path-cite).
+    x: (T, B, 2I) with the feature halves feeding the two directions;
+    W: (2*3I, I)-per-direction stacked as (6I, I)... simplified faithful
+    form: W (2, 3I, I), b (2, 2I), c0 (2, B, I). Returns
+    (h (T, B, 2I), c_final (2, B, I))."""
+    W = jnp.asarray(W)
+    b = jnp.asarray(b)
+    i = W.shape[-1]
+    xf, xb = x[..., :i], x[..., i:]
+    mask_t = None if mask is None else jnp.asarray(mask)
+    c0f = None if c0 is None else c0[0]
+    c0b = None if c0 is None else c0[1]
+    hf, cf = sru(xf, W[0], b[0], c0f, mask_t, layout=0)
+    hb_r, cb = sru(xb[::-1], W[1], b[1], c0b,
+                   None if mask_t is None else mask_t[::-1], layout=0)
+    return jnp.concatenate([hf, hb_r[::-1]], axis=-1), jnp.stack([cf, cb])

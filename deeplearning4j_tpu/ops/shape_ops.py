@@ -539,3 +539,73 @@ def mergemax(*xs):
     for x in xs[1:]:
         out = jnp.maximum(out, x)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tail: scatter_nd in-place variants, tear, bitcast,
+# broadcast_dynamic_shape (libnd4j generic/parity_ops/scatter_nd_add.cpp,
+# scatter_nd_sub.cpp, scatter_nd_update.cpp, tear.cpp, bitcast.cpp,
+# broadcast_dynamic_shape.cpp — path-cites, mount empty this round).
+# ---------------------------------------------------------------------------
+
+def _nd_index(indices):
+    return tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))
+
+
+@op("scatter_nd_add", "gather_scatter")
+def scatter_nd_add(ref, indices, updates):
+    """ref with updates scatter-ADDED at nd-indices (returns the new array —
+    in-place under jit via donation, like every "in-place" reference op)."""
+    return jnp.asarray(ref).at[_nd_index(indices)].add(updates)
+
+
+@op("scatter_nd_sub", "gather_scatter")
+def scatter_nd_sub(ref, indices, updates):
+    return jnp.asarray(ref).at[_nd_index(indices)].add(-jnp.asarray(updates))
+
+
+@op("scatter_nd_update", "gather_scatter")
+def scatter_nd_update(ref, indices, updates):
+    """Duplicate indices: last write wins (XLA scatter with replace)."""
+    return jnp.asarray(ref).at[_nd_index(indices)].set(updates)
+
+
+@op("tear", "shape", differentiable=False)
+def tear(x, axis=0):
+    """Split into a list of subtensors along ``axis``, dropping that axis —
+    the reference's tear op returns the "views"; here they are slices
+    (XLA has no views across op boundaries by design)."""
+    x = jnp.asarray(x)
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+@op("bitcast", "shape", differentiable=False)
+def bitcast(x, dtype):
+    """Reinterpret the bytes (TF Bitcast / reference bitcast op). Same-width
+    dtypes keep the shape; casting to a NARROWER dtype appends a trailing
+    dim of the width ratio; casting to a WIDER dtype consumes a trailing
+    dim equal to the ratio — TF semantics, not numpy's flat view."""
+    x = jnp.asarray(x)
+    src = x.dtype.itemsize
+    dst = jnp.dtype(dtype).itemsize
+    if src == dst:
+        return x.view(jnp.dtype(dtype))
+    if src > dst:                      # widen->narrow: (..., ) -> (..., r)
+        r = src // dst
+        return x.view(jnp.dtype(dtype)).reshape(x.shape + (r,))
+    r = dst // src                     # narrow->wide: (..., r) -> (...)
+    if x.ndim == 0 or x.shape[-1] != r:
+        raise ValueError(
+            f"bitcast to a {r}x wider dtype needs trailing dim {r}, "
+            f"got shape {x.shape}")
+    return x.view(jnp.dtype(dtype)).reshape(x.shape[:-1])
+
+
+@op("broadcast_dynamic_shape", "shape", differentiable=False)
+def broadcast_dynamic_shape(a, b):
+    """NumPy-rules broadcast of two shape VECTORS (reference
+    broadcast_dynamic_shape): returns the broadcast shape as an int array."""
+    a = tuple(int(v) for v in np.asarray(a))
+    b = tuple(int(v) for v in np.asarray(b))
+    return jnp.asarray(np.broadcast_shapes(a, b), jnp.int32)

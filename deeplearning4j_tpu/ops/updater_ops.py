@@ -1,0 +1,136 @@
+"""Updater (learning-rule) ops — libnd4j's ``generic/updaters/*.cpp`` family.
+
+Reference parity: libnd4j registers each learning rule as a declarable op
+(``sgd_updater``, ``adam_updater``, ``ada_grad_updater``, … —
+libnd4j/include/ops/declarable/generic/updaters/, path-cite, mount empty this
+round) so the JVM can fuse the update math into one native call per parameter
+block (SURVEY.md §3.1: "fused native updater ops [JNI]").
+
+TPU-native design: the training loop never calls these by name — the whole
+update is traced into the single jitted train step via ``nn/updaters.py``
+(the IUpdater-parity classes), so the "fusion" the reference hand-rolls is
+XLA's default. These ops exist for registry/by-name parity (SameDiff graphs,
+imported graphs, and direct ``exec_op`` callers): each one delegates to the
+same updater-class math, guaranteeing the op table and the training loop can
+never disagree.
+
+Signature convention (matches the reference ops' tensor in/outs):
+``<name>_updater(gradient, *state, lr=..., ...hyperparams, iteration=0)``
+returns ``(update, *new_state)`` — the caller applies ``param -= update``.
+``apply_sgd`` (reference ``apply_sgd``/applyGradientDescent) is the one op
+that takes the parameter and returns the updated parameter directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.ops.registry import op
+
+
+def _single(updater, grad, state, iteration):
+    """Run an nn.updaters rule on one tensor; states are passed positionally."""
+    update, new_state = updater.apply(grad, state, iteration)
+    return update, new_state
+
+
+@op("sgd_updater", "updater", aliases=("sgdUpdater",))
+def sgd_updater(gradient, lr=1e-3):
+    """update = lr * g (libnd4j sgd_updater, path-cite)."""
+    return jnp.asarray(lr, jnp.asarray(gradient).dtype) * jnp.asarray(gradient)
+
+
+@op("apply_sgd", "updater", aliases=("applyGradientDescent",))
+def apply_sgd(parameters, gradient, lr=1e-3):
+    """parameters - lr * g, returned (libnd4j apply_sgd, path-cite)."""
+    parameters = jnp.asarray(parameters)
+    return parameters - jnp.asarray(lr, parameters.dtype) * jnp.asarray(gradient)
+
+
+@op("nesterovs_updater", "updater", aliases=("nesterovsUpdater",))
+def nesterovs_updater(gradient, state_v, lr=0.1, momentum=0.9, iteration=0):
+    """-> (update, new_v). Same math as nn.updaters.Nesterovs."""
+    upd, st = _single(U.Nesterovs(learning_rate=lr, momentum=momentum),
+                      jnp.asarray(gradient), {"v": jnp.asarray(state_v)},
+                      iteration)
+    return upd, st["v"]
+
+
+@op("ada_grad_updater", "updater", aliases=("adaGradUpdater",))
+def ada_grad_updater(gradient, state_h, lr=0.1, epsilon=1e-6, iteration=0):
+    """-> (update, new_h). Same math as nn.updaters.AdaGrad."""
+    upd, st = _single(U.AdaGrad(learning_rate=lr, epsilon=epsilon),
+                      jnp.asarray(gradient), {"h": jnp.asarray(state_h)},
+                      iteration)
+    return upd, st["h"]
+
+
+@op("rms_prop_updater", "updater", aliases=("rmsPropUpdater",))
+def rms_prop_updater(gradient, state_g, lr=0.1, rms_decay=0.95, epsilon=1e-8,
+                     iteration=0):
+    """-> (update, new_g). Same math as nn.updaters.RmsProp."""
+    upd, st = _single(U.RmsProp(learning_rate=lr, rms_decay=rms_decay,
+                                epsilon=epsilon),
+                      jnp.asarray(gradient), {"g2": jnp.asarray(state_g)},
+                      iteration)
+    return upd, st["g2"]
+
+
+@op("ada_delta_updater", "updater", aliases=("adaDeltaUpdater",))
+def ada_delta_updater(gradient, state_msg, state_msdx, rho=0.95, epsilon=1e-6,
+                      iteration=0):
+    """-> (update, new_msg, new_msdx). Same math as nn.updaters.AdaDelta."""
+    upd, st = _single(U.AdaDelta(rho=rho, epsilon=epsilon),
+                      jnp.asarray(gradient),
+                      {"g2": jnp.asarray(state_msg),
+                       "dx2": jnp.asarray(state_msdx)}, iteration)
+    return upd, st["g2"], st["dx2"]
+
+
+@op("adam_updater", "updater", aliases=("adamUpdater",))
+def adam_updater(gradient, state_m, state_v, lr=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, iteration=0):
+    """-> (update, new_m, new_v). Same math as nn.updaters.Adam."""
+    upd, st = _single(U.Adam(learning_rate=lr, beta1=beta1, beta2=beta2,
+                             epsilon=epsilon),
+                      jnp.asarray(gradient),
+                      {"m": jnp.asarray(state_m), "v": jnp.asarray(state_v)},
+                      iteration)
+    return upd, st["m"], st["v"]
+
+
+@op("ada_max_updater", "updater", aliases=("adaMaxUpdater",))
+def ada_max_updater(gradient, state_m, state_u, lr=1e-3, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, iteration=0):
+    """-> (update, new_m, new_u). Same math as nn.updaters.AdaMax."""
+    upd, st = _single(U.AdaMax(learning_rate=lr, beta1=beta1, beta2=beta2,
+                               epsilon=epsilon),
+                      jnp.asarray(gradient),
+                      {"m": jnp.asarray(state_m), "v": jnp.asarray(state_u)},
+                      iteration)
+    return upd, st["m"], st["v"]
+
+
+@op("ams_grad_updater", "updater", aliases=("amsGradUpdater",))
+def ams_grad_updater(gradient, state_m, state_v, state_vhat, lr=1e-3,
+                     beta1=0.9, beta2=0.999, epsilon=1e-8, iteration=0):
+    """-> (update, new_m, new_v, new_vhat). Same math as nn.updaters.AMSGrad."""
+    upd, st = _single(U.AMSGrad(learning_rate=lr, beta1=beta1, beta2=beta2,
+                                epsilon=epsilon),
+                      jnp.asarray(gradient),
+                      {"m": jnp.asarray(state_m), "v": jnp.asarray(state_v),
+                       "vhat": jnp.asarray(state_vhat)}, iteration)
+    return upd, st["m"], st["v"], st["vhat"]
+
+
+@op("nadam_updater", "updater", aliases=("nadamUpdater",))
+def nadam_updater(gradient, state_m, state_v, lr=1e-3, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, iteration=0):
+    """-> (update, new_m, new_v). Same math as nn.updaters.Nadam."""
+    upd, st = _single(U.Nadam(learning_rate=lr, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon),
+                      jnp.asarray(gradient),
+                      {"m": jnp.asarray(state_m), "v": jnp.asarray(state_v)},
+                      iteration)
+    return upd, st["m"], st["v"]

@@ -828,6 +828,42 @@ class SameDiff:
         hit = needed & self._poison_vars
         return next(iter(hit)) if hit else None
 
+    def poisoned_ancestor_refined(self, targets: Sequence[str]) -> Optional[str]:
+        """``poisoned_ancestor`` refined by value probing at the
+        static/runtime boundary. Provenance alone wrongly rejects graphs
+        whose runtime side consumes only STATIC dims extracted from a
+        dynamic-batch shape fold (e.g. ``x * x.shape[1]`` under torch
+        dynamic_axes: the Shape fold is [-1, C] but the consumed value C is
+        batch-invariant). The output itself cannot be probed (it needs
+        placeholders), but every path from a poison constant to the runtime
+        side crosses a placeholder-free "boundary" var — probe those:
+        only a boundary var whose VALUE changes with the sentinel makes the
+        provenance hit real. Compile-time only."""
+        first = self.poisoned_ancestor(targets)
+        if first is None:
+            return None
+        needed = set(targets)
+        for node in reversed(self._nodes):
+            if any(o in needed for o in node.outputs):
+                needed.update(i for i in node.inputs if isinstance(i, str))
+        # forward evaluability: a var is static iff its chain has no
+        # placeholder (constants/variables seed the set)
+        static = set(self._arrays)
+        for node in self._nodes:
+            ins = [i for i in node.inputs if isinstance(i, str)]
+            if all(i in static for i in ins):
+                static.update(node.outputs)
+        boundary = {t for t in targets if t in static and t in needed}
+        for node in self._nodes:
+            if not all(o in static for o in node.outputs):
+                boundary.update(i for i in node.inputs
+                                if isinstance(i, str) and i in static
+                                and i in needed)
+        for bv in sorted(boundary):
+            if self.derives_poisoned(bv):
+                return bv
+        return None
+
     def derives_poisoned(self, var_name: str) -> bool:
         """True if `var_name`'s VALUE actually depends on a dynamic-dim
         sentinel. Provenance (ancestor reaches a poison constant) is
@@ -850,7 +886,7 @@ class SameDiff:
         """Gradient-path counterpart of output()'s poison check: refuse to
         build a grad/train function whose loss ancestors include a
         dynamic-dim sentinel constant (compile-time only, not per-step)."""
-        bad = self.poisoned_ancestor(self._loss_vars)
+        bad = self.poisoned_ancestor_refined(self._loss_vars)
         if bad is not None:
             raise NotImplementedError(
                 f"loss depends on {bad!r}, a shape constant carrying the -1 "
@@ -883,6 +919,9 @@ class SameDiff:
             tuple(outputs),
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feeds.items())),
             len(self._nodes),
+            # a privileged compile must not satisfy a later unprivileged
+            # call: the poison check runs only on cache miss
+            bool(_allow_poison),
         )
         fn = self._jit_cache.get(sig)
         if fn is None:
@@ -890,7 +929,7 @@ class SameDiff:
             # (outputs, node-count) signature, and the ancestor scan must
             # stay off the per-dispatch hot path
             if not _allow_poison:
-                bad = self.poisoned_ancestor(outputs)
+                bad = self.poisoned_ancestor_refined(outputs)
                 if bad is not None:
                     raise NotImplementedError(
                         f"output depends on {bad!r}, a shape constant "

@@ -618,8 +618,84 @@ class TestONNXDynamicBatch:
             out = np.asarray(sd.output({"x": x.numpy()}, ["y"])["y"])
             np.testing.assert_allclose(out, m(x).numpy(), atol=1e-6)
 
+    def test_runtime_consumer_of_static_dim_imports(self):
+        """Round-5 regression (review finding): when the static-extracted
+        dim feeds RUNTIME arithmetic (Mul) instead of going through
+        const(), the import-time output check used provenance only and
+        wrongly rejected the graph. The refined check probes the
+        static/runtime boundary value and keeps this importable."""
+
+        class _ScaleByWidth(torch.nn.Module):
+            def forward(self, x):
+                return x * x.shape[1]
+
+        m = _ScaleByWidth().eval()
+        sd = import_onnx(self._export_dynamic(m, torch.randn(2, 6)))
+        for b in (2, 4):
+            x = torch.randn(b, 6)
+            out = np.asarray(sd.output({"x": x.numpy()}, ["y"])["y"])
+            np.testing.assert_allclose(out, m(x).numpy(), atol=1e-6)
+
+    def test_runtime_consumer_of_batch_dim_still_rejected(self):
+        """Counterpart: the BATCH dim's value reaching runtime arithmetic
+        is genuinely batch-dependent — must stay a loud rejection, not a
+        silent -1."""
+
+        class _ScaleByBatch(torch.nn.Module):
+            def forward(self, x):
+                return x * x.shape[0]
+
+        data = self._export_dynamic(_ScaleByBatch().eval(),
+                                    torch.randn(2, 6))
+        with pytest.raises(NotImplementedError, match="dynamic|sentinel"):
+            import_onnx(data)
+
 
 class TestTFDynamicBatch:
+    @staticmethod
+    def _freeze_dynamic(fn):
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        conc = tf.function(fn).get_concrete_function(
+            tf.TensorSpec([None, 6], tf.float32))
+        frozen = convert_variables_to_constants_v2(conc)
+        in_name = frozen.inputs[0].name.split(":")[0]
+        out_name = frozen.outputs[0].name
+        return frozen.graph.as_graph_def(), frozen, in_name, out_name
+
+    def test_shape_n_static_dim_imports_batch_dim_rejected(self, rng):
+        """Round-5 regression (review finding): the ShapeN rule folded the
+        dynamic batch dim as a -1 constant WITHOUT the Shape rule's taint,
+        so batch-dependent values silently reached runtime arithmetic.
+        ShapeN now taints like Shape: the static-dim consumer imports (and
+        matches TF at two batch sizes), the batch-dim consumer fails
+        loudly."""
+
+        def uses_static_dim(x):
+            s = tf.raw_ops.ShapeN(input=[x, x])[0]
+            return x * tf.cast(s[1], tf.float32)
+
+        gd, frozen, in_name, out_name = self._freeze_dynamic(uses_static_dim)
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_name]
+        for b in (2, 5):
+            x = rng.normal(size=(b, 6)).astype(np.float32)
+            res = np.asarray(sd.output({in_name: x}, [key])[key])
+            np.testing.assert_allclose(res, np.asarray(frozen(
+                tf.constant(x))[0]), atol=1e-5)
+
+        def uses_batch_dim(x):
+            s = tf.raw_ops.ShapeN(input=[x, x])[0]
+            return x * tf.cast(s[0], tf.float32)
+
+        gd2, _, in2, out2 = self._freeze_dynamic(uses_batch_dim)
+        with pytest.raises(NotImplementedError, match="dynamic|sentinel"):
+            sd2 = import_graph_def(gd2)
+            key2 = sd2.tf_name_map[out2]
+            sd2.output({in2: np.zeros((2, 6), np.float32)}, [key2])
+
     def test_imported_graph_runs_at_two_batch_sizes(self, rng):
         """TF frozen graphs traced with batch=None import once and run at
         any batch size (the keras Pack/StridedSlice reshape pattern folds

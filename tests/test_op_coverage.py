@@ -312,6 +312,82 @@ OVERRIDES = {
     "zeta": lambda f: f(X + 1.5, X + 0.5),
     "betainc": lambda f: f(X + 0.5, X + 0.5, X * 0.5 + 0.2),
     "logit": lambda f: f(X * 0.5 + 0.2),
+    # round-5 tail: updater op family (gradient + state tensors)
+    "apply_sgd": lambda f: f(XN, XN * 0.1),
+    "nesterovs_updater": lambda f: f(XN, jnp.zeros_like(XN)),
+    "ada_grad_updater": lambda f: f(XN, jnp.zeros_like(XN)),
+    "rms_prop_updater": lambda f: f(XN, jnp.zeros_like(XN)),
+    "ada_delta_updater": lambda f: f(XN, jnp.zeros_like(XN),
+                                     jnp.zeros_like(XN)),
+    "adam_updater": lambda f: f(XN, jnp.zeros_like(XN), jnp.zeros_like(XN)),
+    "ada_max_updater": lambda f: f(XN, jnp.zeros_like(XN),
+                                   jnp.zeros_like(XN)),
+    "ams_grad_updater": lambda f: f(XN, jnp.zeros_like(XN),
+                                    jnp.zeros_like(XN), jnp.zeros_like(XN)),
+    "nadam_updater": lambda f: f(XN, jnp.zeros_like(XN), jnp.zeros_like(XN)),
+    # round-5 tail: NLP / manifold helper ops
+    "skipgram": lambda f: f(jnp.ones((5, 4)) * 0.1, jnp.ones((5, 4)) * 0.1,
+                            2, jnp.asarray([1, 3]), jnp.asarray([1.0, 0.0])),
+    "cbow": lambda f: f(jnp.ones((5, 4)) * 0.1, jnp.ones((5, 4)) * 0.1,
+                        jnp.asarray([0, 4]), jnp.asarray([1, 3]),
+                        jnp.asarray([1.0, 0.0])),
+    "barnes_symmetrized": lambda f: f(jnp.asarray([0, 1]),
+                                      jnp.asarray([1, 2]),
+                                      jnp.asarray([0.5, 0.25])),
+    "barnes_edge_forces": lambda f: f(jnp.asarray([0, 1]),
+                                      jnp.asarray([1, 2]),
+                                      jnp.asarray([0.5, 0.25]),
+                                      jnp.ones((3, 2))),
+    "barnes_gains": lambda f: f(jnp.ones((3, 2)), XN[:3, :2], XN[:3, :2]),
+    "cell_contains": lambda f: f(jnp.zeros(2), jnp.ones(2),
+                                 jnp.asarray([0.5, -0.5])),
+    "knn_mindistance": lambda f: f(jnp.zeros(3), -jnp.ones(3), jnp.ones(3)),
+    # round-5 tail: conv/pool/decoder
+    "dilation2d": lambda f: f(IMG, jnp.zeros((2, 2, 6))),
+    "erosion2d": lambda f: f(IMG, jnp.zeros((2, 2, 6))),
+    "max_pool_with_argmax": lambda f: f(IMG),
+    "deconv3d": lambda f: f(jnp.ones((1, 3, 3, 3, 2)),
+                            jnp.ones((2, 2, 2, 2, 4)) * 0.1),
+    "upsampling3d": lambda f: f(jnp.ones((1, 2, 2, 2, 3))),
+    "relu_layer": lambda f: f(XN, jnp.ones((6, 3)) * 0.1, jnp.zeros(3)),
+    "ctc_beam_search_decoder": lambda f: f(
+        jax.nn.log_softmax(jnp.zeros((1, 5, 4))), beam_width=4),
+    # round-5 tail: static/dynamic RNN + sru_bi
+    "static_rnn": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((4, 3)) * 0.1,
+                              jnp.ones((3, 3)) * 0.1),
+    "dynamic_rnn": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((4, 3)) * 0.1,
+                               jnp.ones((3, 3)) * 0.1),
+    "static_bidirectional_rnn": lambda f: f(
+        jnp.ones((3, 2, 4)), jnp.ones((4, 3)) * 0.1, jnp.ones((3, 3)) * 0.1,
+        jnp.zeros(3), jnp.ones((4, 3)) * 0.1, jnp.ones((3, 3)) * 0.1,
+        jnp.zeros(3)),
+    "dynamic_bidirectional_rnn": lambda f: f(
+        jnp.ones((3, 2, 4)), jnp.ones((4, 3)) * 0.1, jnp.ones((3, 3)) * 0.1,
+        jnp.zeros(3), jnp.ones((4, 3)) * 0.1, jnp.ones((3, 3)) * 0.1,
+        jnp.zeros(3)),
+    "sru_bi": lambda f: f(jnp.ones((3, 2, 8)), jnp.ones((2, 12, 4)) * 0.1,
+                          jnp.zeros((2, 8))),
+    # round-5 tail: scatter_nd variants / shape / bit ops
+    "scatter_nd_add": lambda f: f(XN, jnp.asarray([[0], [2]]),
+                                  jnp.ones((2, 6))),
+    "scatter_nd_sub": lambda f: f(XN, jnp.asarray([[0], [2]]),
+                                  jnp.ones((2, 6))),
+    "scatter_nd_update": lambda f: f(XN, jnp.asarray([[0], [2]]),
+                                     jnp.ones((2, 6))),
+    "bitcast": lambda f: f(jnp.asarray([1.0, 2.0], jnp.float32), jnp.int32),
+    "broadcast_dynamic_shape": lambda f: f(jnp.asarray([2, 1, 3]),
+                                           jnp.asarray([2, 4, 1])),
+    "cyclic_rshift_bits": lambda f: f(jnp.asarray([1, 2], jnp.int32), 3),
+    "bits_hamming_distance": lambda f: f(jnp.asarray([1, 2], jnp.int32),
+                                         jnp.asarray([3, 2], jnp.int32)),
+    "fake_quant_with_min_max_vars_per_channel": lambda f: f(
+        XN, -jnp.ones(6), jnp.ones(6)),
+    "compare_and_bitpack": lambda f: f(XN.reshape(3, 8), 0.0),
+    # round-5 tail: linalg
+    "lup": lambda f: f(SQ),
+    "matrix_set_diag": lambda f: f(SQ, jnp.asarray([5.0, 6.0])),
+    "solve_ls": lambda f: f(SQ, jnp.ones((2, 1))),
+    "sufficient_statistics": lambda f: f(XN, (0,)),
 }
 
 # EXACT category match only ("reduce3".startswith("reduce") must not route

@@ -1,0 +1,441 @@
+"""Numeric tests for the round-5 op-registry tail (beyond the coverage gate).
+
+Each section checks real semantics against an independent computation —
+manual math, numpy, or brute force — per the repo's gradcheck-first standard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ops
+from deeplearning4j_tpu.nn import updaters as U
+
+R = np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------- updaters --
+class TestUpdaterOps:
+    def test_adam_matches_updater_class(self):
+        g = jnp.asarray(R.normal(size=(5,)).astype(np.float32))
+        m = jnp.asarray(R.normal(size=(5,)).astype(np.float32)) * 0.1
+        v = jnp.abs(jnp.asarray(R.normal(size=(5,)).astype(np.float32))) * 0.1
+        upd, m2, v2 = ops.exec_op("adam_updater", g, m, v, lr=0.01,
+                                  iteration=3)
+        ref_u, ref_s = U.Adam(learning_rate=0.01).apply(
+            g, {"m": m, "v": v}, 3)
+        np.testing.assert_allclose(upd, ref_u, rtol=1e-6)
+        np.testing.assert_allclose(m2, ref_s["m"], rtol=1e-6)
+        np.testing.assert_allclose(v2, ref_s["v"], rtol=1e-6)
+
+    def test_sgd_and_apply_sgd(self):
+        g = jnp.asarray([1.0, -2.0])
+        np.testing.assert_allclose(ops.exec_op("sgd_updater", g, lr=0.5),
+                                   [0.5, -1.0])
+        p = jnp.asarray([10.0, 10.0])
+        np.testing.assert_allclose(ops.exec_op("apply_sgd", p, g, lr=0.5),
+                                   [9.5, 11.0])
+
+    @pytest.mark.parametrize("name,cls,nstate", [
+        ("nesterovs_updater", U.Nesterovs, 1),
+        ("ada_grad_updater", U.AdaGrad, 1),
+        ("rms_prop_updater", U.RmsProp, 1),
+        ("nadam_updater", U.Nadam, 2),
+        ("ada_max_updater", U.AdaMax, 2),
+    ])
+    def test_delegation_consistency(self, name, cls, nstate):
+        """Every updater op must agree with the class the training loop uses
+        — the invariant the module exists for."""
+        g = jnp.asarray(R.normal(size=(4,)).astype(np.float32))
+        states = [jnp.abs(jnp.asarray(
+            R.normal(size=(4,)).astype(np.float32))) * 0.1
+            for _ in range(nstate)]
+        out = ops.exec_op(name, g, *states, iteration=2)
+        upd = out[0]
+        inst = cls()
+        keys = list(inst.init_state(g).keys())
+        ref_u, _ = inst.apply(g, dict(zip(keys, states)), 2)
+        # op defaults must match class defaults for the shared hyperparams
+        kw = {}
+        if hasattr(inst, "learning_rate"):
+            kw = {}
+        np.testing.assert_allclose(upd, ref_u, rtol=1e-5, atol=1e-7)
+
+
+# ------------------------------------------------------------ word2vec ops --
+class TestSkipgramCbow:
+    def test_skipgram_matches_manual_gradient(self):
+        syn0 = jnp.asarray(R.normal(size=(6, 4)).astype(np.float32)) * 0.1
+        syn1 = jnp.asarray(R.normal(size=(6, 4)).astype(np.float32)) * 0.1
+        target, samples = 2, jnp.asarray([1, 4, 5])
+        labels = jnp.asarray([1.0, 0.0, 0.0])
+        lr = 0.1
+        s0, s1, loss = ops.exec_op("skipgram", syn0, syn1, target, samples,
+                                   labels, lr=lr)
+        # manual: g_k = lr*(label - sigma(w_k . h))
+        h = np.asarray(syn0)[2]
+        w = np.asarray(syn1)[np.asarray(samples)]
+        p = 1 / (1 + np.exp(-(w @ h)))
+        gk = lr * (np.asarray(labels) - p)
+        np.testing.assert_allclose(np.asarray(s0)[2], h + gk @ w, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1)[1],
+                                   w[0] + gk[0] * h, rtol=1e-5)
+        assert float(loss) > 0
+
+    def test_skipgram_training_reduces_loss(self):
+        """Repeated updates on one (target, context) pair must drive the
+        positive-sample probability up."""
+        syn0 = jnp.asarray(R.normal(size=(8, 6)).astype(np.float32)) * 0.1
+        syn1 = jnp.zeros((8, 6), jnp.float32)
+        samples = jnp.asarray([3, 5, 6])
+        labels = jnp.asarray([1.0, 0.0, 0.0])
+        first = None
+        for _ in range(50):
+            syn0, syn1, loss = ops.exec_op("skipgram", syn0, syn1, 1,
+                                           samples, labels, lr=0.5)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first * 0.2
+
+    def test_cbow_mask_and_mean(self):
+        syn0 = jnp.ones((5, 3), jnp.float32) * jnp.asarray(
+            [[1.0], [2.0], [3.0], [4.0], [0.0]])
+        syn1 = jnp.asarray(R.normal(size=(5, 3)).astype(np.float32)) * 0.1
+        ctx = jnp.asarray([0, 1, 4])
+        mask = jnp.asarray([1.0, 1.0, 0.0])
+        s0m, _, _ = ops.exec_op("cbow", syn0, syn1, ctx, jnp.asarray([2]),
+                                jnp.asarray([1.0]), lr=0.1,
+                                context_mask=mask)
+        # masked slot 4 must be untouched
+        np.testing.assert_allclose(np.asarray(s0m)[4], np.asarray(syn0)[4])
+        assert not np.allclose(np.asarray(s0m)[0], np.asarray(syn0)[0])
+
+
+# ----------------------------------------------------------- barnes / tsne --
+class TestBarnesOps:
+    def test_edge_forces_match_dense(self):
+        n, e = 5, 8
+        rows = jnp.asarray(R.integers(0, n, e))
+        cols = jnp.asarray(R.integers(0, n, e))
+        vals = jnp.asarray(R.random(e).astype(np.float32))
+        y = jnp.asarray(R.normal(size=(n, 2)).astype(np.float32))
+        out = ops.exec_op("barnes_edge_forces", rows, cols, vals, y)
+        dense = np.zeros((n, 2), np.float32)
+        for i, j, v in zip(np.asarray(rows), np.asarray(cols),
+                           np.asarray(vals)):
+            d = np.asarray(y)[i] - np.asarray(y)[j]
+            dense[i] += v * d / (1 + d @ d)
+        np.testing.assert_allclose(out, dense, rtol=1e-5, atol=1e-6)
+
+    def test_symmetrize_equals_dense_symmetrization(self):
+        rows = jnp.asarray([0, 1, 2])
+        cols = jnp.asarray([1, 2, 0])
+        vals = jnp.asarray([1.0, 2.0, 4.0])
+        r2, c2, v2 = ops.exec_op("barnes_symmetrized", rows, cols, vals)
+        dense = np.zeros((3, 3))
+        for i, j, v in zip(np.asarray(r2), np.asarray(c2), np.asarray(v2)):
+            dense[i, j] += v
+        p = np.zeros((3, 3))
+        for i, j, v in zip([0, 1, 2], [1, 2, 0], [1.0, 2.0, 4.0]):
+            p[i, j] = v
+        np.testing.assert_allclose(dense, (p + p.T) / 2)
+
+    def test_gains_rule(self):
+        gains = jnp.ones((2, 2))
+        grad = jnp.asarray([[1.0, -1.0], [1.0, 1.0]])
+        incs = jnp.asarray([[1.0, 1.0], [-1.0, 1.0]])
+        out = np.asarray(ops.exec_op("barnes_gains", gains, grad, incs))
+        np.testing.assert_allclose(out, [[0.8, 1.2], [1.2, 0.8]])
+
+    def test_knn_mindistance(self):
+        d = ops.exec_op("knn_mindistance", jnp.asarray([2.0, 0.0]),
+                        jnp.asarray([-1.0, -1.0]), jnp.asarray([1.0, 1.0]))
+        np.testing.assert_allclose(d, 1.0)
+        inside = ops.exec_op("knn_mindistance", jnp.zeros(2),
+                             -jnp.ones(2), jnp.ones(2))
+        np.testing.assert_allclose(inside, 0.0)
+        assert bool(ops.exec_op("cell_contains", jnp.zeros(2), jnp.ones(2),
+                                jnp.asarray([0.5, -0.9])))
+
+
+# -------------------------------------------------------------- conv tail --
+class TestConvTail:
+    def test_dilation2d_manual(self):
+        x = jnp.asarray(R.normal(size=(1, 5, 5, 2)).astype(np.float32))
+        f = jnp.asarray(R.normal(size=(2, 2, 2)).astype(np.float32)) * 0.1
+        out = ops.exec_op("dilation2d", x, f, padding="VALID")
+        xn, fn = np.asarray(x), np.asarray(f)
+        man = np.zeros((1, 4, 4, 2), np.float32)
+        for y in range(4):
+            for xx in range(4):
+                for c in range(2):
+                    man[0, y, xx, c] = np.max(
+                        xn[0, y:y + 2, xx:xx + 2, c] + fn[:, :, c])
+        np.testing.assert_allclose(out, man, rtol=1e-5)
+
+    def test_erosion_duality(self):
+        x = jnp.asarray(R.normal(size=(1, 6, 6, 1)).astype(np.float32))
+        f = jnp.asarray(R.normal(size=(3, 3, 1)).astype(np.float32)) * 0.1
+        ero = ops.exec_op("erosion2d", x, f, padding="VALID")
+        dil = ops.exec_op("dilation2d", -x, f[::-1, ::-1, :],
+                          padding="VALID")
+        np.testing.assert_allclose(ero, -np.asarray(dil), rtol=1e-5)
+
+    def test_max_pool_with_argmax_flat_indices(self):
+        x = jnp.arange(32.0).reshape(1, 4, 4, 2)
+        vals, idx = ops.exec_op("max_pool_with_argmax", x)
+        np.testing.assert_allclose(
+            np.asarray(vals).ravel(),
+            np.asarray(x).reshape(4, 4, 2)[1::2, 1::2, :].ravel())
+        # TF flat index convention: value recoverable by flat lookup
+        flat = np.asarray(x).ravel()
+        np.testing.assert_allclose(flat[np.asarray(idx).ravel()],
+                                   np.asarray(vals).ravel())
+
+    def test_deconv3d_inverts_stride_shape(self):
+        x = jnp.ones((2, 3, 3, 3, 4))
+        w = jnp.ones((2, 2, 2, 4, 6)) * 0.1
+        out = ops.exec_op("deconv3d", x, w, strides=(2, 2, 2))
+        assert out.shape == (2, 6, 6, 6, 6)
+
+    def test_deconv3d_int_stride(self):
+        out = ops.exec_op("deconv3d", jnp.ones((1, 2, 2, 2, 3)),
+                          jnp.ones((2, 2, 2, 3, 4)) * 0.1, strides=2)
+        assert out.shape == (1, 4, 4, 4, 4)
+
+    def test_upsampling3d(self):
+        x = jnp.arange(8.0).reshape(1, 2, 2, 2, 1)
+        out = ops.exec_op("upsampling3d", x, 2)
+        assert out.shape == (1, 4, 4, 4, 1)
+        np.testing.assert_allclose(np.asarray(out)[0, :2, :2, :2, 0],
+                                   np.asarray(x)[0, 0, 0, 0, 0])
+
+    def test_mean_pairwise_sq_err_vs_bruteforce(self):
+        p = R.normal(size=(3, 5)).astype(np.float32)
+        l = R.normal(size=(3, 5)).astype(np.float32)
+        got = float(ops.exec_op("mean_pairwssqerr_loss", jnp.asarray(p),
+                                jnp.asarray(l)))
+        d = p - l
+        per = []
+        for b in range(3):
+            acc, cnt = 0.0, 0
+            for i in range(5):
+                for j in range(5):
+                    if i != j:
+                        acc += (d[b, i] - d[b, j]) ** 2 / 2
+                        cnt += 1
+            per.append(acc / cnt)  # mean over ordered pairs of (d_i-d_j)^2/2
+        # identity form: (n*sum_sq - sq_sum)/(n(n-1)) == mean over ordered
+        # pairs of (d_i-d_j)^2 / 2 * 2 ... assert against the direct formula
+        per2 = [(5 * (d[b] ** 2).sum() - d[b].sum() ** 2) / (5 * 4)
+                for b in range(3)]
+        np.testing.assert_allclose(got, np.mean(per2), rtol=1e-5)
+        np.testing.assert_allclose(np.mean(per), np.mean(per2), rtol=1e-5)
+
+
+# ------------------------------------------------------------ ctc decoder --
+class TestCtcBeamSearch:
+    def test_peaked_distribution_greedy_consistent(self):
+        # classes: 0=blank; emit 1,1,blank,2 -> collapse to [1, 2]
+        logits = np.full((1, 4, 3), -10.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2]):
+            logits[0, t, c] = 10.0
+        lp = jax.nn.log_softmax(jnp.asarray(logits))
+        paths, logp = ops.exec_op("ctc_beam_search_decoder", lp,
+                                  beam_width=8)
+        assert paths[0][0] == [1, 2]
+        assert logp.shape == (1, 1)
+
+    def test_merging_beats_greedy(self):
+        """The canonical CTC case: many alignments of one short label can
+        outweigh the single best alignment of the greedy label."""
+        # T=2, classes 0=blank,1=a. P(blank)=0.6, P(a)=0.4 each step.
+        # Greedy per-frame: [blank, blank] -> []. p([]) = 0.36 but
+        # p([a]) = 0.4*0.4(a,a collapses) + 0.4*0.6 + 0.6*0.4 = 0.64.
+        probs = np.asarray([[[0.6, 0.4], [0.6, 0.4]]], np.float32)
+        lp = jnp.asarray(np.log(probs))
+        paths, logp = ops.exec_op("ctc_beam_search_decoder", lp,
+                                  beam_width=4, top_paths=2)
+        assert paths[0][0] == [1]
+        np.testing.assert_allclose(np.exp(logp[0][0]), 0.64, rtol=1e-5)
+        np.testing.assert_allclose(np.exp(logp[0][1]), 0.36, rtol=1e-5)
+
+
+# ------------------------------------------------------------- rnn tail ----
+class TestRnnTail:
+    def _params(self, i=3, h=4):
+        wx = jnp.asarray(R.normal(size=(i, h)).astype(np.float32)) * 0.3
+        wh = jnp.asarray(R.normal(size=(h, h)).astype(np.float32)) * 0.3
+        b = jnp.asarray(R.normal(size=(h,)).astype(np.float32)) * 0.1
+        return wx, wh, b
+
+    def test_static_equals_dynamic(self):
+        wx, wh, b = self._params()
+        x = jnp.asarray(R.normal(size=(5, 2, 3)).astype(np.float32))
+        ys1, h1 = ops.exec_op("static_rnn", x, wx, wh, b)
+        ys2, h2 = ops.exec_op("dynamic_rnn", x, wx, wh, b)
+        np.testing.assert_allclose(ys1, ys2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h1, h2, rtol=1e-5, atol=1e-6)
+
+    def test_seq_lens_freeze_state_zero_output(self):
+        wx, wh, b = self._params()
+        x = jnp.asarray(R.normal(size=(4, 2, 3)).astype(np.float32))
+        ys, h = ops.exec_op("dynamic_rnn", x, wx, wh, b,
+                            seq_lens=jnp.asarray([2, 4]))
+        np.testing.assert_allclose(np.asarray(ys)[2:, 0], 0.0)
+        ys_short, h_short = ops.exec_op("dynamic_rnn", x[:2, :1], wx, wh, b)
+        np.testing.assert_allclose(h[0], h_short[0], rtol=1e-5, atol=1e-6)
+
+    def test_bidirectional_reverse_semantics(self):
+        wx, wh, b = self._params()
+        wx2, wh2, b2 = self._params()
+        x = jnp.asarray(R.normal(size=(4, 1, 3)).astype(np.float32))
+        ys, (hf, hb) = ops.exec_op("static_bidirectional_rnn", x, wx, wh, b,
+                                   wx2, wh2, b2)
+        assert ys.shape == (4, 1, 8)
+        # backward half at t=0 equals forward pass over reversed input at end
+        ys_rev, h_rev = ops.exec_op("static_rnn", x[::-1], wx2, wh2, b2)
+        np.testing.assert_allclose(np.asarray(ys)[:, :, 4:],
+                                   np.asarray(ys_rev)[::-1], rtol=1e-5)
+        np.testing.assert_allclose(hb, h_rev, rtol=1e-5)
+
+    def test_sru_bi_shapes_and_direction(self):
+        x = jnp.asarray(R.normal(size=(5, 2, 8)).astype(np.float32))
+        w = jnp.asarray(R.normal(size=(2, 12, 4)).astype(np.float32)) * 0.1
+        b = jnp.zeros((2, 8))
+        h, c = ops.exec_op("sru_bi", x, w, b)
+        assert h.shape == (5, 2, 8) and c.shape == (2, 2, 4)
+        hf, cf = ops.exec_op("sru", x[..., :4], w[0], b[0], layout=0)
+        np.testing.assert_allclose(np.asarray(h)[..., :4], hf, rtol=1e-5)
+
+
+# ---------------------------------------------------------- shape/bit tail --
+class TestShapeBitTail:
+    def test_scatter_nd_variants(self):
+        ref = jnp.zeros((4, 2))
+        idx = jnp.asarray([[1], [1]])
+        upd = jnp.ones((2, 2))
+        added = ops.exec_op("scatter_nd_add", ref, idx, upd)
+        np.testing.assert_allclose(np.asarray(added)[1], [2.0, 2.0])
+        sub = ops.exec_op("scatter_nd_sub", ref, idx, upd)
+        np.testing.assert_allclose(np.asarray(sub)[1], [-2.0, -2.0])
+        setv = ops.exec_op("scatter_nd_update", ref, idx, upd)
+        np.testing.assert_allclose(np.asarray(setv)[1], [1.0, 1.0])
+
+    def test_tear_and_bitcast(self):
+        parts = ops.exec_op("tear", jnp.arange(12.0).reshape(3, 4), axis=1)
+        assert len(parts) == 4 and parts[0].shape == (3,)
+        np.testing.assert_allclose(parts[2], [2.0, 6.0, 10.0])
+        x = jnp.asarray([1.5, -2.0], jnp.float32)
+        round_trip = ops.exec_op("bitcast",
+                                 ops.exec_op("bitcast", x, jnp.int32),
+                                 jnp.float32)
+        np.testing.assert_allclose(round_trip, x)
+        # TF width-change semantics: narrow appends a ratio dim, widen
+        # consumes it (NOT numpy's flat view)
+        narrow = ops.exec_op("bitcast", x, jnp.uint8)
+        assert narrow.shape == (2, 4)
+        wide = ops.exec_op("bitcast", narrow, jnp.float32)
+        assert wide.shape == (2,)
+        np.testing.assert_allclose(wide, x)
+        with pytest.raises(ValueError):
+            ops.exec_op("bitcast", jnp.zeros((3,), jnp.uint8), jnp.float32)
+
+    def test_broadcast_dynamic_shape(self):
+        out = ops.exec_op("broadcast_dynamic_shape", jnp.asarray([2, 1, 3]),
+                          jnp.asarray([4, 1]))
+        np.testing.assert_array_equal(out, [2, 4, 3])
+
+    def test_hamming_and_rotr(self):
+        a = np.asarray([0b1010, 0b1111], np.int32)
+        b = np.asarray([0b0101, 0b1111], np.int32)
+        got = int(ops.exec_op("bits_hamming_distance", jnp.asarray(a),
+                              jnp.asarray(b)))
+        assert got == 4
+        x = jnp.asarray([8], jnp.int32)
+        np.testing.assert_array_equal(
+            ops.exec_op("cyclic_rshift_bits", x, 3), [1])
+        # rotr by 0 is identity; rotr(rotl(x)) round-trips
+        np.testing.assert_array_equal(
+            ops.exec_op("cyclic_rshift_bits",
+                        ops.exec_op("cyclic_shift_bits", x, 7), 7), x)
+
+
+# ------------------------------------------------------------- quant tail --
+class TestQuantTail:
+    def test_fake_quant_grid_and_clip(self):
+        x = jnp.asarray([-10.0, 0.0, 2.5, 10.0])
+        y = np.asarray(ops.exec_op("fake_quant_with_min_max_vars", x,
+                                   min=0.0, max=6.0))
+        scale = 6.0 / 255.0
+        assert y[0] == 0.0 and abs(y[3] - 6.0) < 1e-6
+        np.testing.assert_allclose(y[2] / scale, np.round(y[2] / scale),
+                                   atol=1e-4)
+
+    def test_fake_quant_straight_through_grad(self):
+        f = lambda x: jnp.sum(ops.exec_op(
+            "fake_quant_with_min_max_vars", x, min=0.0, max=6.0))
+        g = jax.grad(f)(jnp.asarray([-1.0, 3.0, 7.0]))
+        np.testing.assert_allclose(g, [0.0, 1.0, 0.0])
+
+    def test_per_channel(self):
+        x = jnp.asarray([[-2.0, 2.0], [0.5, 0.5]])
+        y = ops.exec_op("fake_quant_with_min_max_vars_per_channel", x,
+                        jnp.asarray([-1.0, 0.0]), jnp.asarray([1.0, 1.0]))
+        assert float(y[0, 0]) >= -1.001 and float(y[0, 1]) <= 1.001
+
+    def test_compare_and_bitpack(self):
+        x = jnp.asarray(R.normal(size=(2, 16)).astype(np.float32))
+        out = np.asarray(ops.exec_op("compare_and_bitpack", x, 0.0))
+        ref = np.packbits((np.asarray(x) > 0).astype(np.uint8),
+                          axis=-1)
+        np.testing.assert_array_equal(out, ref)
+
+
+# ------------------------------------------------------------ linalg tail --
+class TestLinalgTail:
+    def test_lup_reconstructs(self):
+        a = jnp.asarray(R.normal(size=(4, 4)).astype(np.float32))
+        l, u, p = ops.exec_op("lup", a)
+        np.testing.assert_allclose(np.asarray(a)[np.asarray(p)],
+                                   np.asarray(l) @ np.asarray(u),
+                                   rtol=1e-4, atol=1e-5)
+        assert np.allclose(np.triu(np.asarray(l), 1), 0)
+        assert np.allclose(np.tril(np.asarray(u), -1), 0)
+
+    def test_matrix_set_diag(self):
+        x = jnp.ones((2, 3))
+        out = ops.exec_op("matrix_set_diag", x, jnp.asarray([7.0, 8.0]))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[7, 1, 1], [1, 8, 1]])
+
+    def test_solve_ls_matches_lstsq(self):
+        a = jnp.asarray(R.normal(size=(6, 3)).astype(np.float32))
+        b = jnp.asarray(R.normal(size=(6, 2)).astype(np.float32))
+        fast = ops.exec_op("solve_ls", a, b)
+        slow = ops.exec_op("solve_ls", a, b, fast=False)
+        np.testing.assert_allclose(fast, slow, rtol=1e-3, atol=1e-4)
+        # regularization shrinks the solution
+        reg = ops.exec_op("solve_ls", a, b, l2_regularizer=10.0)
+        assert np.linalg.norm(np.asarray(reg)) < np.linalg.norm(
+            np.asarray(fast))
+
+    def test_sufficient_statistics_compose_to_moments(self):
+        x = jnp.asarray(R.normal(size=(8, 3)).astype(np.float32))
+        count, m_ss, v_ss, shift = ops.exec_op("sufficient_statistics", x,
+                                               (0,))
+        mean, var = ops.exec_op("normalize_moments", count, m_ss, v_ss)
+        np.testing.assert_allclose(mean, jnp.mean(x, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(var, jnp.var(x, axis=0), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_zero_fraction(self):
+        np.testing.assert_allclose(
+            ops.exec_op("zero_fraction", jnp.asarray([0.0, 1.0, 0.0, 2.0])),
+            0.5)
+
+    def test_check_numerics(self):
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(ops.exec_op("check_numerics", x), x)
+        with pytest.raises(FloatingPointError):
+            ops.exec_op("check_numerics", jnp.asarray([1.0, np.nan]))
