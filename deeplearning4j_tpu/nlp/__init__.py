@@ -13,3 +13,7 @@ from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec, GloVe, ParagraphVectors  # noqa: F401
 from deeplearning4j_tpu.nlp.fasttext import FastText  # noqa: F401
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer  # noqa: F401
+from deeplearning4j_tpu.nlp.vectorizer import (  # noqa: F401
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)

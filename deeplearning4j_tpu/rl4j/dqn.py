@@ -43,6 +43,11 @@ class QLearningConfiguration:
     epsilon_nb_step: int = 3000       # linear anneal steps
     learning_rate: float = 1e-3
     hidden: Tuple[int, ...] = (64, 64)
+    # rl4j QLearningConfiguration.doubleDQN (DoubleDQN vs StandardDQN target
+    # computers, path-cite): bootstrap with Q_target evaluated at the ONLINE
+    # network's argmax action instead of max over the target network —
+    # van Hasselt's overestimation fix.
+    double_dqn: bool = False
 
 
 def _mlp_init(key, sizes):
@@ -142,7 +147,12 @@ class QLearningDiscreteDense:
 
         @jax.jit
         def train(params, target_params, opt_state, it, s, a, r, s2, done):
-            q_next = jnp.max(_mlp_apply(target_params, s2), axis=-1)
+            if c.double_dqn:
+                a_star = jnp.argmax(_mlp_apply(params, s2), axis=-1)
+                q_next = jnp.take_along_axis(
+                    _mlp_apply(target_params, s2), a_star[:, None], 1)[:, 0]
+            else:
+                q_next = jnp.max(_mlp_apply(target_params, s2), axis=-1)
             target = r * c.reward_factor + c.gamma * (1.0 - done) * q_next
 
             def loss_fn(params):

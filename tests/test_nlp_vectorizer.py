@@ -1,0 +1,69 @@
+"""BagOfWords / TF-IDF vectorizer tests (reference
+org.deeplearning4j.bagofwords.vectorizer.* test parity)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import BagOfWordsVectorizer, TfidfVectorizer
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs",
+]
+
+
+class TestBagOfWords:
+    def test_counts(self):
+        v = BagOfWordsVectorizer().fit(DOCS)
+        x = v.transform(DOCS[0])
+        assert x[v.index_of("the")] == 2.0
+        assert x[v.index_of("cat")] == 1.0
+        assert x[v.index_of("dog")] == 0.0
+
+    def test_min_word_frequency(self):
+        v = BagOfWordsVectorizer(min_word_frequency=2).fit(DOCS)
+        assert v.index_of("the") >= 0      # appears 4x
+        assert v.index_of("sat") >= 0      # 2x
+        assert v.index_of("cats") == -1    # 1x — filtered
+
+    def test_fit_transform_matrix(self):
+        v = BagOfWordsVectorizer()
+        m = v.fit_transform(DOCS)
+        assert m.shape == (3, len(v.vocab))
+        np.testing.assert_allclose(m[0], v.transform(DOCS[0]))
+
+    def test_vectorize_with_labels(self):
+        v = BagOfWordsVectorizer().fit(DOCS, labels=["pet", "pet", "both"])
+        x, y = v.vectorize(DOCS[2], "both")
+        assert y.tolist() == [1.0, 0.0]    # labels sorted: both, pet
+        with pytest.raises(ValueError):
+            v.vectorize(DOCS[0], "unknown")
+
+
+class TestTfidf:
+    def test_weighting_formula(self):
+        v = TfidfVectorizer().fit(DOCS)
+        x = v.transform(DOCS[0])
+        # "cat": tf=1, df=1, N=3 -> log10(3)
+        np.testing.assert_allclose(x[v.index_of("cat")], math.log10(3.0),
+                                   rtol=1e-6)
+        # "the": tf=2, df=2 -> 2*log10(1.5)
+        np.testing.assert_allclose(x[v.index_of("the")],
+                                   2 * math.log10(1.5), rtol=1e-6)
+        # word in every doc of a 3-doc corpus: idf = log10(1) = 0
+        v2 = TfidfVectorizer().fit(["a b", "a c", "a d"])
+        assert v2.transform("a a")[v2.index_of("a")] == 0.0
+
+    def test_tfidf_word_helper(self):
+        v = TfidfVectorizer().fit(DOCS)
+        np.testing.assert_allclose(v.tfidf_word("cat", 2),
+                                   2 * math.log10(3.0), rtol=1e-6)
+        assert v.tfidf_word("missing", 5) == 0.0
+
+    def test_unseen_words_ignored(self):
+        v = TfidfVectorizer().fit(DOCS)
+        x = v.transform("zebra quagga")
+        np.testing.assert_allclose(x, 0.0)
