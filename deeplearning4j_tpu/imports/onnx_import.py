@@ -630,7 +630,9 @@ def _o_conv(m, node):
 def _o_pool(m, node):
     x = m.get(node.inputs[0])
     k = tuple(node.attr("kernel_shape"))
-    strides = tuple(node.attr("strides", list(k)))
+    # ONNX spec: strides default to 1 per spatial axis (NOT kernel_shape —
+    # torch always writes the attr, so the corpus never hit this default)
+    strides = tuple(node.attr("strides", [1] * len(k)))
     pads = node.attr("pads", [0, 0, 0, 0])
     xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
     if node.attr("auto_pad", "NOTSET") in ("SAME_UPPER", "SAME_LOWER"):
@@ -1420,3 +1422,555 @@ def _o_einsum(m, node):
     m.set(node.outputs[0], m.sd._op("einsum_apply", operands,
                                     attrs=dict(equation=eq),
                                     name=node.outputs[0]))
+
+
+# ---------------------------------------------------------------------------
+# Round-5 rules: quantization (QDQ), normalization tail, spatial samplers,
+# signal ops, losses, random family, const-foldable dynamics.
+# ---------------------------------------------------------------------------
+
+def _axis_shaped(m, var, axis, rank):
+    """Reshape a per-axis 1-D param for broadcasting along `axis` of a
+    rank-`rank` tensor (QuantizeLinear per-axis convention)."""
+    shape = [1] * rank
+    shape[axis] = -1
+    return m.sd._op("reshape", [var], attrs=dict(shape=tuple(shape)))
+
+
+def _q_range(np_dtype):
+    info = np.iinfo(np_dtype)
+    return float(info.min), float(info.max)
+
+
+@orule("QuantizeLinear")
+def _o_quantize_linear(m, node):
+    x = m.get(node.inputs[0])
+    scale = m.get(node.inputs[1])
+    axis = node.attr("axis", 1)
+    rank = len(x.shape) if x.shape is not None else None
+    zp_arr = None
+    if m.has_input(node, 2):
+        zp_arr = m.const(node.inputs[2])
+        qdt = zp_arr.dtype
+    else:
+        qdt = np.dtype(np.uint8)
+    qmin, qmax = _q_range(qdt)
+    sc_shape = m.const_vals.get(node.inputs[1])
+    per_axis = (sc_shape is not None and sc_shape.ndim == 1
+                and sc_shape.size > 1)
+    if per_axis:
+        if rank is None:
+            raise NotImplementedError("per-axis QuantizeLinear needs rank")
+        scale = _axis_shaped(m, scale, axis, rank)
+    y = m.sd._op("div", [x, scale])
+    y = m.sd._op("rint", [y])
+    if zp_arr is not None and np.any(zp_arr):
+        zp = m.sd._op("cast", [m.get(node.inputs[2])],
+                      attrs=dict(dtype=np.float32))
+        if per_axis:
+            zp = _axis_shaped(m, zp, axis, rank)
+        y = m.sd._op("add", [y, zp])
+    y = m.sd._op("clipbyvalue", [y], attrs=dict(clip_min=qmin, clip_max=qmax))
+    m.set(node.outputs[0], m.sd._op("cast", [y], attrs=dict(dtype=qdt),
+                                    name=node.outputs[0]))
+
+
+@orule("DequantizeLinear")
+def _o_dequantize_linear(m, node):
+    x = m.get(node.inputs[0])
+    scale = m.get(node.inputs[1])
+    axis = node.attr("axis", 1)
+    rank = len(x.shape) if x.shape is not None else None
+    xf = m.sd._op("cast", [x], attrs=dict(dtype=np.float32))
+    sc_val = m.const_vals.get(node.inputs[1])
+    per_axis = sc_val is not None and sc_val.ndim == 1 and sc_val.size > 1
+    if m.has_input(node, 2):
+        zp = m.sd._op("cast", [m.get(node.inputs[2])],
+                      attrs=dict(dtype=np.float32))
+        if per_axis:
+            if rank is None:
+                raise NotImplementedError(
+                    "per-axis DequantizeLinear needs rank")
+            zp = _axis_shaped(m, zp, axis, rank)
+        xf = m.sd._op("sub", [xf, zp])
+    if per_axis:
+        scale = _axis_shaped(m, scale, axis, rank)
+    m.set(node.outputs[0], m.sd._op("mul", [xf, scale],
+                                    name=node.outputs[0]))
+
+
+@orule("DynamicQuantizeLinear")
+def _o_dynamic_quantize(m, node):
+    # spec: rmin=min(0,min(x)), rmax=max(0,max(x)); scale=(rmax-rmin)/255;
+    # zp=round(clip(-rmin/scale, 0, 255)); y=round(x/scale)+zp clipped u8
+    x = m.get(node.inputs[0])
+    zero = m.sd.constant(np.float32(0.0))
+    rmin = m.sd._op("minimum", [m.sd._op("reduce_min", [x]), zero])
+    rmax = m.sd._op("maximum", [m.sd._op("reduce_max", [x]), zero])
+    scale = m.sd._op("div", [m.sd._op("sub", [rmax, rmin]),
+                             m.sd.constant(np.float32(255.0))])
+    zp_f = m.sd._op("clipbyvalue", [
+        m.sd._op("rint", [m.sd._op("div", [m.sd._op("neg", [rmin]),
+                                           scale])])],
+        attrs=dict(clip_min=0.0, clip_max=255.0))
+    y = m.sd._op("clipbyvalue", [
+        m.sd._op("add", [m.sd._op("rint", [m.sd._op("div", [x, scale])]),
+                         zp_f])], attrs=dict(clip_min=0.0, clip_max=255.0))
+    m.set(node.outputs[0], m.sd._op("cast", [y],
+                                    attrs=dict(dtype=np.uint8),
+                                    name=node.outputs[0]))
+    m.set(node.outputs[1], scale)
+    m.set(node.outputs[2], m.sd._op("cast", [zp_f],
+                                    attrs=dict(dtype=np.uint8)))
+
+
+@orule("GroupNormalization")
+def _o_group_norm(m, node):
+    # opset 18+: x (N, C, *spatial), scale (C), bias (C)
+    x = m.get(node.inputs[0])
+    eps = node.attr("epsilon", 1e-5)
+    groups = node.attr("num_groups")
+    shp = x.shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise NotImplementedError("GroupNormalization needs static shape")
+    n, c = shp[0], shp[1]
+    spatial = tuple(shp[2:])
+    g = int(groups)
+    xg = m.sd._op("reshape", [x], attrs=dict(
+        shape=(n, g, c // g) + spatial))
+    axes = tuple(range(2, 2 + 1 + len(spatial)))
+    mean = m.sd._op("mean", [xg], attrs=dict(axis=axes, keepdims=True))
+    diff = m.sd._op("sub", [xg, mean])
+    var = m.sd._op("mean", [m.sd._op("square", [diff])],
+                   attrs=dict(axis=axes, keepdims=True))
+    denom = m.sd._op("sqrt", [m.sd._op("scalar_add", [var, float(eps)])])
+    norm = m.sd._op("reshape", [m.sd._op("div", [diff, denom])],
+                    attrs=dict(shape=(n, c) + spatial))
+    pshape = (1, c) + (1,) * len(spatial)
+    scale = m.sd._op("reshape", [m.get(node.inputs[1])],
+                     attrs=dict(shape=pshape))
+    bias = m.sd._op("reshape", [m.get(node.inputs[2])],
+                    attrs=dict(shape=pshape))
+    m.set(node.outputs[0], m.sd._op(
+        "add", [m.sd._op("mul", [norm, scale]), bias],
+        name=node.outputs[0]))
+
+
+@orule("MeanVarianceNormalization")
+def _o_mvn(m, node):
+    x = m.get(node.inputs[0])
+    axes = tuple(node.attr("axes", [0, 2, 3]))
+    mean = m.sd._op("mean", [x], attrs=dict(axis=axes, keepdims=True))
+    diff = m.sd._op("sub", [x, mean])
+    var = m.sd._op("mean", [m.sd._op("square", [diff])],
+                   attrs=dict(axis=axes, keepdims=True))
+    m.set(node.outputs[0], m.sd._op(
+        "div", [diff, m.sd._op("sqrt",
+                               [m.sd._op("scalar_add", [var, 1e-9])])],
+        name=node.outputs[0]))
+
+
+@orule("ScatterElements")
+def _o_scatter_elements(m, node):
+    x, idx, upd = (m.get(i) for i in node.inputs[:3])
+    red = node.attr("reduction", "none")
+    if isinstance(red, bytes):
+        red = red.decode()
+    m.set(node.outputs[0], m.sd._op(
+        "put_along_axis", [x, idx, upd],
+        attrs=dict(axis=node.attr("axis", 0), reduction=red),
+        name=node.outputs[0]))
+
+
+@orule("LpPool")
+def _o_lp_pool(m, node):
+    x = m.get(node.inputs[0])
+    p = node.attr("p", 2)
+    k = tuple(node.attr("kernel_shape"))
+    # ONNX spec: strides default to 1 per spatial axis (NOT kernel_shape)
+    strides = tuple(node.attr("strides", [1] * len(k)))
+    pads = node.attr("pads", [0, 0, 0, 0])
+    if node.attr("auto_pad", "NOTSET") not in ("NOTSET", "VALID") \
+            or any(pads):
+        raise NotImplementedError("LpPool with padding")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    y = m.sd._op("pnormpool2d", [xh], attrs=dict(
+        kernel=k, strides=strides, padding="VALID", p=int(p)))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+@orule("GlobalLpPool")
+def _o_global_lp_pool(m, node):
+    x = m.get(node.inputs[0])
+    p = float(node.attr("p", 2))
+    ap = m.sd._op("pow", [m.sd._op("abs", [x]),
+                          m.sd.constant(np.float32(p))])
+    s = m.sd._op("sum", [ap], attrs=dict(axis=(2, 3), keepdims=True))
+    m.set(node.outputs[0], m.sd._op(
+        "pow", [s, m.sd.constant(np.float32(1.0 / p))],
+        name=node.outputs[0]))
+
+
+@orule("Upsample")
+def _o_upsample(m, node):
+    # deprecated opset-9 op: scales as input (or attr in opset 7)
+    x = m.get(node.inputs[0])
+    mode = node.attr("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if mode not in ("nearest",):
+        raise NotImplementedError(f"Upsample mode {mode!r} (use Resize)")
+    scales = node.attr("scales")
+    if scales is None:
+        scales = [float(v) for v in m.const(node.inputs[1])]
+    shp = x.shape
+    if shp is None or any(s is None or s < 0 for s in shp[2:]):
+        raise NotImplementedError("Upsample with unknown spatial dims")
+    out_hw = tuple(int(np.floor(s * f))
+                   for s, f in zip(shp[2:], scales[2:]))
+    # Upsample is ASYMMETRIC-coordinate nearest; jax.image.resize samples
+    # at half-pixel coords — they coincide only at integer upscales (same
+    # guard as the Resize rule's 'asymmetric' branch)
+    if any(o % s for s, o in zip(shp[2:], out_hw)):
+        raise NotImplementedError(
+            "Upsample with non-integer scale (asymmetric vs half-pixel "
+            "sampling differ; re-export with Resize + an explicit "
+            "coordinate_transformation_mode)")
+    xh = m.sd._op("permute", [x], attrs=dict(axes=(0, 2, 3, 1)))
+    y = m.sd._op("image_resize", [xh], attrs=dict(size=out_hw,
+                                                  method="nearest"))
+    m.set(node.outputs[0], m.sd._op("permute", [y],
+                                    attrs=dict(axes=(0, 3, 1, 2)),
+                                    name=node.outputs[0]))
+
+
+@orule("HannWindow", "HammingWindow", "BlackmanWindow")
+def _o_window(m, node):
+    size = int(m.const(node.inputs[0]))
+    periodic = bool(node.attr("periodic", 1))
+    kind = {"HannWindow": "hann_window", "HammingWindow": "hamming_window",
+            "BlackmanWindow": "blackman_window"}[node.op_type]
+    m.set(node.outputs[0], m.sd._op(
+        kind, [], attrs=dict(size=size, periodic=periodic),
+        name=node.outputs[0]))
+
+
+@orule("DFT")
+def _o_dft(m, node):
+    # input: (..., n, 1) real or (..., n, 2) real/imag pairs
+    x = m.get(node.inputs[0])
+    if node.attr("inverse", 0):
+        raise NotImplementedError("inverse DFT")
+    onesided = bool(node.attr("onesided", 0))
+    axis = node.attr("axis", 1)
+    if m.has_input(node, 1) and node.inputs[1]:
+        raise NotImplementedError("DFT with explicit dft_length")
+    shp = x.shape
+    if shp is None:
+        raise NotImplementedError("DFT needs known rank")
+    # the node's axis counts in the FULL rank (incl. the trailing
+    # component dim); normalize before squeeze/pack drops that dim
+    axis = axis % len(shp)
+    if axis == len(shp) - 1:
+        raise NotImplementedError("DFT over the component dim")
+    last = shp[-1]
+    if last == 1:
+        xr = m.sd._op("squeeze", [x], attrs=dict(axis=-1))
+        if onesided:
+            c = m.sd._op("rfft", [xr], attrs=dict(axis=axis))
+        else:
+            c = m.sd._op("fft", [xr], attrs=dict(axis=axis))
+    elif last == 2:
+        if onesided:
+            raise NotImplementedError("onesided DFT of complex input")
+        c = m.sd._op("fft", [m.sd._op("complex_pack", [x])],
+                     attrs=dict(axis=axis))
+    else:
+        raise NotImplementedError("DFT input must end in dim 1 or 2")
+    m.set(node.outputs[0], m.sd._op("complex_unpack", [c],
+                                    name=node.outputs[0]))
+
+
+@orule("STFT")
+def _o_stft(m, node):
+    x = m.get(node.inputs[0])
+    step = int(m.const(node.inputs[1]))
+    window = m.get(node.inputs[2]) if m.has_input(node, 2) else None
+    if m.has_input(node, 3):
+        frame_length = int(m.const(node.inputs[3]))
+    elif window is not None:
+        wshape = m.const(node.inputs[2]).shape
+        frame_length = int(wshape[0])
+    else:
+        raise NotImplementedError("STFT without frame_length or window")
+    onesided = bool(node.attr("onesided", 1))
+    ins = [x] if window is None else [x, window]
+    c = m.sd._op("stft", ins, attrs=dict(
+        frame_length=frame_length, frame_step=step, onesided=onesided))
+    m.set(node.outputs[0], m.sd._op("complex_unpack", [c],
+                                    name=node.outputs[0]))
+
+
+@orule("NegativeLogLikelihoodLoss")
+def _o_nll_loss(m, node):
+    ins = [m.get(node.inputs[0]), m.get(node.inputs[1])]
+    if m.has_input(node, 2):
+        ins.append(m.get(node.inputs[2]))
+    red = node.attr("reduction", "mean")
+    if isinstance(red, bytes):
+        red = red.decode()
+    m.set(node.outputs[0], m.sd._op(
+        "nll_loss", ins,
+        attrs=dict(reduction=red,
+                   ignore_index=node.attr("ignore_index")),
+        name=node.outputs[0]))
+
+
+@orule("SoftmaxCrossEntropyLoss")
+def _o_sce_loss(m, node):
+    scores = m.get(node.inputs[0])
+    target = m.get(node.inputs[1])
+    red = node.attr("reduction", "mean")
+    if isinstance(red, bytes):
+        red = red.decode()
+    logp = m.sd._op("log_softmax", [scores], attrs=dict(axis=1))
+    ins = [logp, target]
+    if m.has_input(node, 2):
+        ins.append(m.get(node.inputs[2]))
+    loss = m.sd._op("nll_loss", ins, attrs=dict(
+        reduction=red, ignore_index=node.attr("ignore_index")),
+        name=node.outputs[0])
+    m.set(node.outputs[0], loss)
+    if len(node.outputs) > 1 and node.outputs[1]:
+        m.set(node.outputs[1], logp)
+
+
+@orule("GridSample")
+def _o_grid_sample(m, node):
+    x, grid = m.get(node.inputs[0]), m.get(node.inputs[1])
+    mode = node.attr("mode", "bilinear")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    mode = {"linear": "bilinear", "bilinear": "bilinear",
+            "nearest": "nearest"}.get(mode)
+    if mode is None:
+        raise NotImplementedError("GridSample cubic mode")
+    pad = node.attr("padding_mode", "zeros")
+    if isinstance(pad, bytes):
+        pad = pad.decode()
+    m.set(node.outputs[0], m.sd._op(
+        "grid_sample", [x, grid],
+        attrs=dict(mode=mode, padding_mode=pad,
+                   align_corners=bool(node.attr("align_corners", 0))),
+        name=node.outputs[0]))
+
+
+@orule("RoiAlign")
+def _o_roi_align(m, node):
+    x, rois, bidx = (m.get(i) for i in node.inputs[:3])
+    # attr introduced in opset 16 (default there: half_pixel). A node
+    # WITHOUT the attr is a pre-16 export whose semantics are the legacy
+    # output_half_pixel (no 0.5 offset) — same attr-absent reasoning as
+    # the Resize rule's opset-10 branch.
+    ctm = node.attr("coordinate_transformation_mode", "output_half_pixel")
+    if isinstance(ctm, bytes):
+        ctm = ctm.decode()
+    mode = node.attr("mode", "avg")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    ratio = node.attr("sampling_ratio", 0)
+    if ratio <= 0:
+        # ONNX default 0 means adaptive (data-dependent grid) — approximate
+        # with the torchvision-export default of 2 samples per bin axis
+        ratio = 2
+    m.set(node.outputs[0], m.sd._op(
+        "roi_align", [x, rois, bidx],
+        attrs=dict(output_size=(node.attr("output_height", 1),
+                                node.attr("output_width", 1)),
+                   spatial_scale=node.attr("spatial_scale", 1.0),
+                   sampling_ratio=int(ratio), mode=mode,
+                   aligned=(ctm == "half_pixel")),
+        name=node.outputs[0]))
+
+
+@orule("CenterCropPad")
+def _o_center_crop_pad(m, node):
+    x = m.get(node.inputs[0])
+    target = [int(v) for v in m.const(node.inputs[1])]
+    shp = x.shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise NotImplementedError("CenterCropPad needs static shape")
+    axes = node.attr("axes")
+    axes = list(range(len(shp))) if axes is None \
+        else [a % len(shp) for a in axes]
+    new_shape = list(shp)
+    begins = [0] * len(shp)
+    sizes = list(shp)
+    pads = [(0, 0)] * len(shp)
+    for a, t in zip(axes, target):
+        new_shape[a] = t
+        if t < shp[a]:                     # crop centered
+            begins[a] = (shp[a] - t) // 2
+            sizes[a] = t
+        elif t > shp[a]:                   # pad centered
+            lo = (t - shp[a]) // 2
+            pads[a] = (lo, t - shp[a] - lo)
+    y = m.sd._op("slice", [x], attrs=dict(begin=tuple(begins),
+                                          sizes=tuple(sizes)))
+    if any(p != (0, 0) for p in pads):
+        y = m.sd._op("pad", [y], attrs=dict(paddings=tuple(pads)))
+    m.set(node.outputs[0], m.sd._op("identity", [y], name=node.outputs[0]))
+
+
+@orule("MaxUnpool")
+def _o_max_unpool(m, node):
+    x, idx = m.get(node.inputs[0]), m.get(node.inputs[1])
+    shp = x.shape
+    if shp is None:
+        raise NotImplementedError("MaxUnpool needs known input shape")
+    if m.has_input(node, 2):
+        out_shape = tuple(int(v) for v in m.const(node.inputs[2]))
+    else:
+        k = node.attr("kernel_shape")
+        strides = node.attr("strides", list(k))
+        pads = node.attr("pads", [0] * (2 * len(k)))
+        spatial = [
+            (shp[2 + i] - 1) * strides[i] - pads[i] - pads[len(k) + i]
+            + k[i] for i in range(len(k))]
+        out_shape = tuple(shp[:2]) + tuple(spatial)
+    m.set(node.outputs[0], m.sd._op(
+        "max_unpool2d", [x, idx], attrs=dict(output_shape=out_shape),
+        name=node.outputs[0]))
+
+
+def _o_seed_key(m, node, tag):
+    import zlib
+
+    import jax as _jax
+
+    seed = node.attr("seed")
+    seed_i = int(seed if seed is not None else 0) & 0x7FFFFFFF
+    # crc32, not hash(): str hashes are salted per process (same convention
+    # as samediff weight init) — imports must reproduce across processes.
+    # The output name goes into the mix so two same-type random nodes in
+    # one graph draw INDEPENDENT streams.
+    mix = zlib.crc32(f"{tag}:{node.outputs[0]}".encode()) & 0x7FFFFFFF
+    key = np.asarray(_jax.random.PRNGKey(seed_i ^ mix))
+    return m.sd.constant(key, name=f"{node.outputs[0]}__key")
+
+
+@orule("RandomNormal", "RandomNormalLike")
+def _o_random_normal(m, node):
+    if node.op_type == "RandomNormal":
+        shape = tuple(node.attr("shape"))
+    else:
+        shp = m.get(node.inputs[0]).shape
+        if shp is None or any(s is None or s < 0 for s in shp):
+            raise NotImplementedError("RandomNormalLike needs static shape")
+        shape = tuple(shp)
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+    key = _o_seed_key(m, node, "normal")
+    m.set(node.outputs[0], m.sd._op(
+        "random_normal", [key],
+        attrs=dict(shape=shape, mean=node.attr("mean", 0.0),
+                   stddev=node.attr("scale", 1.0), dtype=np.dtype(dt)),
+        name=node.outputs[0]))
+
+
+@orule("RandomUniform", "RandomUniformLike")
+def _o_random_uniform(m, node):
+    if node.op_type == "RandomUniform":
+        shape = tuple(node.attr("shape"))
+    else:
+        shp = m.get(node.inputs[0]).shape
+        if shp is None or any(s is None or s < 0 for s in shp):
+            raise NotImplementedError("RandomUniformLike needs static shape")
+        shape = tuple(shp)
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+    key = _o_seed_key(m, node, "uniform")
+    m.set(node.outputs[0], m.sd._op(
+        "random_uniform", [key],
+        attrs=dict(shape=shape, minval=node.attr("low", 0.0),
+                   maxval=node.attr("high", 1.0), dtype=np.dtype(dt)),
+        name=node.outputs[0]))
+
+
+@orule("Bernoulli")
+def _o_bernoulli(m, node):
+    x = m.get(node.inputs[0])
+    shp = x.shape
+    if shp is None or any(s is None or s < 0 for s in shp):
+        raise NotImplementedError("Bernoulli needs static shape")
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+    key = _o_seed_key(m, node, "bernoulli")
+    m.set(node.outputs[0], m.sd._op(
+        "random_bernoulli", [key, None, x],
+        attrs=dict(dtype=np.dtype(dt)), name=node.outputs[0]))
+
+
+@orule("Multinomial")
+def _o_multinomial(m, node):
+    logits = m.get(node.inputs[0])
+    key = _o_seed_key(m, node, "multinomial")
+    samples = m.sd._op("random_categorical", [key, logits],
+                       attrs=dict(num_samples=node.attr("sample_size", 1)))
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.int32
+    m.set(node.outputs[0], m.sd._op("cast", [samples],
+                                    attrs=dict(dtype=np.dtype(dt)),
+                                    name=node.outputs[0]))
+
+
+@orule("Compress")
+def _o_compress(m, node):
+    # output length is the number of True conditions — data-dependent, so
+    # the condition must be constant (fold to a gather); loud otherwise
+    cond = np.asarray(m.const(node.inputs[1])).astype(bool)
+    idx = np.nonzero(cond)[0].astype(np.int64)
+    x = m.get(node.inputs[0])
+    axis = node.attr("axis")
+    iv = m.sd.constant(idx, name=f"{node.outputs[0]}__idx")
+    if axis is None:
+        flat = m.sd._op("reshape", [x], attrs=dict(shape=(-1,)))
+        m.set(node.outputs[0], m.sd._op("gather", [flat, iv],
+                                        attrs=dict(axis=0),
+                                        name=node.outputs[0]))
+    else:
+        m.set(node.outputs[0], m.sd._op("gather", [x, iv],
+                                        attrs=dict(axis=int(axis)),
+                                        name=node.outputs[0]))
+
+
+@orule("NonZero")
+def _o_nonzero(m, node):
+    # output shape = number of nonzeros: XLA-dynamic. Constant inputs fold;
+    # anything else fails loudly rather than guessing a size.
+    val = m.const(node.inputs[0])
+    out = np.stack(np.nonzero(np.asarray(val))).astype(np.int64)
+    m.set(node.outputs[0], m.sd.constant(out, name=node.outputs[0]),
+          const_val=out)
+
+
+@orule("Unique")
+def _o_unique(m, node):
+    val = np.asarray(m.const(node.inputs[0]))
+    if node.attr("axis") is not None:
+        raise NotImplementedError("Unique with axis")
+    sorted_attr = node.attr("sorted", 1)
+    uniq, first_idx, inverse, counts = np.unique(
+        val.reshape(-1), return_index=True, return_inverse=True,
+        return_counts=True)
+    if not sorted_attr:
+        order = np.argsort(first_idx, kind="stable")
+        remap = np.empty_like(order)
+        remap[order] = np.arange(order.size)
+        uniq = uniq[order]
+        first_idx = first_idx[order]
+        counts = counts[order]
+        inverse = remap[inverse]
+    outs = [uniq, first_idx.astype(np.int64), inverse.astype(np.int64),
+            counts.astype(np.int64)]
+    for i, o in enumerate(node.outputs):
+        if o:
+            m.set(o, m.sd.constant(outs[i], name=o), const_val=outs[i])

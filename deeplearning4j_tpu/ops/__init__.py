@@ -37,6 +37,7 @@ from deeplearning4j_tpu.ops import (  # noqa: F401
     reduce,
     rnn,
     shape_ops,
+    signal,
     updater_ops,
 )
 

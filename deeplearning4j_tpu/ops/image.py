@@ -281,3 +281,125 @@ def ssim(a, b, max_val=1.0, filter_size=11, filter_sigma=1.5, k1=0.01,
     lum = (2.0 * mu_a * mu_b + c1) / (mu_a ** 2 + mu_b ** 2 + c1)
     cs = (2.0 * cov + c2) / (va + vb + c2)
     return jnp.mean(lum * cs, axis=(1, 2, 3))
+
+
+# ---------------------------------------------------------------------------
+# Round-5: spatial samplers for the ONNX vision tail (GridSample, RoiAlign
+# — onnx.ai op set; torch F.grid_sample / torchvision.ops.roi_align
+# semantics, which the ONNX exporters emit). NCHW at the op boundary (the
+# layout those exporters use); gathers + lerp, fully differentiable.
+# ---------------------------------------------------------------------------
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _sample_bilinear_nchw(img, px, py, padding_mode):
+    """img: (C, H, W); px/py: (...,) pixel coords. Returns (C, ...)."""
+    c, h, w = img.shape
+    x0 = jnp.floor(px)
+    y0 = jnp.floor(py)
+    wx = px - x0
+    wy = py - y0
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            weight = ((wx if dx else 1.0 - wx)
+                      * (wy if dy else 1.0 - wy))
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            val = img[:, yc, xc]                     # (C, ...)
+            if padding_mode == "zeros":
+                inb = ((xi >= 0) & (xi <= w - 1)
+                       & (yi >= 0) & (yi <= h - 1)).astype(img.dtype)
+                weight = weight * inb
+            out = out + val * weight.astype(img.dtype)
+    return out
+
+
+@op("grid_sample", "image")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=False):
+    """torch F.grid_sample / ONNX GridSample. x: (N, C, H, W); grid:
+    (N, Ho, Wo, 2) normalized (x, y) in [-1, 1]. Returns (N, C, Ho, Wo)."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(f"padding_mode {padding_mode!r}")
+    h, w = x.shape[2], x.shape[3]
+    px = _unnormalize(grid[..., 0], w, align_corners)   # (N, Ho, Wo)
+    py = _unnormalize(grid[..., 1], h, align_corners)
+
+    if mode == "nearest":
+        def one(img, gx, gy):
+            xi = jnp.round(gx)
+            yi = jnp.round(gy)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            val = img[:, yc, xc]
+            if padding_mode == "zeros":
+                inb = ((xi >= 0) & (xi <= w - 1)
+                       & (yi >= 0) & (yi <= h - 1)).astype(img.dtype)
+                val = val * inb
+            return val
+    elif mode == "bilinear":
+        def one(img, gx, gy):
+            return _sample_bilinear_nchw(img, gx, gy, padding_mode)
+    else:
+        raise NotImplementedError(f"grid_sample mode {mode!r}")
+    return jax.vmap(one)(x, px, py)
+
+
+@op("roi_align", "image")
+def roi_align(x, boxes, batch_indices, output_size=(7, 7),
+              spatial_scale=1.0, sampling_ratio=2, mode="avg",
+              aligned=True):
+    """torchvision roi_align / ONNX RoiAlign. x: (N, C, H, W); boxes:
+    (K, 4) as (x1, y1, x2, y2); batch_indices: (K,). Returns
+    (K, C, oh, ow). ``aligned`` is ONNX half_pixel (the torchvision
+    aligned=True offset). ``sampling_ratio`` must be positive: the
+    adaptive (<=0) variant sizes its sampling grid per-roi at RUNTIME —
+    a data-dependent shape XLA cannot compile; exporters emit an explicit
+    ratio (torchvision defaults its ONNX export to 2)."""
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if int(sampling_ratio) <= 0:
+        raise NotImplementedError(
+            "roi_align adaptive sampling_ratio<=0 is data-dependent; "
+            "pass an explicit positive ratio")
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    r = int(sampling_ratio)
+    off = 0.5 if aligned else 0.0
+
+    def one(box, bi):
+        img = x[bi]                                    # (C, H, W)
+        x1, y1, x2, y2 = (box * spatial_scale) - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:                                # torchvision legacy
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bh = rh / oh
+        bw = rw / ow
+        # sample grid: r x r points per output bin, at bin-relative
+        # (i + (j+0.5)/r) positions — torchvision's exact layout
+        gy = (y1 + bh * (jnp.arange(oh)[:, None]
+                         + (jnp.arange(r)[None, :] + 0.5) / r))  # (oh, r)
+        gx = (x1 + bw * (jnp.arange(ow)[:, None]
+                         + (jnp.arange(r)[None, :] + 0.5) / r))  # (ow, r)
+        py = gy.reshape(-1)[:, None]                    # (oh*r, 1)
+        px = gx.reshape(-1)[None, :]                    # (1, ow*r)
+        vals = _sample_bilinear_nchw(
+            img, jnp.broadcast_to(px, (oh * r, ow * r)),
+            jnp.broadcast_to(py, (oh * r, ow * r)), "border")  # (C,...)
+        vals = vals.reshape(img.shape[0], oh, r, ow, r)
+        if mode == "max":
+            return jnp.max(vals, axis=(2, 4))
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one)(boxes, jnp.asarray(batch_indices, jnp.int32))

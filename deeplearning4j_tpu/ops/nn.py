@@ -1077,3 +1077,46 @@ def ctc_beam_search_decoder(log_probs, sequence_lengths=None, beam_width=16,
         row += [NEG] * (top_paths - len(row))
         all_logp.append(row)
     return all_paths, _np.asarray(all_logp, _np.float32)
+
+
+@op("nll_loss", "loss")
+def nll_loss(log_probs, target, weight=None, reduction="mean",
+             ignore_index=None):
+    """Negative log-likelihood over class axis 1 (ONNX
+    NegativeLogLikelihoodLoss / torch F.nll_loss semantics).
+    log_probs: (N, C, d...); target: (N, d...) int. ``reduction`` mean is
+    weight-normalized (sum of per-element weights), per the spec."""
+    lp = _accf(log_probs)
+    target = jnp.asarray(target)
+    tc = jnp.expand_dims(target, 1)                     # (N, 1, d...)
+    safe_t = jnp.clip(tc, 0, lp.shape[1] - 1)
+    picked = -jnp.take_along_axis(lp, safe_t, axis=1)[:, 0]   # (N, d...)
+    if weight is not None:
+        w_el = jnp.asarray(weight, lp.dtype)[jnp.clip(
+            target, 0, lp.shape[1] - 1)]
+    else:
+        w_el = jnp.ones_like(picked)
+    if ignore_index is not None:
+        keep = (target != ignore_index).astype(lp.dtype)
+        w_el = w_el * keep
+    picked = picked * w_el
+    if reduction == "none":
+        return picked
+    if reduction == "sum":
+        return jnp.sum(picked)
+    return jnp.sum(picked) / jnp.maximum(jnp.sum(w_el), 1e-12)
+
+
+@op("max_unpool2d", "pooling", differentiable=False)
+def max_unpool2d(x, indices, output_shape):
+    """Scatter pooled values back to their argmax positions (ONNX
+    MaxUnpool): ``indices`` are row-major flat positions into the FULL
+    output tensor (the ONNX MaxPool Indices convention); everything else
+    is zero. Duplicate indices: last write wins."""
+    x = jnp.asarray(x)
+    total = 1
+    for s in output_shape:
+        total *= int(s)
+    flat = jnp.zeros((total,), x.dtype)
+    flat = flat.at[jnp.asarray(indices).reshape(-1)].set(x.reshape(-1))
+    return flat.reshape(tuple(output_shape))

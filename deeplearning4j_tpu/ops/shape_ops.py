@@ -609,3 +609,31 @@ def broadcast_dynamic_shape(a, b):
     a = tuple(int(v) for v in np.asarray(a))
     b = tuple(int(v) for v in np.asarray(b))
     return jnp.asarray(np.broadcast_shapes(a, b), jnp.int32)
+
+
+@op("put_along_axis", "gather_scatter", aliases=("scatter_elements",))
+def put_along_axis(x, indices, updates, axis=0, reduction="none"):
+    """Axis-wise elementwise scatter (ONNX ScatterElements / torch
+    scatter): the inverse of take_along_axis. ``reduction``:
+    none (replace) | add | mul | max | min."""
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    updates = jnp.asarray(updates, x.dtype)
+    idx = [jnp.broadcast_to(
+        jnp.arange(indices.shape[d]).reshape(
+            tuple(indices.shape[d] if i == d else 1
+                  for i in range(indices.ndim))), indices.shape)
+        for d in range(indices.ndim)]
+    idx[axis] = indices
+    ref = x.at[tuple(idx)]
+    if reduction == "none":
+        return ref.set(updates)
+    if reduction == "add":
+        return ref.add(updates)
+    if reduction == "mul":
+        return ref.multiply(updates)
+    if reduction == "max":
+        return ref.max(updates)
+    if reduction == "min":
+        return ref.min(updates)
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -1,0 +1,118 @@
+"""Signal-processing ops: FFT family, windows, STFT.
+
+Reference parity: nd4j's fft surface (org.nd4j.linalg.fft, path-cite,
+mount empty this round) and the ONNX signal operator set (DFT/STFT/
+HannWindow/HammingWindow/BlackmanWindow) that ``imports/onnx_import.py``
+lowers to. Complex tensors follow the ONNX convention at the op boundary
+where noted: a trailing dim of size 2 holding (real, imag) — XLA has
+native complex, so internally these are complex64/128 and convert at the
+edges only when asked.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import op
+
+
+@op("fft", "signal", differentiable=False)
+def fft(x, n=None, axis=-1):
+    """Complex FFT (input real or complex) -> complex."""
+    return jnp.fft.fft(jnp.asarray(x), n=n, axis=axis)
+
+
+@op("ifft", "signal", differentiable=False)
+def ifft(x, n=None, axis=-1):
+    return jnp.fft.ifft(jnp.asarray(x), n=n, axis=axis)
+
+
+@op("rfft", "signal", differentiable=False)
+def rfft(x, n=None, axis=-1):
+    """Real-input FFT -> onesided complex (n//2+1 bins)."""
+    return jnp.fft.rfft(jnp.asarray(x), n=n, axis=axis)
+
+
+@op("irfft", "signal", differentiable=False)
+def irfft(x, n=None, axis=-1):
+    return jnp.fft.irfft(jnp.asarray(x), n=n, axis=axis)
+
+
+def _window(name: str, size: int, periodic: bool = True,
+            dtype=jnp.float32):
+    n = int(size)
+    if n < 1:
+        raise ValueError("window size must be >= 1")
+    denom = n if periodic else n - 1
+    if denom == 0:                      # size-1 symmetric window
+        return jnp.ones((1,), dtype)
+    k = np.arange(n)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / denom)
+    elif name == "hamming":
+        # ONNX HammingWindow coefficients: 25/46, 21/46
+        w = 25.0 / 46.0 - (21.0 / 46.0) * np.cos(2 * np.pi * k / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / denom)
+             + 0.08 * np.cos(4 * np.pi * k / denom))
+    else:  # pragma: no cover
+        raise ValueError(name)
+    return jnp.asarray(w, dtype)
+
+
+@op("hann_window", "signal", differentiable=False)
+def hann_window(size, periodic=True, dtype=jnp.float32):
+    return _window("hann", size, periodic, dtype)
+
+
+@op("hamming_window", "signal", differentiable=False)
+def hamming_window(size, periodic=True, dtype=jnp.float32):
+    return _window("hamming", size, periodic, dtype)
+
+
+@op("blackman_window", "signal", differentiable=False)
+def blackman_window(size, periodic=True, dtype=jnp.float32):
+    return _window("blackman", size, periodic, dtype)
+
+
+@op("stft", "signal", differentiable=False)
+def stft(signal, window=None, *, frame_length, frame_step, onesided=True):
+    """Short-time Fourier transform (ONNX STFT semantics).
+
+    signal: (B, T) real (a trailing size-1 dim is squeezed). Returns
+    complex (B, frames, bins) with bins = frame_length//2+1 when
+    ``onesided`` else frame_length. Frames are gathered as a strided view
+    (static shapes) and the FFT batches over them — one XLA fft call."""
+    x = jnp.asarray(signal)
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    if x.ndim == 1:
+        x = x[None, :]
+    fl, step = int(frame_length), int(frame_step)
+    b, t = x.shape
+    n_frames = 1 + (t - fl) // step
+    if n_frames < 1:
+        raise ValueError("signal shorter than one frame")
+    idx = (np.arange(n_frames)[:, None] * step
+           + np.arange(fl)[None, :])           # (frames, fl)
+    frames = x[:, idx]                          # (B, frames, fl)
+    if window is not None:
+        frames = frames * jnp.asarray(window, frames.dtype)
+    return jnp.fft.rfft(frames, axis=-1) if onesided \
+        else jnp.fft.fft(frames.astype(jnp.complex64), axis=-1)
+
+
+@op("complex_pack", "signal", differentiable=False)
+def complex_pack(x):
+    """(..., 2) real/imag pairs -> complex (the ONNX DFT tensor layout)."""
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1]).astype(jnp.complex64)
+
+
+@op("complex_unpack", "signal", differentiable=False)
+def complex_unpack(c):
+    """complex -> (..., 2) real/imag (the ONNX DFT tensor layout)."""
+    c = jnp.asarray(c)
+    return jnp.stack([jnp.real(c), jnp.imag(c)], axis=-1)

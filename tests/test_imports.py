@@ -161,7 +161,8 @@ class TestTFImport:
 def _onnx_tensor(name, arr):
     arr = np.asarray(arr)
     dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
-          np.dtype(np.int32): 6}[arr.dtype]
+          np.dtype(np.int32): 6, np.dtype(np.uint8): 2,
+          np.dtype(np.int8): 3, np.dtype(np.bool_): 9}[arr.dtype]
     return (pm.f_packed_ints(1, arr.shape) + pm.f_varint(2, dt)
             + pm.f_str(8, name) + pm.f_bytes(9, arr.tobytes()))
 

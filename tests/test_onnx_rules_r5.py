@@ -1,0 +1,639 @@
+"""Round-5 ONNX rule expansion tests: QDQ quantization, normalization
+tail, spatial samplers, signal ops, losses, random family, const-foldable
+dynamic ops.
+
+Goldens: torch exports where the exporter emits the op (GridSample,
+SoftmaxCrossEntropyLoss), protomini-authored graphs against numpy/torch
+functional references everywhere else (same strategy as the Scan test —
+no onnx package in the image, and torchvision is absent)."""
+
+import io
+import warnings
+
+import jax
+import numpy as np
+import pytest
+import torch
+
+warnings.filterwarnings("ignore")
+
+from deeplearning4j_tpu.imports import import_onnx  # noqa: E402
+
+from test_imports import (  # noqa: E402
+    _onnx_attr_f,
+    _onnx_attr_i,
+    _onnx_attr_ints,
+    _onnx_input,
+    _onnx_model,
+    _onnx_node,
+    _onnx_tensor,
+)
+from test_imports import _onnx_attr_s  # noqa: E402
+
+R = np.random.default_rng(9)
+
+
+def _export(model, args, input_names, output_names):
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda mb, co: mb
+    try:
+        buf = io.BytesIO()
+        torch.onnx.export(model, args, buf, input_names=input_names,
+                          output_names=output_names, dynamo=False)
+        return buf.getvalue()
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
+
+
+def _run(model_bytes, feeds, outs):
+    sd = import_onnx(model_bytes)
+    res = sd.output(feeds, outs)
+    return [np.asarray(res[o]) for o in outs]
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("align", [False, True])
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    def test_torch_golden(self, mode, align):
+        class G(torch.nn.Module):
+            def forward(self, x, g):
+                return torch.nn.functional.grid_sample(
+                    x, g, mode=mode, padding_mode="zeros",
+                    align_corners=align)
+
+        x = torch.randn(2, 3, 5, 6)
+        g = torch.rand(2, 4, 4, 2) * 2.2 - 1.1   # includes out-of-bounds
+        data = _export(G().eval(), (x, g), ["x", "g"], ["y"])
+        (y,) = _run(data, {"x": x.numpy(), "g": g.numpy()}, ["y"])
+        with torch.no_grad():
+            golden = G()(x, g).numpy()
+        np.testing.assert_allclose(y, golden, atol=1e-5, rtol=1e-4)
+
+    def test_border_padding(self):
+        class G(torch.nn.Module):
+            def forward(self, x, g):
+                return torch.nn.functional.grid_sample(
+                    x, g, padding_mode="border", align_corners=True)
+
+        x = torch.randn(1, 2, 4, 4)
+        g = torch.rand(1, 3, 3, 2) * 3.0 - 1.5
+        data = _export(G().eval(), (x, g), ["x", "g"], ["y"])
+        (y,) = _run(data, {"x": x.numpy(), "g": g.numpy()}, ["y"])
+        with torch.no_grad():
+            golden = G()(x, g).numpy()
+        np.testing.assert_allclose(y, golden, atol=1e-5, rtol=1e-4)
+
+
+class TestQuantization:
+    def test_qdq_roundtrip_per_tensor(self):
+        x = R.normal(size=(2, 8)).astype(np.float32) * 3
+        scale, zp = np.float32(0.05), np.uint8(128)
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("QuantizeLinear", ["x", "s", "z"], ["q"]),
+                _onnx_node("DequantizeLinear", ["q", "s", "z"], ["y"]),
+            ],
+            initializers=[_onnx_tensor("s", scale.reshape(())),
+                          _onnx_tensor("z", zp.reshape(()))],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["q", "y"],
+        )
+        q, y = _run(model, {"x": x}, ["q", "y"])
+        ref_q = np.clip(np.round(x / scale) + zp, 0, 255).astype(np.uint8)
+        np.testing.assert_array_equal(q, ref_q)
+        np.testing.assert_allclose(
+            y, (ref_q.astype(np.float32) - zp) * scale, atol=1e-6)
+
+    def test_per_axis_dequantize(self):
+        q = R.integers(0, 255, size=(3, 4)).astype(np.uint8)
+        scale = np.asarray([0.1, 0.2, 0.3], np.float32)
+        zp = np.asarray([0, 10, 20], np.uint8)
+        model = _onnx_model(
+            nodes=[_onnx_node("DequantizeLinear", ["q", "s", "z"], ["y"],
+                              _onnx_attr_i("axis", 0))],
+            initializers=[_onnx_tensor("s", scale), _onnx_tensor("z", zp)],
+            inputs=[_onnx_input("q", q.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"q": q}, ["y"])
+        ref = (q.astype(np.float32) - zp[:, None].astype(np.float32)) \
+            * scale[:, None]
+        np.testing.assert_allclose(y, ref, atol=1e-6)
+
+    def test_dynamic_quantize(self):
+        x = R.normal(size=(12,)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("DynamicQuantizeLinear", ["x"],
+                              ["y", "scale", "zp"])],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y", "scale", "zp"],
+        )
+        y, scale, zp = _run(model, {"x": x}, ["y", "scale", "zp"])
+        rmin = min(0.0, float(x.min()))
+        rmax = max(0.0, float(x.max()))
+        ref_scale = (rmax - rmin) / 255.0
+        ref_zp = np.clip(round(-rmin / ref_scale), 0, 255)
+        np.testing.assert_allclose(float(scale), ref_scale, rtol=1e-5)
+        assert int(zp) == int(ref_zp)
+        ref_y = np.clip(np.round(x / ref_scale) + ref_zp, 0,
+                        255).astype(np.uint8)
+        np.testing.assert_array_equal(y, ref_y)
+
+
+class TestNormalizationTail:
+    def test_group_norm_vs_torch(self):
+        x = R.normal(size=(2, 6, 4, 4)).astype(np.float32)
+        w = R.normal(size=(6,)).astype(np.float32)
+        b = R.normal(size=(6,)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("GroupNormalization", ["x", "w", "b"], ["y"],
+                              _onnx_attr_i("num_groups", 3),
+                              _onnx_attr_f("epsilon", 1e-5))],
+            initializers=[_onnx_tensor("w", w), _onnx_tensor("b", b)],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        with torch.no_grad():
+            golden = torch.nn.functional.group_norm(
+                torch.from_numpy(x), 3, torch.from_numpy(w),
+                torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(y, golden, atol=1e-5, rtol=1e-4)
+
+    def test_mvn(self):
+        x = R.normal(size=(2, 3, 4, 4)).astype(np.float32) * 5 + 2
+        model = _onnx_model(
+            nodes=[_onnx_node("MeanVarianceNormalization", ["x"], ["y"])],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        mean = x.mean(axis=(0, 2, 3), keepdims=True)
+        var = x.var(axis=(0, 2, 3), keepdims=True)
+        np.testing.assert_allclose(y, (x - mean) / np.sqrt(var + 1e-9),
+                                   atol=1e-5, rtol=1e-4)
+
+
+class TestScatterPool:
+    def test_scatter_elements_reductions(self):
+        x = np.zeros((3, 4), np.float32)
+        idx = np.asarray([[0, 1], [2, 0]], np.int64)
+        upd = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        for red, ref_fn in [
+            ("none", lambda: _scatter_ref(x, idx, upd, "none")),
+            ("add", lambda: _scatter_ref(x, idx, upd, "add")),
+        ]:
+            attrs = [_onnx_attr_i("axis", 1)]
+            if red != "none":
+                attrs.append(_onnx_attr_s("reduction", red))
+            model = _onnx_model(
+                nodes=[_onnx_node("ScatterElements", ["x", "i", "u"],
+                                  ["y"], *attrs)],
+                initializers=[_onnx_tensor("i", idx),
+                              _onnx_tensor("u", upd)],
+                inputs=[_onnx_input("x", x.shape)],
+                outputs=["y"],
+            )
+            (y,) = _run(model, {"x": x}, ["y"])
+            np.testing.assert_allclose(y, ref_fn())
+
+    def test_lp_pool_and_global(self):
+        x = R.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("LpPool", ["x"], ["y"],
+                              _onnx_attr_ints("kernel_shape", [2, 2]),
+                              _onnx_attr_ints("strides", [2, 2]),
+                              _onnx_attr_i("p", 2))],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = np.zeros((1, 2, 2, 2), np.float32)
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    blk = x[0, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    ref[0, c, i, j] = np.sqrt((blk ** 2).sum())
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+        gmodel = _onnx_model(
+            nodes=[_onnx_node("GlobalLpPool", ["x"], ["y"],
+                              _onnx_attr_i("p", 2))],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (gy,) = _run(gmodel, {"x": x}, ["y"])
+        gref = np.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True))
+        np.testing.assert_allclose(gy, gref, rtol=1e-5)
+
+    def test_upsample_nearest(self):
+        x = R.normal(size=(1, 2, 3, 3)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("Upsample", ["x", "s"], ["y"],
+                              _onnx_attr_s("mode", "nearest"))],
+            initializers=[_onnx_tensor(
+                "s", np.asarray([1.0, 1.0, 2.0, 2.0], np.float32))],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = x.repeat(2, axis=2).repeat(2, axis=3)
+        np.testing.assert_allclose(y, ref)
+
+    def test_max_unpool(self):
+        # MaxPool 2x2 on a 4x4, then MaxUnpool restores positions
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        vals = x[:, :, 1::2, 1::2]
+        idx = np.asarray([[[[5, 7], [13, 15]]]], np.int64)
+        model = _onnx_model(
+            nodes=[_onnx_node("MaxUnpool", ["v", "i"], ["y"],
+                              _onnx_attr_ints("kernel_shape", [2, 2]),
+                              _onnx_attr_ints("strides", [2, 2]))],
+            initializers=[_onnx_tensor("i", idx)],
+            inputs=[_onnx_input("v", vals.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"v": vals}, ["y"])
+        ref = np.zeros_like(x)
+        ref.reshape(-1)[idx.reshape(-1)] = vals.reshape(-1)
+        np.testing.assert_allclose(y, ref)
+
+
+def _scatter_ref(x, idx, upd, red):
+    out = x.copy()
+    for r in range(idx.shape[0]):
+        for c in range(idx.shape[1]):
+            if red == "add":
+                out[r, idx[r, c]] += upd[r, c]
+            else:
+                out[r, idx[r, c]] = upd[r, c]
+    return out
+
+
+class TestRoiAlign:
+    def test_vs_numpy_reference(self):
+        x = R.normal(size=(1, 2, 8, 8)).astype(np.float32)
+        rois = np.asarray([[1.0, 1.0, 6.0, 6.0], [0.0, 0.0, 7.0, 3.0]],
+                          np.float32)
+        bidx = np.zeros((2,), np.int64)
+        model = _onnx_model(
+            nodes=[_onnx_node(
+                "RoiAlign", ["x", "r", "b"], ["y"],
+                _onnx_attr_i("output_height", 2),
+                _onnx_attr_i("output_width", 2),
+                _onnx_attr_i("sampling_ratio", 2),
+                _onnx_attr_f("spatial_scale", 1.0),
+                _onnx_attr_s("coordinate_transformation_mode",
+                             "half_pixel"))],
+            initializers=[_onnx_tensor("r", rois),
+                          _onnx_tensor("b", bidx)],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = _roi_align_ref(x, rois, bidx, (2, 2), 2, 1.0, True)
+        np.testing.assert_allclose(y, ref, atol=1e-5, rtol=1e-4)
+
+
+def _bilinear_ref(img, py, px):
+    c, h, w = img.shape
+    y0, x0 = int(np.floor(py)), int(np.floor(px))
+    wy, wx = py - y0, px - x0
+    out = np.zeros(c, img.dtype)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy = min(max(y0 + dy, 0), h - 1)
+            xx = min(max(x0 + dx, 0), w - 1)
+            wgt = (wy if dy else 1 - wy) * (wx if dx else 1 - wx)
+            out += img[:, yy, xx] * wgt
+    return out
+
+
+def _roi_align_ref(x, rois, bidx, out_size, ratio, scale, aligned):
+    oh, ow = out_size
+    off = 0.5 if aligned else 0.0
+    k = rois.shape[0]
+    c = x.shape[1]
+    out = np.zeros((k, c, oh, ow), np.float32)
+    for r in range(k):
+        img = x[int(bidx[r])]
+        x1, y1, x2, y2 = rois[r] * scale - off
+        bh, bw = (y2 - y1) / oh, (x2 - x1) / ow
+        for i in range(oh):
+            for j in range(ow):
+                acc = np.zeros(c, np.float32)
+                for si in range(ratio):
+                    for sj in range(ratio):
+                        py = y1 + bh * (i + (si + 0.5) / ratio)
+                        px = x1 + bw * (j + (sj + 0.5) / ratio)
+                        acc += _bilinear_ref(img, py, px)
+                out[r, :, i, j] = acc / (ratio * ratio)
+    return out
+
+
+class TestSignal:
+    def test_windows(self):
+        for op_t, tfn in [("HannWindow", torch.hann_window),
+                          ("HammingWindow", None),
+                          ("BlackmanWindow", torch.blackman_window)]:
+            model = _onnx_model(
+                nodes=[_onnx_node(op_t, ["n"], ["w"])],
+                initializers=[_onnx_tensor("n",
+                                           np.asarray(16, np.int64))],
+                inputs=[],
+                outputs=["w"],
+            )
+            (w,) = _run(model, {}, ["w"])
+            assert w.shape == (16,)
+            if tfn is not None:
+                np.testing.assert_allclose(
+                    w, tfn(16, periodic=True).numpy(), atol=1e-5)
+            else:
+                # ONNX Hamming uses 25/46 coefficients
+                k = np.arange(16)
+                ref = 25 / 46 - (21 / 46) * np.cos(2 * np.pi * k / 16)
+                np.testing.assert_allclose(w, ref, atol=1e-6)
+
+    def test_dft_real_onesided_vs_numpy(self):
+        x = R.normal(size=(2, 16, 1)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("DFT", ["x"], ["y"],
+                              _onnx_attr_i("onesided", 1),
+                              _onnx_attr_i("axis", 1))],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = np.fft.rfft(x[..., 0], axis=1)
+        np.testing.assert_allclose(y[..., 0], ref.real, atol=1e-4)
+        np.testing.assert_allclose(y[..., 1], ref.imag, atol=1e-4)
+
+    def test_stft_vs_numpy(self):
+        sig = R.normal(size=(1, 32)).astype(np.float32)
+        win = np.hanning(8).astype(np.float32)  # symmetric window, any is fine
+        model = _onnx_model(
+            nodes=[_onnx_node("STFT", ["x", "st", "w"], ["y"],
+                              _onnx_attr_i("onesided", 1))],
+            initializers=[_onnx_tensor("st", np.asarray(4, np.int64)),
+                          _onnx_tensor("w", win)],
+            inputs=[_onnx_input("x", sig.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": sig}, ["y"])
+        frames = np.stack([sig[0, i * 4:i * 4 + 8] * win
+                           for i in range(7)])
+        ref = np.fft.rfft(frames, axis=-1)
+        np.testing.assert_allclose(y[0, ..., 0], ref.real, atol=1e-4)
+        np.testing.assert_allclose(y[0, ..., 1], ref.imag, atol=1e-4)
+
+
+class TestLosses:
+    def test_softmax_cross_entropy_loss_torch_export(self):
+        class M(torch.nn.Module):
+            def forward(self, x, t):
+                return torch.nn.functional.cross_entropy(x, t)
+
+        x = torch.randn(4, 5)
+        t = torch.tensor([0, 2, 4, 1])
+        data = _export(M().eval(), (x, t), ["x", "t"], ["loss"])
+        (loss,) = _run(data, {"x": x.numpy(), "t": t.numpy()}, ["loss"])
+        np.testing.assert_allclose(float(loss), float(M()(x, t)),
+                                   rtol=1e-5)
+
+    def test_nll_loss_weighted_mean(self):
+        lp = np.log(np.full((3, 4), 0.25, np.float32))
+        target = np.asarray([0, 1, 2], np.int64)
+        w = np.asarray([1.0, 2.0, 0.5, 1.0], np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("NegativeLogLikelihoodLoss",
+                              ["lp", "t", "w"], ["y"],
+                              _onnx_attr_s("reduction", "mean"))],
+            initializers=[_onnx_tensor("t", target),
+                          _onnx_tensor("w", w)],
+            inputs=[_onnx_input("lp", lp.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"lp": lp}, ["y"])
+        per = -lp[np.arange(3), target] * w[target]
+        np.testing.assert_allclose(float(y), per.sum() / w[target].sum(),
+                                   rtol=1e-5)
+
+
+class TestRandomFamily:
+    def test_random_normal_stats_and_determinism(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("RandomNormal", [], ["y"],
+                              _onnx_attr_ints("shape", [2000]),
+                              _onnx_attr_f("mean", 3.0),
+                              _onnx_attr_f("scale", 0.5))],
+            initializers=[],
+            inputs=[],
+            outputs=["y"],
+        )
+        (a,) = _run(model, {}, ["y"])
+        (b,) = _run(model, {}, ["y"])
+        np.testing.assert_array_equal(a, b)  # seeded: deterministic
+        assert abs(a.mean() - 3.0) < 0.1
+        assert abs(a.std() - 0.5) < 0.05
+
+    def test_random_uniform_range(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("RandomUniform", [], ["y"],
+                              _onnx_attr_ints("shape", [500]),
+                              _onnx_attr_f("low", -2.0),
+                              _onnx_attr_f("high", -1.0))],
+            initializers=[],
+            inputs=[],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {}, ["y"])
+        assert y.min() >= -2.0 and y.max() <= -1.0
+
+    def test_bernoulli_and_multinomial(self):
+        p = np.full((400,), 0.25, np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("Bernoulli", ["p"], ["y"])],
+            initializers=[_onnx_tensor("p", p)],
+            inputs=[],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {}, ["y"])
+        assert set(np.unique(y)) <= {0.0, 1.0}
+        assert 0.1 < y.mean() < 0.45
+        logits = np.log(np.asarray([[0.01, 0.01, 0.98]], np.float32))
+        mmodel = _onnx_model(
+            nodes=[_onnx_node("Multinomial", ["l"], ["s"],
+                              _onnx_attr_i("sample_size", 64))],
+            initializers=[_onnx_tensor("l", logits)],
+            inputs=[],
+            outputs=["s"],
+        )
+        (s,) = _run(mmodel, {}, ["s"])
+        assert s.shape == (1, 64)
+        assert (s == 2).mean() > 0.8
+
+
+class TestConstFoldableDynamics:
+    def test_compress_const_condition(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        cond = np.asarray([True, False, True])
+        model = _onnx_model(
+            nodes=[_onnx_node("Compress", ["x", "c"], ["y"],
+                              _onnx_attr_i("axis", 0))],
+            initializers=[_onnx_tensor("c", cond)],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        np.testing.assert_allclose(y, x[[0, 2]])
+
+    def test_nonzero_and_unique_const_fold(self):
+        v = np.asarray([[1, 0, 2], [0, 3, 0]], np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("NonZero", ["v"], ["y"])],
+            initializers=[_onnx_tensor("v", v)],
+            inputs=[],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {}, ["y"])
+        np.testing.assert_array_equal(y, np.stack(np.nonzero(v)))
+
+        u = np.asarray([3, 1, 3, 2, 1], np.float32)
+        umodel = _onnx_model(
+            nodes=[_onnx_node("Unique", ["u"], ["vals", "idx", "inv",
+                                               "counts"],
+                              _onnx_attr_i("sorted", 0))],
+            initializers=[_onnx_tensor("u", u)],
+            inputs=[],
+            outputs=["vals", "inv", "counts"],
+        )
+        vals, inv, counts = _run(umodel, {}, ["vals", "inv", "counts"])
+        np.testing.assert_allclose(vals, [3, 1, 2])  # first-occurrence order
+        np.testing.assert_array_equal(inv, [0, 1, 0, 2, 1])
+        np.testing.assert_array_equal(counts, [2, 2, 1])
+
+    def test_nonzero_runtime_input_rejected(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("NonZero", ["x"], ["y"])],
+            initializers=[],
+            inputs=[_onnx_input("x", (3,))],
+            outputs=["y"],
+        )
+        with pytest.raises(NotImplementedError):
+            import_onnx(model)
+
+
+class TestReviewRegressions:
+    """Round-5 review findings, each pinned by a test."""
+
+    def test_two_random_nodes_draw_independent_streams(self):
+        model = _onnx_model(
+            nodes=[
+                _onnx_node("RandomNormal", [], ["a"],
+                           _onnx_attr_ints("shape", [64])),
+                _onnx_node("RandomNormal", [], ["b"],
+                           _onnx_attr_ints("shape", [64])),
+            ],
+            initializers=[],
+            inputs=[],
+            outputs=["a", "b"],
+        )
+        a, b = _run(model, {}, ["a", "b"])
+        assert not np.allclose(a, b), "same-type random nodes correlated"
+
+    def test_pool_default_strides_are_one(self):
+        # spec: missing strides = 1 per axis (NOT kernel_shape)
+        x = R.normal(size=(1, 1, 4, 4)).astype(np.float32)
+        for op_t in ("MaxPool", "LpPool"):
+            model = _onnx_model(
+                nodes=[_onnx_node(op_t, ["x"], ["y"],
+                                  _onnx_attr_ints("kernel_shape", [2, 2]))],
+                initializers=[],
+                inputs=[_onnx_input("x", x.shape)],
+                outputs=["y"],
+            )
+            (y,) = _run(model, {"x": x}, ["y"])
+            assert y.shape == (1, 1, 3, 3), (op_t, y.shape)
+        ref = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[0, 0, i, j] = x[0, 0, i:i + 2, j:j + 2].max()
+        model = _onnx_model(
+            nodes=[_onnx_node("MaxPool", ["x"], ["y"],
+                              _onnx_attr_ints("kernel_shape", [2, 2]))],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        np.testing.assert_allclose(y, ref)
+
+    def test_upsample_fractional_scale_rejected(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("Upsample", ["x", "s"], ["y"],
+                              _onnx_attr_s("mode", "nearest"))],
+            initializers=[_onnx_tensor(
+                "s", np.asarray([1.0, 1.0, 1.5, 1.5], np.float32))],
+            inputs=[_onnx_input("x", (1, 1, 4, 4))],
+            outputs=["y"],
+        )
+        with pytest.raises(NotImplementedError, match="non-integer"):
+            import_onnx(model)
+
+    def test_dft_negative_axis(self):
+        x = R.normal(size=(2, 16, 1)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("DFT", ["x"], ["y"],
+                              _onnx_attr_i("onesided", 1),
+                              _onnx_attr_i("axis", -2))],
+            initializers=[],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = np.fft.rfft(x[..., 0], axis=1)
+        np.testing.assert_allclose(y[..., 0], ref.real, atol=1e-4)
+
+    def test_roi_align_legacy_no_ctm_attr(self):
+        # pre-opset-16 node (no coordinate_transformation_mode): legacy
+        # output_half_pixel semantics, i.e. NO -0.5 offset
+        x = R.normal(size=(1, 1, 8, 8)).astype(np.float32)
+        rois = np.asarray([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        bidx = np.zeros((1,), np.int64)
+        model = _onnx_model(
+            nodes=[_onnx_node(
+                "RoiAlign", ["x", "r", "b"], ["y"],
+                _onnx_attr_i("output_height", 2),
+                _onnx_attr_i("output_width", 2),
+                _onnx_attr_i("sampling_ratio", 2))],
+            initializers=[_onnx_tensor("r", rois),
+                          _onnx_tensor("b", bidx)],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = _roi_align_ref(x, rois, bidx, (2, 2), 2, 1.0, False)
+        np.testing.assert_allclose(y, ref, atol=1e-5, rtol=1e-4)
+
+
+class TestCenterCropPad:
+    def test_crop_and_pad(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        model = _onnx_model(
+            nodes=[_onnx_node("CenterCropPad", ["x", "t"], ["y"])],
+            initializers=[_onnx_tensor(
+                "t", np.asarray([2, 8], np.int64))],
+            inputs=[_onnx_input("x", x.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"x": x}, ["y"])
+        ref = np.zeros((2, 8), np.float32)
+        ref[:, 1:7] = x[1:3]
+        np.testing.assert_allclose(y, ref)

@@ -383,6 +383,23 @@ OVERRIDES = {
     "fake_quant_with_min_max_vars_per_channel": lambda f: f(
         XN, -jnp.ones(6), jnp.ones(6)),
     "compare_and_bitpack": lambda f: f(XN.reshape(3, 8), 0.0),
+    # round-5: signal / sampler / loss ops backing the ONNX rule expansion
+    "hann_window": lambda f: f(8),
+    "hamming_window": lambda f: f(8),
+    "blackman_window": lambda f: f(8),
+    "stft": lambda f: f(jnp.ones((1, 32)), frame_length=8, frame_step=4),
+    "complex_pack": lambda f: f(jnp.ones((3, 2))),
+    "grid_sample": lambda f: f(jnp.ones((1, 2, 4, 4)),
+                               jnp.zeros((1, 2, 2, 2))),
+    "roi_align": lambda f: f(jnp.ones((1, 2, 8, 8)),
+                             jnp.asarray([[0.0, 0.0, 4.0, 4.0]]),
+                             jnp.asarray([0]), output_size=(2, 2)),
+    "put_along_axis": lambda f: f(XN, jnp.zeros((1, 6), jnp.int32),
+                                  jnp.ones((1, 6))),
+    "nll_loss": lambda f: f(jax.nn.log_softmax(XN), IDX[:4] % 6),
+    "max_unpool2d": lambda f: f(jnp.ones((1, 1, 2, 2)),
+                                jnp.asarray([[[[0, 3], [8, 11]]]]),
+                                (1, 1, 4, 4)),
     # round-5 tail: linalg
     "lup": lambda f: f(SQ),
     "matrix_set_diag": lambda f: f(SQ, jnp.asarray([5.0, 6.0])),
