@@ -1664,7 +1664,20 @@ def _o_dft(m, node):
     if node.attr("inverse", 0):
         raise NotImplementedError("inverse DFT")
     onesided = bool(node.attr("onesided", 0))
-    axis = node.attr("axis", 1)
+    rank = len(x.shape) if x.shape is not None else None
+    if m.has_input(node, 2):
+        # opset-20 form: axis is INPUT 2
+        axis = int(np.asarray(m.const(node.inputs[2])).reshape(-1)[0])
+    elif node.attr("axis") is not None:
+        axis = node.attr("axis")        # opset-17 attr form
+    elif rank == 3:
+        axis = 1                        # defaults coincide: 1 == -2 at rank 3
+    else:
+        # opset-17 default (1) and opset-20 default (-2) differ here and
+        # the node alone does not reveal its opset
+        raise NotImplementedError(
+            "DFT without an explicit axis on rank != 3 input is "
+            "opset-ambiguous")
     if m.has_input(node, 1) and node.inputs[1]:
         raise NotImplementedError("DFT with explicit dft_length")
     shp = x.shape
@@ -1834,7 +1847,8 @@ def _o_max_unpool(m, node):
         out_shape = tuple(int(v) for v in m.const(node.inputs[2]))
     else:
         k = node.attr("kernel_shape")
-        strides = node.attr("strides", list(k))
+        # spec: strides default to 1 per axis (NOT kernel_shape)
+        strides = node.attr("strides", [1] * len(k))
         pads = node.attr("pads", [0] * (2 * len(k)))
         spatial = [
             (shp[2 + i] - 1) * strides[i] - pads[i] - pads[len(k) + i]
@@ -1865,12 +1879,15 @@ def _o_seed_key(m, node, tag):
 def _o_random_normal(m, node):
     if node.op_type == "RandomNormal":
         shape = tuple(node.attr("shape"))
+        ref_dt = np.float32
     else:
-        shp = m.get(node.inputs[0]).shape
+        like = m.get(node.inputs[0])
+        shp = like.shape
         if shp is None or any(s is None or s < 0 for s in shp):
             raise NotImplementedError("RandomNormalLike needs static shape")
         shape = tuple(shp)
-    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+        ref_dt = like.dtype or np.float32  # spec: inherit input dtype
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else ref_dt
     key = _o_seed_key(m, node, "normal")
     m.set(node.outputs[0], m.sd._op(
         "random_normal", [key],
@@ -1883,12 +1900,15 @@ def _o_random_normal(m, node):
 def _o_random_uniform(m, node):
     if node.op_type == "RandomUniform":
         shape = tuple(node.attr("shape"))
+        ref_dt = np.float32
     else:
-        shp = m.get(node.inputs[0]).shape
+        like = m.get(node.inputs[0])
+        shp = like.shape
         if shp is None or any(s is None or s < 0 for s in shp):
             raise NotImplementedError("RandomUniformLike needs static shape")
         shape = tuple(shp)
-    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+        ref_dt = like.dtype or np.float32  # spec: inherit input dtype
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else ref_dt
     key = _o_seed_key(m, node, "uniform")
     m.set(node.outputs[0], m.sd._op(
         "random_uniform", [key],
@@ -1903,7 +1923,8 @@ def _o_bernoulli(m, node):
     shp = x.shape
     if shp is None or any(s is None or s < 0 for s in shp):
         raise NotImplementedError("Bernoulli needs static shape")
-    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else np.float32
+    ref_dt = x.dtype or np.float32  # spec: inherit input dtype
+    dt = _DTYPES[node.attr("dtype")] if node.attr("dtype") else ref_dt
     key = _o_seed_key(m, node, "bernoulli")
     m.set(node.outputs[0], m.sd._op(
         "random_bernoulli", [key, None, x],

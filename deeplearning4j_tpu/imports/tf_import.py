@@ -1742,3 +1742,560 @@ def _sparse_softmax_ce_grad(m, node):
         n_out=2, name=node.name)
     m.set(node.name, loss, slot=0)
     m.set(node.name, backprop, slot=1)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 rules: linalg tail, image tail, 3-D conv/pool, bitwise, FFT,
+# fake-quant, random family, scatter tail, misc.
+# ---------------------------------------------------------------------------
+
+@rule("Betainc")
+def _tf_betainc(m, node):
+    a, b, x = (m.get(i) for i in m.inputs(node)[:3])
+    m.set(node.name, m.sd._op("betainc", [a, b, x], name=node.name))
+
+
+@rule("Polygamma")
+def _tf_polygamma(m, node):
+    n, x = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("polygamma", [n, x], name=node.name))
+
+
+@rule("Zeta")
+def _tf_zeta(m, node):
+    x, q = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("zeta", [x, q], name=node.name))
+
+
+@rule("SelfAdjointEigV2")
+def _tf_eigh(m, node):
+    if not _attr_or(node, "compute_v", "b", True):
+        raise UnsupportedOpError("SelfAdjointEigV2 compute_v=False")
+    e, v = m.sd._op("eigh", [m.get(m.inputs(node)[0])], n_out=2,
+                    name=node.name)
+    m.set(node.name, e, slot=0)
+    m.set(node.name, v, slot=1)
+
+
+@rule("Svd")
+def _tf_svd(m, node):
+    # TF output order (s, u, v) with v — not the vh our op returns
+    if not _attr_or(node, "compute_uv", "b", True):
+        s = m.sd._op("svd", [m.get(m.inputs(node)[0])],
+                     attrs=dict(compute_uv=False), name=node.name)
+        m.set(node.name, s, slot=0)
+        return
+    full = bool(_attr_or(node, "full_matrices", "b", False))
+    u, s, vh = m.sd._op("svd", [m.get(m.inputs(node)[0])],
+                        attrs=dict(full_matrices=full), n_out=3,
+                        name=node.name)
+    m.set(node.name, s, slot=0)
+    m.set(node.name, u, slot=1)
+    m.set(node.name, m.sd._op("swapaxes", [vh],
+                              attrs=dict(axis1=-2, axis2=-1)), slot=2)
+
+
+@rule("Qr")
+def _tf_qr(m, node):
+    if _attr_or(node, "full_matrices", "b", False):
+        raise UnsupportedOpError("Qr full_matrices")
+    q, r = m.sd._op("qr", [m.get(m.inputs(node)[0])], n_out=2,
+                    name=node.name)
+    m.set(node.name, q, slot=0)
+    m.set(node.name, r, slot=1)
+
+
+@rule("Cholesky")
+def _tf_cholesky(m, node):
+    m.set(node.name, m.sd._op("cholesky", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("MatrixInverse")
+def _tf_matrix_inverse(m, node):
+    if _attr_or(node, "adjoint", "b", False):
+        raise UnsupportedOpError("MatrixInverse adjoint")
+    m.set(node.name, m.sd._op("matrix_inverse",
+                              [m.get(m.inputs(node)[0])], name=node.name))
+
+
+@rule("MatrixSolve")
+def _tf_matrix_solve(m, node):
+    if _attr_or(node, "adjoint", "b", False):
+        raise UnsupportedOpError("MatrixSolve adjoint")
+    a, b = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("solve", [a, b], name=node.name))
+
+
+@rule("MatrixTriangularSolve")
+def _tf_tri_solve(m, node):
+    if _attr_or(node, "adjoint", "b", False):
+        raise UnsupportedOpError("MatrixTriangularSolve adjoint")
+    a, b = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op(
+        "triangular_solve", [a, b],
+        attrs=dict(lower=bool(_attr_or(node, "lower", "b", True))),
+        name=node.name))
+
+
+@rule("Lu")
+def _tf_lu(m, node):
+    lu_p, _, perm = m.sd._op("lu", [m.get(m.inputs(node)[0])], n_out=3,
+                             name=node.name)
+    m.set(node.name, lu_p, slot=0)
+    m.set(node.name, perm, slot=1)
+
+
+@rule("Cross")
+def _tf_cross(m, node):
+    a, b = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("cross", [a, b], name=node.name))
+
+
+@rule("Diag")
+def _tf_diag(m, node):
+    # TF Diag of a rank-1 input = matrix_diag; higher ranks unsupported
+    x = m.get(m.inputs(node)[0])
+    if x.shape is not None and len(x.shape) != 1:
+        raise UnsupportedOpError("Diag of rank > 1")
+    m.set(node.name, m.sd._op("matrix_diag", [x], name=node.name))
+
+
+@rule("DiagPart", "MatrixDiagPartV3")
+def _tf_diag_part(m, node):
+    if node.op == "MatrixDiagPartV3":
+        k = m.const(m.inputs(node)[1])
+        if np.any(np.asarray(k) != 0):
+            raise UnsupportedOpError("MatrixDiagPartV3 k != 0")
+    m.set(node.name, m.sd._op("matrix_diag_part",
+                              [m.get(m.inputs(node)[0])], name=node.name))
+
+
+@rule("MatrixDiagV3")
+def _tf_matrix_diag_v3(m, node):
+    k = m.const(m.inputs(node)[1])
+    if np.any(np.asarray(k) != 0):
+        raise UnsupportedOpError("MatrixDiagV3 k != 0")
+    m.set(node.name, m.sd._op("matrix_diag", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("MatrixSetDiagV3")
+def _tf_matrix_set_diag(m, node):
+    k = m.const(m.inputs(node)[2])
+    if np.any(np.asarray(k) != 0):
+        raise UnsupportedOpError("MatrixSetDiagV3 k != 0")
+    x, d = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("matrix_set_diag", [x, d], name=node.name))
+
+
+@rule("Trace")
+def _tf_trace(m, node):
+    m.set(node.name, m.sd._op("trace", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("L2Loss")
+def _tf_l2_loss(m, node):
+    m.set(node.name, m.sd._op("l2_loss", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("InTopKV2")
+def _tf_in_top_k(m, node):
+    preds, targets = (m.get(i) for i in m.inputs(node)[:2])
+    k = int(m.const(m.inputs(node)[2]))
+    m.set(node.name, m.sd._op("in_top_k", [preds, targets],
+                              attrs=dict(k=k), name=node.name))
+
+
+@rule("HistogramFixedWidth")
+def _tf_histogram(m, node):
+    x = m.get(m.inputs(node)[0])
+    vr = [float(v) for v in m.const(m.inputs(node)[1])]
+    nbins = int(m.const(m.inputs(node)[2]))
+    m.set(node.name, m.sd._op(
+        "histogram_fixed_width", [x],
+        attrs=dict(value_range=tuple(vr), nbins=nbins), name=node.name))
+
+
+@rule("SegmentMax", "SegmentMin", "SegmentProd")
+def _tf_segment_extra(m, node):
+    data, seg = (m.get(i) for i in m.inputs(node)[:2])
+    seg_val = m.const(m.inputs(node)[1])
+    ns = int(np.asarray(seg_val).max()) + 1
+    opn = {"SegmentMax": "segment_max", "SegmentMin": "segment_min",
+           "SegmentProd": "segment_prod"}[node.op]
+    m.set(node.name, m.sd._op(opn, [data, seg],
+                              attrs=dict(num_segments=ns), name=node.name))
+
+
+@rule("TensorScatterAdd")
+def _tf_tensor_scatter_add(m, node):
+    t, idx, upd = (m.get(i) for i in m.inputs(node)[:3])
+    m.set(node.name, m.sd._op("scatter_nd_add", [t, idx, upd],
+                              name=node.name))
+
+
+@rule("Bitcast")
+def _tf_bitcast(m, node):
+    dt = _tf_dtype(node.attr["type"].type)
+    m.set(node.name, m.sd._op("bitcast", [m.get(m.inputs(node)[0])],
+                              attrs=dict(dtype=dt), name=node.name))
+
+
+@rule("BroadcastArgs")
+def _tf_broadcast_args(m, node):
+    a = m.const(m.inputs(node)[0])
+    b = m.const(m.inputs(node)[1])
+    out = np.broadcast_shapes(tuple(int(v) for v in a),
+                              tuple(int(v) for v in b))
+    arr = np.asarray(out, np.int32)
+    m.set(node.name, m.sd.constant(arr, name=node.name), const_val=arr)
+
+
+@rule("DataFormatVecPermute")
+def _tf_df_vec_permute(m, node):
+    src = _attr_or(node, "src_format", "s", b"NHWC").decode()
+    dst = _attr_or(node, "dst_format", "s", b"NCHW").decode()
+    val = np.asarray(m.const(m.inputs(node)[0]))
+    if val.shape[0] == 2:
+        # TF size-2 form: spatial dims only — strip N and C from formats
+        src = "".join(c for c in src if c not in "NC")
+        dst = "".join(c for c in dst if c not in "NC")
+    perm = [src.index(c) for c in dst]
+    out = val[perm]
+    m.set(node.name, m.sd.constant(out, name=node.name), const_val=out)
+
+
+@rule("EnsureShape")
+def _tf_ensure_shape(m, node):
+    # static shapes by construction: verify now, then identity
+    x = m.get(m.inputs(node)[0])
+    want = tuple(d.size for d in node.attr["shape"].shape.dim) \
+        if "shape" in node.attr else None
+    if want is not None and x.shape is not None:
+        for got, exp in zip(x.shape, want):
+            if exp >= 0 and got is not None and got >= 0 and got != exp:
+                raise UnsupportedOpError(
+                    f"EnsureShape mismatch: {x.shape} vs {want}")
+    m.set(node.name, m.sd._op("identity", [x], name=node.name))
+
+
+# -- image tail --------------------------------------------------------------
+
+@rule("RGBToHSV")
+def _tf_rgb_to_hsv(m, node):
+    m.set(node.name, m.sd._op("rgb_to_hsv", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("HSVToRGB")
+def _tf_hsv_to_rgb(m, node):
+    m.set(node.name, m.sd._op("hsv_to_rgb", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("AdjustHue")
+def _tf_adjust_hue(m, node):
+    x = m.get(m.inputs(node)[0])
+    delta = float(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("adjust_hue", [x], attrs=dict(delta=delta),
+                              name=node.name))
+
+
+@rule("AdjustSaturation")
+def _tf_adjust_saturation(m, node):
+    x = m.get(m.inputs(node)[0])
+    factor = float(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("adjust_saturation", [x],
+                              attrs=dict(factor=factor), name=node.name))
+
+
+@rule("AdjustContrastv2")
+def _tf_adjust_contrast(m, node):
+    x = m.get(m.inputs(node)[0])
+    factor = float(m.const(m.inputs(node)[1]))
+    m.set(node.name, m.sd._op("adjust_contrast", [x],
+                              attrs=dict(factor=factor), name=node.name))
+
+
+@rule("CropAndResize")
+def _tf_crop_and_resize(m, node):
+    img, boxes, bidx = (m.get(i) for i in m.inputs(node)[:3])
+    crop_size = tuple(int(v) for v in m.const(m.inputs(node)[3]))
+    method = _attr_or(node, "method", "s", b"bilinear").decode()
+    if float(_attr_or(node, "extrapolation_value", "f", 0.0)) != 0.0:
+        raise UnsupportedOpError("CropAndResize extrapolation_value != 0")
+    m.set(node.name, m.sd._op(
+        "crop_and_resize", [img, boxes, bidx],
+        attrs=dict(crop_size=crop_size, method=method), name=node.name))
+
+
+@rule("Dilation2D")
+def _tf_dilation2d(m, node):
+    x, f = (m.get(i) for i in m.inputs(node)[:2])
+    strides = list(node.attr["strides"].list.i)
+    rates = list(node.attr["rates"].list.i)
+    padding = node.attr["padding"].s.decode()
+    m.set(node.name, m.sd._op(
+        "dilation2d", [x, f],
+        attrs=dict(strides=(strides[1], strides[2]),
+                   rates=(rates[1], rates[2]), padding=padding),
+        name=node.name))
+
+
+@rule("NonMaxSuppressionV3", "NonMaxSuppressionV4", "NonMaxSuppressionV5")
+def _tf_nms(m, node):
+    ins = m.inputs(node)
+    boxes, scores = m.get(ins[0]), m.get(ins[1])
+    max_out = int(m.const(ins[2]))
+    iou = float(m.const(ins[3]))
+    score_th = float(m.const(ins[4])) if len(ins) > 4 else float("-inf")
+    if node.op == "NonMaxSuppressionV5" and len(ins) > 5 \
+            and float(m.const(ins[5])) != 0.0:
+        raise UnsupportedOpError("soft-NMS sigma != 0")
+    if node.op == "NonMaxSuppressionV4" \
+            and _attr_or(node, "pad_to_max_output_size", "b", False):
+        raise UnsupportedOpError("NMS pad_to_max_output_size")
+    sel = m.sd._op("non_max_suppression", [boxes, scores],
+                   attrs=dict(max_output_size=max_out, iou_threshold=iou,
+                              score_threshold=score_th), name=node.name)
+    m.set(node.name, sel, slot=0)
+    # valid_outputs = count of non-pad entries (our op pads with -1);
+    # V4 emits it at slot 1, V5 at slot 2 (after selected_scores)
+    valid = m.sd._op("cast", [m.sd._op("sum", [m.sd._op("cast", [
+        m.sd._op("greaterequal", [sel, 0])], attrs=dict(dtype=np.int32))])],
+        attrs=dict(dtype=np.int32))
+    if node.op == "NonMaxSuppressionV4":
+        m.set(node.name, valid, slot=1)
+    elif node.op == "NonMaxSuppressionV5":
+        m.set(node.name, m.sd._op("gather", [scores, sel],
+                                  attrs=dict(axis=0)), slot=1)
+        m.set(node.name, valid, slot=2)
+
+
+# -- 3-D conv/pool (NDHWC — TF's native 3-D layout) --------------------------
+
+@rule("Conv3D")
+def _tf_conv3d(m, node):
+    df = _attr_or(node, "data_format", "s", b"NDHWC").decode()
+    if df != "NDHWC":
+        raise UnsupportedOpError(f"Conv3D data_format {df}")
+    x, w = (m.get(i) for i in m.inputs(node)[:2])
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    m.set(node.name, m.sd._op(
+        "conv3d", [x, w],
+        attrs=dict(strides=tuple(strides[1:4]), padding=padding),
+        name=node.name))
+
+
+@rule("MaxPool3D", "AvgPool3D")
+def _tf_pool3d(m, node):
+    df = _attr_or(node, "data_format", "s", b"NDHWC").decode()
+    if df != "NDHWC":
+        raise UnsupportedOpError(f"{node.op} data_format {df}")
+    x = m.get(m.inputs(node)[0])
+    ksize = list(node.attr["ksize"].list.i)
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    opn = "maxpool3d" if node.op == "MaxPool3D" else "avgpool3d"
+    m.set(node.name, m.sd._op(
+        opn, [x], attrs=dict(kernel=tuple(ksize[1:4]),
+                             strides=tuple(strides[1:4]),
+                             padding=padding), name=node.name))
+
+
+# -- bitwise -----------------------------------------------------------------
+
+@rule("LeftShift")
+def _tf_left_shift(m, node):
+    a, b = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("shift_left", [a, b], name=node.name))
+
+
+@rule("RightShift")
+def _tf_right_shift(m, node):
+    a, b = (m.get(i) for i in m.inputs(node)[:2])
+    m.set(node.name, m.sd._op("shift_right", [a, b], name=node.name))
+
+
+@rule("Invert")
+def _tf_invert(m, node):
+    m.set(node.name, m.sd._op("toggle_bits", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+@rule("PopulationCount")
+def _tf_popcount(m, node):
+    # TF outputs uint8; int32 here feeds the same consumers (Cast follows)
+    m.set(node.name, m.sd._op("popcount", [m.get(m.inputs(node)[0])],
+                              name=node.name))
+
+
+# -- FFT (TF complex tensors are native complex64 in JAX) --------------------
+
+@rule("FFT", "IFFT", "RFFT", "IRFFT")
+def _tf_fft(m, node):
+    x = m.get(m.inputs(node)[0])
+    opn = {"FFT": "fft", "IFFT": "ifft", "RFFT": "rfft",
+           "IRFFT": "irfft"}[node.op]
+    attrs = dict(axis=-1)
+    if node.op in ("RFFT", "IRFFT"):
+        fft_length = int(np.asarray(m.const(m.inputs(node)[1])).reshape(-1)[0])
+        attrs["n"] = fft_length
+    m.set(node.name, m.sd._op(opn, [x], attrs=attrs, name=node.name))
+
+
+# -- fake quantization -------------------------------------------------------
+
+@rule("FakeQuantWithMinMaxArgs")
+def _tf_fake_quant_args(m, node):
+    x = m.get(m.inputs(node)[0])
+    m.set(node.name, m.sd._op(
+        "fake_quant_with_min_max_vars", [x],
+        attrs=dict(min=float(_attr_or(node, "min", "f", -6.0)),
+                   max=float(_attr_or(node, "max", "f", 6.0)),
+                   num_bits=int(_attr_or(node, "num_bits", "i", 8)),
+                   narrow_range=bool(_attr_or(node, "narrow_range", "b",
+                                              False))),
+        name=node.name))
+
+
+@rule("FakeQuantWithMinMaxVars", "FakeQuantWithMinMaxVarsPerChannel")
+def _tf_fake_quant_vars(m, node):
+    # min/max are tensors (constants in frozen graphs) — pass them as graph
+    # INPUTS, not attrs: arrays in attrs would break save/load
+    x, mn, mx = (m.get(i) for i in m.inputs(node)[:3])
+    opn = "fake_quant_with_min_max_vars" if node.op.endswith("Vars") \
+        else "fake_quant_with_min_max_vars_per_channel"
+    m.set(node.name, m.sd._op(
+        opn, [x, mn, mx],
+        attrs=dict(num_bits=int(_attr_or(node, "num_bits", "i", 8)),
+                   narrow_range=bool(_attr_or(node, "narrow_range", "b",
+                                              False))),
+        name=node.name))
+
+
+# -- random family -----------------------------------------------------------
+
+def _tf_seed_key(m, node, tag):
+    import zlib
+
+    import jax as _jax
+
+    s1 = int(_attr_or(node, "seed", "i", 0))
+    s2 = int(_attr_or(node, "seed2", "i", 0))
+    mix = zlib.crc32(f"{tag}:{node.name}".encode()) & 0x7FFFFFFF
+    key = np.asarray(_jax.random.PRNGKey((s1 * 2654435761 + s2) % (2**31)
+                                         ^ mix))
+    return m.sd.constant(key, name=f"{node.name}__key")
+
+
+@rule("RandomStandardNormal", "RandomUniform")
+def _tf_random(m, node):
+    shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    dt = _tf_dtype(node.attr["dtype"].type)
+    key = _tf_seed_key(m, node, node.op)
+    opn = "random_normal" if node.op == "RandomStandardNormal" \
+        else "random_uniform"
+    m.set(node.name, m.sd._op(opn, [key],
+                              attrs=dict(shape=shape, dtype=dt),
+                              name=node.name))
+
+
+def _stateless_emit(m, node, shape, seed):
+    """Shared stateless-random lowering: seed vector -> PRNGKey constant ->
+    registry random op (one recipe for V1/V2 — keep them in lockstep)."""
+    import jax as _jax
+
+    seed = np.asarray(seed).reshape(-1)
+    key = m.sd.constant(
+        np.asarray(_jax.random.PRNGKey(int(seed[0]) % (2**31)
+                                       ^ (int(seed[-1]) % (2**31)))),
+        name=f"{node.name}__key")
+    dt = _tf_dtype(node.attr["dtype"].type)
+    opn = "random_normal" if "Normal" in node.op else "random_uniform"
+    m.set(node.name, m.sd._op(opn, [key],
+                              attrs=dict(shape=shape, dtype=dt),
+                              name=node.name))
+
+
+@rule("StatelessRandomNormal", "StatelessRandomUniform")
+def _tf_stateless_random(m, node):
+    shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    _stateless_emit(m, node, shape, m.const(m.inputs(node)[1]))
+
+
+@rule("Multinomial")
+def _tf_multinomial(m, node):
+    logits = m.get(m.inputs(node)[0])
+    num = int(m.const(m.inputs(node)[1]))
+    key = _tf_seed_key(m, node, "multinomial")
+    samples = m.sd._op("random_categorical", [key, logits],
+                       attrs=dict(num_samples=num))
+    dt = _tf_dtype(node.attr["output_dtype"].type) \
+        if "output_dtype" in node.attr else np.int64
+    m.set(node.name, m.sd._op("cast", [samples],
+                              attrs=dict(dtype=dt), name=node.name))
+
+
+@rule("UniqueV2")
+def _tf_unique_v2(m, node):
+    # output length is data-dependent: const-fold only (XLA-static rule)
+    val = np.asarray(m.const(m.inputs(node)[0]))
+    axis = np.asarray(m.const(m.inputs(node)[1])).reshape(-1)
+    if axis.size and int(axis[0]) != 0:
+        raise UnsupportedOpError("UniqueV2 axis != 0")
+    uniq, first_idx, inverse = np.unique(val, return_index=True,
+                                         return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty_like(order)
+    remap[order] = np.arange(order.size)
+    uniq = uniq[order]
+    inverse = remap[inverse]
+    m.set(node.name, m.sd.constant(uniq, name=node.name), slot=0,
+          const_val=uniq)
+    inv = inverse.astype(np.int32)
+    m.set(node.name, m.sd.constant(inv, name=f"{node.name}_idx"), slot=1,
+          const_val=inv)
+
+
+@rule("SparseTensorDenseMatMul")
+def _tf_sparse_dense_matmul(m, node):
+    if _attr_or(node, "adjoint_a", "b", False) \
+            or _attr_or(node, "adjoint_b", "b", False):
+        raise UnsupportedOpError("SparseTensorDenseMatMul adjoint")
+    ins = m.inputs(node)
+    a_idx, a_vals = m.get(ins[0]), m.get(ins[1])
+    a_shape = tuple(int(v) for v in m.const(ins[2]))
+    b = m.get(ins[3])
+    dense_a = m.sd._op("scatter_nd", [a_idx, a_vals],
+                       attrs=dict(shape=a_shape))
+    m.set(node.name, m.sd._op("matmul", [dense_a, b], name=node.name))
+
+
+@rule("StatelessRandomGetKeyCounter", "StatelessRandomGetAlg")
+def _tf_stateless_key_counter(m, node):
+    # V2 stateless-random plumbing: fold the seed through — the V2 sampling
+    # rule below derives its PRNGKey from this folded value
+    if node.op == "StatelessRandomGetAlg":
+        alg = np.asarray(1, np.int32)
+        m.set(node.name, m.sd.constant(alg, name=node.name), const_val=alg)
+        return
+    seed = np.asarray(m.const(m.inputs(node)[0])).reshape(-1)
+    key = seed.astype(np.int64)
+    counter = np.zeros(2, np.int64)
+    m.set(node.name, m.sd.constant(key, name=node.name), slot=0,
+          const_val=key)
+    m.set(node.name, m.sd.constant(counter, name=f"{node.name}_ctr"),
+          slot=1, const_val=counter)
+
+
+@rule("StatelessRandomNormalV2", "StatelessRandomUniformV2")
+def _tf_stateless_random_v2(m, node):
+    shape = tuple(int(v) for v in m.const(m.inputs(node)[0]))
+    # input 1 is the folded key from StatelessRandomGetKeyCounter (the
+    # original user seed, passed through by that rule)
+    _stateless_emit(m, node, shape, m.const(m.inputs(node)[1]))

@@ -410,15 +410,21 @@ def _fake_quant(x, qmin, qmax, minv, maxv):
     nudged range, zero outside (TF's FakeQuantWithMinMaxVarsGradient)."""
     scale = (maxv - minv) / (qmax - qmin)
     scale = jnp.where(scale == 0, 1e-8, scale)
+    # same fp32 expression as TF's Nudge() — for SYMMETRIC ranges the true
+    # zero point is exactly .5 and fp32 rounding decides the side; TF's own
+    # Args and Vars kernels disagree with each other there (measured:
+    # (-4,4)->127, (-3,3)->128), so one quantum of ambiguity at that
+    # boundary is inherent and the tests allow it
     zero_f = qmin - minv / scale
-    nudged_zero = jnp.clip(jnp.round(zero_f), qmin, qmax)
+    nudged_zero = jnp.clip(jnp.floor(zero_f + 0.5), qmin, qmax)
     nmin = (qmin - nudged_zero) * scale
     nmax = (qmax - nudged_zero) * scale
 
     @jax.custom_vjp
     def q(x):
         clamped = jnp.clip(x, nmin, nmax)
-        return jnp.round((clamped - nmin) / scale) * scale + nmin
+        # floor(v + 0.5), matching the TF kernel — NOT round-half-to-even
+        return jnp.floor((clamped - nmin) / scale + 0.5) * scale + nmin
 
     def fwd(x):
         return q(x), (x,)
@@ -492,3 +498,18 @@ def check_numerics(x, message="check_numerics failed"):
         if not bool(finite):
             raise FloatingPointError(message)
     return x
+
+
+@op("popcount", "transform_same", aliases=("population_count",),
+    differentiable=False)
+def popcount(x):
+    """Per-element set-bit count (TF PopulationCount) — SWAR loop over the
+    unsigned view, output int32 like TF's uint8-widened semantics."""
+    x = jnp.asarray(x)
+    bits = x.dtype.itemsize * 8
+    u = x.view(jnp.dtype(f"uint{bits}"))
+    ones = jnp.asarray(1, u.dtype)
+    cnt = jnp.zeros_like(u)
+    for i in range(bits):
+        cnt = cnt + ((u >> i) & ones)
+    return cnt.astype(jnp.int32)

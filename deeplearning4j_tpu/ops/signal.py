@@ -7,6 +7,12 @@ lowers to. Complex tensors follow the ONNX convention at the op boundary
 where noted: a trailing dim of size 2 holding (real, imag) — XLA has
 native complex, so internally these are complex64/128 and convert at the
 edges only when asked.
+
+Platform note (measured 2026-07-31): these lower to the XLA ``fft`` HLO,
+which the experimental axon TPU plugin currently returns UNIMPLEMENTED for
+— the family runs on the CPU backend (where the whole test suite exercises
+it) until the plugin gains the kernel. Real TPU builds of XLA implement
+fft natively, so no code change is expected when the plugin catches up.
 """
 
 from __future__ import annotations

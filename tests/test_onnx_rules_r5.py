@@ -246,6 +246,22 @@ class TestScatterPool:
         ref = x.repeat(2, axis=2).repeat(2, axis=3)
         np.testing.assert_allclose(y, ref)
 
+    def test_max_unpool_default_strides_are_one(self):
+        # review regression: missing strides attr = 1 per axis by spec,
+        # so a (1,1,2,2) pooled input unpools to (1,1,3,3), not (1,1,4,4)
+        vals = np.ones((1, 1, 2, 2), np.float32)
+        idx = np.asarray([[[[0, 2], [6, 8]]]], np.int64)
+        model = _onnx_model(
+            nodes=[_onnx_node("MaxUnpool", ["v", "i"], ["y"],
+                              _onnx_attr_ints("kernel_shape", [2, 2]))],
+            initializers=[_onnx_tensor("i", idx)],
+            inputs=[_onnx_input("v", vals.shape)],
+            outputs=["y"],
+        )
+        (y,) = _run(model, {"v": vals}, ["y"])
+        assert y.shape == (1, 1, 3, 3)
+        assert y.reshape(-1)[[0, 2, 6, 8]].sum() == 4.0
+
     def test_max_unpool(self):
         # MaxPool 2x2 on a 4x4, then MaxUnpool restores positions
         x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)

@@ -1,0 +1,294 @@
+"""Round-5 TF importer rules: linalg tail, image tail, 3-D conv/pool,
+bitwise, FFT, fake-quant, random family — golden-tested against the
+installed TensorFlow wherever outputs are deterministic (decompositions
+compare reconstructions, not sign-ambiguous factors)."""
+
+import numpy as np
+import pytest
+import tensorflow as tf
+
+from deeplearning4j_tpu.imports import import_graph_def
+
+from test_imports import _freeze, _golden_match
+
+R = np.random.default_rng(21)
+
+
+def _golden(fn, feeds, atol=1e-5):
+    gd, golden, in_names, out_names = _freeze(fn, feeds)
+    _golden_match(gd, golden, in_names, out_names, feeds, atol=atol)
+
+
+def _import_run(fn, feeds):
+    gd, golden, in_names, out_names = _freeze(fn, feeds)
+    sd = import_graph_def(gd)
+    keys = [sd.tf_name_map[o if ":" in o else o + ":0"] for o in out_names]
+    res = sd.output({n: v for n, v in zip(in_names, feeds)}, keys)
+    return [np.asarray(res[k]) for k in keys], golden
+
+
+class TestLinalgTail:
+    def test_exact_ops(self):
+        a = R.normal(size=(3, 3)).astype(np.float32)
+        spd = (a @ a.T + 3 * np.eye(3)).astype(np.float32)
+        b = R.normal(size=(3, 2)).astype(np.float32)
+        _golden(lambda x: tf.linalg.cholesky(x), [spd], atol=1e-4)
+        _golden(lambda x: tf.linalg.inv(x), [spd], atol=1e-4)
+        _golden(tf.linalg.solve, [spd, b], atol=1e-3)
+        _golden(lambda x: tf.linalg.trace(x), [spd])
+        _golden(lambda x: tf.linalg.diag_part(x), [spd])
+        _golden(lambda x: tf.nn.l2_loss(x), [spd])
+
+    def test_triangular_solve(self):
+        l = np.tril(R.normal(size=(3, 3)).astype(np.float32)) \
+            + 2 * np.eye(3, dtype=np.float32)
+        b = R.normal(size=(3, 2)).astype(np.float32)
+        _golden(lambda x, y: tf.linalg.triangular_solve(x, y, lower=True),
+                [l, b], atol=1e-4)
+
+    def test_cross_and_diag(self):
+        a = R.normal(size=(4, 3)).astype(np.float32)
+        b = R.normal(size=(4, 3)).astype(np.float32)
+        _golden(tf.linalg.cross, [a, b])
+        v = R.normal(size=(5,)).astype(np.float32)
+        _golden(lambda x: tf.linalg.diag(x), [v])
+
+    def test_svd_reconstruction(self):
+        a = R.normal(size=(4, 4)).astype(np.float32)
+        (s, u, v), (ref_s, ref_u, ref_v) = _import_run(
+            lambda x: tf.linalg.svd(x), [a])
+        np.testing.assert_allclose(np.sort(s)[::-1], np.sort(ref_s)[::-1],
+                                   atol=1e-4)
+        rec = u @ np.diag(s) @ v.T
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_eigh_reconstruction(self):
+        a = R.normal(size=(4, 4)).astype(np.float32)
+        spd = (a + a.T).astype(np.float32)
+        (e, v), (ref_e, ref_v) = _import_run(
+            lambda x: tf.linalg.eigh(x), [spd])
+        np.testing.assert_allclose(np.sort(e), np.sort(ref_e), atol=1e-4)
+        np.testing.assert_allclose(v @ np.diag(e) @ v.T, spd, atol=1e-3)
+
+    def test_qr_reconstruction(self):
+        a = R.normal(size=(4, 3)).astype(np.float32)
+        (q, r), _ = _import_run(lambda x: tf.linalg.qr(x), [a])
+        np.testing.assert_allclose(q @ r, a, atol=1e-4)
+        np.testing.assert_allclose(np.tril(r, -1), 0, atol=1e-6)
+
+    def test_special_functions(self):
+        a = (R.random((8,)) * 2 + 0.5).astype(np.float32)
+        b = (R.random((8,)) * 2 + 0.5).astype(np.float32)
+        x = R.random((8,)).astype(np.float32) * 0.8 + 0.1
+        _golden(tf.math.betainc, [a, b, x], atol=1e-4)
+        _golden(tf.math.zeta, [a + 1.5, b], atol=1e-3)
+        _golden(tf.math.polygamma,
+                [np.ones(8, np.float32), a + 0.5], atol=1e-3)
+
+
+class TestImageTail:
+    def test_colorspace_roundtrip(self):
+        img = R.random((2, 5, 5, 3)).astype(np.float32)
+        _golden(tf.image.rgb_to_hsv, [img], atol=1e-5)
+        hsv = tf.image.rgb_to_hsv(img).numpy()
+        _golden(tf.image.hsv_to_rgb, [hsv], atol=1e-5)
+
+    def test_adjust_ops(self):
+        img = R.random((1, 6, 6, 3)).astype(np.float32)
+        _golden(lambda x: tf.image.adjust_hue(x, 0.15), [img], atol=1e-4)
+        _golden(lambda x: tf.image.adjust_saturation(x, 1.4), [img],
+                atol=1e-4)
+        _golden(lambda x: tf.image.adjust_contrast(x, 1.7), [img],
+                atol=1e-4)
+
+    def test_crop_and_resize(self):
+        img = R.random((2, 8, 8, 2)).astype(np.float32)
+        boxes = np.asarray([[0.1, 0.1, 0.8, 0.9], [0.0, 0.0, 1.0, 1.0]],
+                           np.float32)
+        bidx = np.asarray([0, 1], np.int32)
+        _golden(lambda x, b, i: tf.image.crop_and_resize(x, b, i, (4, 4)),
+                [img, boxes, bidx], atol=1e-4)
+
+    def test_dilation2d(self):
+        x = R.normal(size=(1, 6, 6, 2)).astype(np.float32)
+        f = (R.normal(size=(2, 2, 2)) * 0.1).astype(np.float32)
+        _golden(lambda a, b: tf.nn.dilation2d(
+            a, b, strides=[1, 1, 1, 1], padding="VALID",
+            data_format="NHWC", dilations=[1, 1, 1, 1]), [x, f],
+            atol=1e-5)
+
+    def test_non_max_suppression(self):
+        boxes = np.asarray([[0, 0, 1, 1], [0.05, 0.05, 1, 1],
+                            [0.5, 0.5, 1.5, 1.5], [2, 2, 3, 3]],
+                           np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7, 0.6], np.float32)
+        (sel,), (ref,) = _import_run(
+            lambda b, s: tf.image.non_max_suppression(b, s, 3, 0.5),
+            [boxes, scores])
+        np.testing.assert_array_equal(sel[:len(ref)], ref)
+
+    def test_nms_v5_scores_and_valid_outputs(self):
+        boxes = np.asarray([[0, 0, 1, 1], [0.05, 0.05, 1, 1],
+                            [2, 2, 3, 3]], np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+
+        def f(b, s):
+            sel, ssc, valid = tf.raw_ops.NonMaxSuppressionV5(
+                boxes=b, scores=s, max_output_size=3, iou_threshold=0.5,
+                score_threshold=float("-inf"), soft_nms_sigma=0.0,
+                pad_to_max_output_size=False)
+            return sel, ssc, valid
+
+        (sel, ssc, valid), (rsel, rssc, rvalid) = _import_run(
+            f, [boxes, scores])
+        assert int(valid) == int(rvalid) == 2
+        np.testing.assert_array_equal(sel[:2], rsel[:2])
+        np.testing.assert_allclose(ssc[:2], rssc[:2], atol=1e-6)
+
+
+class TestConv3D:
+    def test_conv3d(self):
+        x = R.normal(size=(1, 5, 5, 5, 2)).astype(np.float32)
+        w = (R.normal(size=(2, 2, 2, 2, 3)) * 0.2).astype(np.float32)
+        _golden(lambda a, b: tf.nn.conv3d(
+            a, b, strides=[1, 1, 1, 1, 1], padding="SAME"), [x, w],
+            atol=1e-4)
+
+    def test_pool3d(self):
+        x = R.normal(size=(1, 4, 4, 4, 2)).astype(np.float32)
+        _golden(lambda a: tf.nn.max_pool3d(a, 2, 2, "VALID"), [x])
+        _golden(lambda a: tf.nn.avg_pool3d(a, 2, 2, "VALID"), [x],
+                atol=1e-5)
+
+
+class TestBitwiseFFT:
+    def test_bitwise(self):
+        a = np.asarray([1, 2, 12, -7], np.int32)
+        b = np.asarray([1, 2, 2, 1], np.int32)
+        _golden(tf.bitwise.left_shift, [a, b])
+        _golden(tf.bitwise.right_shift, [a, b])
+        _golden(tf.bitwise.invert, [a])
+
+    def test_popcount_vs_tf(self):
+        a = np.asarray([0, 1, 255, 1023], np.int32)
+        (got,), (ref,) = _import_run(
+            lambda x: tf.raw_ops.PopulationCount(x=x), [a])
+        np.testing.assert_array_equal(got, ref.astype(np.int32))
+
+    def test_rfft_roundtrip(self):
+        x = R.normal(size=(2, 16)).astype(np.float32)
+        (got,), (ref,) = _import_run(
+            lambda a: tf.signal.rfft(a), [x])
+        np.testing.assert_allclose(got.real, ref.real, atol=1e-4)
+        np.testing.assert_allclose(got.imag, ref.imag, atol=1e-4)
+        (inv,), (ref_inv,) = _import_run(
+            lambda a: tf.signal.irfft(tf.signal.rfft(a)), [x])
+        np.testing.assert_allclose(inv, ref_inv, atol=1e-4)
+
+
+class TestFakeQuant:
+    def test_args(self):
+        x = np.linspace(-8, 8, 33).astype(np.float32)
+        _golden(lambda a: tf.quantization.fake_quant_with_min_max_args(
+            a, min=-4.0, max=4.0), [x], atol=1e-5)
+
+    def test_vars_asymmetric_exact(self):
+        # asymmetric range: the nudge is NOT on the .5 boundary -> exact
+        x = R.normal(size=(4, 3)).astype(np.float32) * 4
+
+        def v(a):
+            return tf.quantization.fake_quant_with_min_max_vars(
+                a, tf.constant(-3.1), tf.constant(2.9))
+
+        _golden(v, [x], atol=1e-5)
+
+    def test_vars_symmetric_within_one_quantum(self):
+        # symmetric range: the true zero point is exactly .5 and fp32
+        # rounding decides the side — TF's own Args/Vars kernels disagree
+        # there (see ops/elementwise.py nudge comment). Allow one quantum.
+        x = R.normal(size=(4, 3)).astype(np.float32) * 4
+
+        def v(a):
+            return tf.quantization.fake_quant_with_min_max_vars(
+                a, tf.constant(-3.0), tf.constant(3.0))
+
+        gd, golden, in_names, out_names = _freeze(v, [x])
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0]]
+        got = np.asarray(sd.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_allclose(got, golden[0], atol=6.0 / 255.0 + 1e-6)
+
+        def pc(a):
+            return tf.quantization.fake_quant_with_min_max_vars_per_channel(
+                a, tf.constant([-1.0, -2.0, -4.1]),
+                tf.constant([1.0, 2.0, 3.9]))
+
+        gd, golden, in_names, out_names = _freeze(pc, [x])
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0]]
+        got = np.asarray(sd.output({in_names[0]: x}, [key])[key])
+        np.testing.assert_allclose(got, golden[0], atol=4.0 / 255.0 + 1e-6)
+
+
+class TestRandomMisc:
+    def test_random_shapes_and_determinism(self):
+        def f(x):
+            return x + tf.random.normal((3, 4), seed=7)
+
+        gd, _, in_names, out_names = _freeze(
+            f, [np.zeros((3, 4), np.float32)])
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0] if ":" in out_names[0]
+                             else out_names[0] + ":0"]
+        feeds = {in_names[0]: np.zeros((3, 4), np.float32)}
+        a = np.asarray(sd.output(feeds, [key])[key])
+        b = np.asarray(sd.output(feeds, [key])[key])
+        assert a.shape == (3, 4)
+        np.testing.assert_array_equal(a, b)
+        assert np.std(a) > 0.3  # actually random-looking
+
+    def test_stateless_random(self):
+        def f(x):
+            return x + tf.random.stateless_normal((2, 5), seed=[3, 9])
+
+        gd, _, in_names, out_names = _freeze(
+            f, [np.zeros((2, 5), np.float32)])
+        sd = import_graph_def(gd)
+        key = sd.tf_name_map[out_names[0] if ":" in out_names[0]
+                             else out_names[0] + ":0"]
+        a = np.asarray(sd.output(
+            {in_names[0]: np.zeros((2, 5), np.float32)}, [key])[key])
+        assert a.shape == (2, 5) and np.isfinite(a).all()
+
+    def test_tensor_scatter_add_and_hist(self):
+        t = np.zeros((5, 2), np.float32)
+        idx = np.asarray([[1], [3]], np.int32)
+        upd = np.ones((2, 2), np.float32)
+        _golden(tf.tensor_scatter_nd_add, [t, idx, upd])
+        x = R.normal(size=(50,)).astype(np.float32)
+        _golden(lambda a: tf.histogram_fixed_width(a, [-2.0, 2.0], nbins=8),
+                [x])
+
+    def test_in_top_k_and_segment_max(self):
+        preds = R.normal(size=(4, 6)).astype(np.float32)
+        targets = np.asarray([0, 3, 5, 2], np.int32)
+        _golden(lambda p, t: tf.math.in_top_k(t, p, k=2), [preds, targets])
+        data = R.normal(size=(6, 3)).astype(np.float32)
+        segs = np.asarray([0, 0, 1, 1, 1, 2], np.int32)
+        _golden(lambda d: tf.math.segment_max(d, segs), [data])
+
+    def test_sparse_dense_matmul(self):
+        b = R.normal(size=(4, 3)).astype(np.float32)
+        a_idx = np.asarray([[0, 1], [2, 3]], np.int64)
+        a_vals = np.asarray([2.0, -1.5], np.float32)
+
+        def f(bm):
+            return tf.raw_ops.SparseTensorDenseMatMul(
+                a_indices=a_idx, a_values=a_vals, a_shape=[3, 4], b=bm)
+
+        _golden(f, [b], atol=1e-5)
+
+    def test_bitcast(self):
+        x = np.asarray([1.0, -2.5], np.float32)
+        _golden(lambda a: tf.bitcast(a, tf.int32), [x])
+        _golden(lambda a: tf.bitcast(a, tf.uint8), [x])
