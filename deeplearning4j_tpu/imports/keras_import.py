@@ -632,6 +632,29 @@ def _embedding(cfg, w):
     return lyr, ({"W": w[0]} if w else {})
 
 
+def _conv2d_transpose(cfg, w):
+    """Keras Conv2DTranspose -> Deconvolution2D. Keras stores the kernel as
+    (kh, kw, out, in); our deconv2d takes HWIO with I = input channels, and
+    the transpose semantics additionally require the spatial FLIP (verified
+    against an fp64 manual conv-transpose: flip+swap is exact; the keras
+    kernel as-is through lax.conv_transpose is not)."""
+    if cfg.get("output_padding") not in (None, [None, None]):
+        raise NotImplementedError("Conv2DTranspose output_padding")
+    if tuple(cfg.get("dilation_rate", (1, 1))) != (1, 1):
+        raise NotImplementedError("Conv2DTranspose dilation")
+    lyr = L.Deconvolution2D(
+        n_out=cfg["filters"], kernel_size=tuple(cfg["kernel_size"]),
+        stride=tuple(cfg["strides"]), padding=_pad(cfg),
+        activation=_act(cfg), has_bias=cfg.get("use_bias", True))
+    p = {}
+    if w:
+        p["W"] = np.ascontiguousarray(
+            w[0][::-1, ::-1].transpose(0, 1, 3, 2))
+        if cfg.get("use_bias", True) and len(w) > 1:
+            p["b"] = w[1]
+    return lyr, p
+
+
 def _conv1d(cfg, w):
     if cfg.get("padding") == "causal":
         raise KerasImportError("Conv1D causal padding not supported")
@@ -782,6 +805,7 @@ _LAYER_BUILDERS = {
     # -- round-2 breadth (VERDICT r1 missing #6) ----------------------------
     "Conv1D": _conv1d,
     "Conv3D": _conv3d,
+    "Conv2DTranspose": _conv2d_transpose,
     "DepthwiseConv2D": _depthwise2d,
     "Bidirectional": _bidirectional,
     "TimeDistributed": _time_distributed,

@@ -1863,12 +1863,16 @@ def _tf_diag(m, node):
 
 @rule("DiagPart", "MatrixDiagPartV3")
 def _tf_diag_part(m, node):
+    x = m.get(m.inputs(node)[0])
     if node.op == "MatrixDiagPartV3":
         k = m.const(m.inputs(node)[1])
         if np.any(np.asarray(k) != 0):
             raise UnsupportedOpError("MatrixDiagPartV3 k != 0")
-    m.set(node.name, m.sd._op("matrix_diag_part",
-                              [m.get(m.inputs(node)[0])], name=node.name))
+    elif x.shape is not None and len(x.shape) != 2:
+        # TF DiagPart is rank-2k -> rank-k (out[i,j] = in[i,j,i,j]);
+        # matrix_diag_part only coincides at rank 2
+        raise UnsupportedOpError("DiagPart of rank != 2")
+    m.set(node.name, m.sd._op("matrix_diag_part", [x], name=node.name))
 
 
 @rule("MatrixDiagV3")
@@ -2085,9 +2089,12 @@ def _tf_conv3d(m, node):
     x, w = (m.get(i) for i in m.inputs(node)[:2])
     strides = list(node.attr["strides"].list.i)
     padding = node.attr["padding"].s.decode()
+    dil = list(node.attr["dilations"].list.i) if "dilations" in node.attr \
+        else [1] * 5
     m.set(node.name, m.sd._op(
         "conv3d", [x, w],
-        attrs=dict(strides=tuple(strides[1:4]), padding=padding),
+        attrs=dict(strides=tuple(strides[1:4]), padding=padding,
+                   dilation=tuple(dil[1:4])),
         name=node.name))
 
 
@@ -2248,8 +2255,11 @@ def _tf_unique_v2(m, node):
     axis = np.asarray(m.const(m.inputs(node)[1])).reshape(-1)
     if axis.size and int(axis[0]) != 0:
         raise UnsupportedOpError("UniqueV2 axis != 0")
-    uniq, first_idx, inverse = np.unique(val, return_index=True,
+    # axis=0 keeps unique SLICES for rank>1 (TF semantics) — plain
+    # np.unique would silently flatten
+    uniq, first_idx, inverse = np.unique(val, axis=0, return_index=True,
                                          return_inverse=True)
+    inverse = inverse.reshape(-1)
     order = np.argsort(first_idx, kind="stable")
     remap = np.empty_like(order)
     remap[order] = np.arange(order.size)

@@ -393,14 +393,8 @@ def bits_hamming_distance(x, y):
     bits_hamming_distance) — a scalar int."""
     x = jnp.asarray(x)
     v = jnp.bitwise_xor(x, jnp.asarray(y, x.dtype))
-    bits = x.dtype.itemsize * 8
-    u = v.view(jnp.dtype(f"uint{bits}"))
-    # SWAR popcount on the unsigned view (XLA has no popcnt HLO)
-    ones = jnp.asarray(1, u.dtype)
-    cnt = jnp.zeros_like(u)
-    for i in range(bits):
-        cnt = cnt + ((u >> i) & ones)
-    return jnp.sum(cnt.astype(jnp.int32))
+    u = v.view(jnp.dtype(f"uint{x.dtype.itemsize * 8}"))
+    return jnp.sum(lax.population_count(u).astype(jnp.int32))
 
 
 def _fake_quant(x, qmin, qmax, minv, maxv):
@@ -503,13 +497,8 @@ def check_numerics(x, message="check_numerics failed"):
 @op("popcount", "transform_same", aliases=("population_count",),
     differentiable=False)
 def popcount(x):
-    """Per-element set-bit count (TF PopulationCount) — SWAR loop over the
-    unsigned view, output int32 like TF's uint8-widened semantics."""
+    """Per-element set-bit count (TF PopulationCount) — the XLA popcnt HLO,
+    output int32."""
     x = jnp.asarray(x)
-    bits = x.dtype.itemsize * 8
-    u = x.view(jnp.dtype(f"uint{bits}"))
-    ones = jnp.asarray(1, u.dtype)
-    cnt = jnp.zeros_like(u)
-    for i in range(bits):
-        cnt = cnt + ((u >> i) & ones)
-    return cnt.astype(jnp.int32)
+    u = x.view(jnp.dtype(f"uint{x.dtype.itemsize * 8}"))
+    return lax.population_count(u).astype(jnp.int32)

@@ -33,6 +33,7 @@ class UIServer:
         self.storages: List = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._sys_history: List = []  # (timestamp, process RSS MB) samples
 
     @classmethod
     def get_instance(cls, port: "Optional[int]" = None) -> "UIServer":
@@ -111,6 +112,9 @@ class UIServer:
                     self._send(server._render(sid).encode(), "text/html")
                 elif u.path.startswith("/train/histograms"):
                     self._send(server._render_histograms(session).encode(),
+                               "text/html")
+                elif u.path.startswith("/train/system"):
+                    self._send(server._render_system().encode(),
                                "text/html")
                 elif u.path in ("/", "/train", "/train/"):
                     self._send(server._render(session).encode(), "text/html")
@@ -211,7 +215,8 @@ class UIServer:
 <table border=1 cellpadding=4>
 <tr><th>iter</th><th>epoch</th><th>score</th><th>ms</th></tr>{rows}</table>
 <p>{len(recs)} records; raw data at <a href="/train/data">/train/data</a>;
-per-layer <a href="/train/histograms{qs}">parameter/update histograms</a></p>
+per-layer <a href="/train/histograms{qs}">parameter/update histograms</a>;
+<a href="/train/system">system</a></p>
 </body></html>"""
 
     def _render_histograms(self, session: "Optional[str]" = None) -> str:
@@ -259,6 +264,90 @@ per-layer <a href="/train/histograms{qs}">parameter/update histograms</a></p>
 <p><a href="/train/">&larr; overview</a></p>
 {body}
 </body></html>"""
+
+    def _render_system(self) -> str:
+        """DL4J UI "System" tab parity: hardware/memory facts — host RAM,
+        process RSS, accelerator devices with per-device memory stats
+        (the reference shows JVM/off-heap memory + GPU list; here it is
+        host + PJRT devices). Each page load appends an RSS sample so the
+        chart shows live memory over time."""
+        import html as _html
+        import time as _time
+
+        snap = _system_snapshot()
+        self._sys_history.append((_time.time(), snap.get("process_rss_mb")))
+        self._sys_history = self._sys_history[-500:]
+        t0 = self._sys_history[0][0]
+        pts = [(t - t0, v) for t, v in self._sys_history
+               if isinstance(v, int)]
+        chart = _line_chart(pts, "process RSS (MB) vs seconds") if pts \
+            else ""
+        host_rows = "".join(
+            f"<tr><td>{_html.escape(str(k))}</td>"
+            f"<td>{_html.escape(str(v))}</td></tr>"
+            for k, v in snap.items() if k != "devices")
+        dev_rows = "".join(
+            "<tr>" + "".join(
+                f"<td>{_html.escape(str(d.get(c, '')))}</td>"
+                for c in ("id", "platform", "kind", "mem_in_use_mb",
+                          "mem_limit_mb")) + "</tr>"
+            for d in snap.get("devices", []))
+        return f"""<!doctype html><html><head><title>System</title>
+<meta http-equiv="refresh" content="10"></head>
+<body style="font-family:sans-serif">
+<h2>System</h2>
+<p><a href="/train/">&larr; overview</a></p>
+{chart}
+<h3>Host</h3><table border=1 cellpadding=4>{host_rows}</table>
+<h3>Devices</h3><table border=1 cellpadding=4>
+<tr><th>id</th><th>platform</th><th>kind</th><th>mem in use (MB)</th>
+<th>mem limit (MB)</th></tr>{dev_rows}</table>
+</body></html>"""
+
+
+def _system_snapshot() -> dict:
+    """Host + device facts for the System page (and tests)."""
+    import platform
+    import sys as _sys
+
+    snap: dict = {"python": _sys.version.split()[0],
+                  "platform": platform.platform()}
+    try:  # host memory via /proc (Linux; this image)
+        with open("/proc/meminfo") as f:
+            mem = {l.split(":")[0]: l.split()[1] for l in f if ":" in l}
+        snap["host_mem_total_mb"] = int(mem.get("MemTotal", 0)) // 1024
+        snap["host_mem_available_mb"] = int(
+            mem.get("MemAvailable", 0)) // 1024
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    snap["process_rss_mb"] = int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    try:
+        import jax
+
+        snap["jax"] = jax.__version__
+        devs = []
+        for d in jax.devices():
+            row = {"id": d.id, "platform": d.platform,
+                   "kind": getattr(d, "device_kind", "?")}
+            try:
+                stats = d.memory_stats() or {}
+                if "bytes_in_use" in stats:
+                    row["mem_in_use_mb"] = stats["bytes_in_use"] // 2**20
+                if "bytes_limit" in stats:
+                    row["mem_limit_mb"] = stats["bytes_limit"] // 2**20
+            except Exception:
+                pass
+            devs.append(row)
+        snap["devices"] = devs
+    except Exception:
+        snap["devices"] = []
+    return snap
 
 
 _PALETTE = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",

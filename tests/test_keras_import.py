@@ -499,3 +499,29 @@ class TestKerasFullModelCorpus:
         net = KerasModelImport.import_keras_model_and_weights(path)
         out = np.asarray(net.output(x))
         np.testing.assert_allclose(out, golden, atol=1e-4, rtol=1e-4)
+
+
+def test_conv2d_transpose(rng, tmp_path):
+    """Round-5: Conv2DTranspose -> Deconvolution2D (kernel flip+swap
+    verified against an fp64 manual conv-transpose)."""
+    tf.keras.utils.set_random_seed(11)
+    model = tf.keras.Sequential([
+        tf.keras.Input((5, 5, 3)),
+        tf.keras.layers.Conv2DTranspose(4, (3, 3), strides=(2, 2),
+                                        padding="same",
+                                        activation="relu"),
+        tf.keras.layers.Conv2DTranspose(2, (3, 3), padding="valid"),
+    ])
+    x = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+    _roundtrip(model, x, tmp_path, atol=1e-4)
+
+
+def test_conv2d_transpose_no_bias_valid(rng, tmp_path):
+    tf.keras.utils.set_random_seed(12)
+    model = tf.keras.Sequential([
+        tf.keras.Input((6, 6, 2)),
+        tf.keras.layers.Conv2DTranspose(3, (2, 2), strides=(3, 3),
+                                        padding="valid", use_bias=False),
+    ])
+    x = rng.normal(size=(1, 6, 6, 2)).astype(np.float32)
+    _roundtrip(model, x, tmp_path, atol=1e-4)

@@ -288,6 +288,20 @@ class TestRandomMisc:
 
         _golden(f, [b], atol=1e-5)
 
+    def test_conv3d_dilation_forwarded(self):
+        x = R.normal(size=(1, 6, 6, 6, 1)).astype(np.float32)
+        w = (R.normal(size=(2, 2, 2, 1, 2)) * 0.3).astype(np.float32)
+        _golden(lambda a, b: tf.nn.conv3d(
+            a, b, strides=[1, 1, 1, 1, 1], padding="VALID",
+            dilations=[1, 2, 2, 2, 1]), [x, w], atol=1e-4)
+
+    def test_diag_part_rank4_rejected(self):
+        x = R.normal(size=(2, 3, 2, 3)).astype(np.float32)
+        gd, _, in_names, out_names = _freeze(
+            lambda a: tf.raw_ops.DiagPart(input=a), [x])
+        with pytest.raises(NotImplementedError, match="rank"):
+            import_graph_def(gd)
+
     def test_bitcast(self):
         x = np.asarray([1.0, -2.5], np.float32)
         _golden(lambda a: tf.bitcast(a, tf.int32), [x])

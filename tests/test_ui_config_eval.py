@@ -276,3 +276,34 @@ class TestCGActivationStats:
         acts = recs[-1]["activations"]
         assert "h" in acts and "out" in acts
         assert "hist" in acts["h"]
+
+
+class TestSystemPage:
+    def test_system_page_serves_host_and_devices(self):
+        """DL4J UI System-tab parity (round 5): host memory, process RSS,
+        and the PJRT device table render; repeated loads grow the RSS
+        sample history that drives the live chart."""
+        import urllib.request
+
+        from deeplearning4j_tpu.util.ui_server import _system_snapshot
+
+        snap = _system_snapshot()
+        assert snap.get("host_mem_total_mb", 0) > 0
+        assert snap.get("process_rss_mb", 0) > 0
+        assert isinstance(snap.get("devices"), list) and snap["devices"]
+
+        ui = UIServer(port=0)
+        ui.attach(InMemoryStatsStorage())
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            html = urllib.request.urlopen(f"{base}/train/system").read() \
+                .decode()
+            assert "System" in html and "Devices" in html
+            assert "process_rss_mb" in html or "host_mem_total_mb" in html
+            urllib.request.urlopen(f"{base}/train/system").read()
+            assert len(ui._sys_history) == 2
+            # overview links to the system page
+            over = urllib.request.urlopen(f"{base}/train").read().decode()
+            assert "/train/system" in over
+        finally:
+            ui.stop()
