@@ -21,6 +21,7 @@ from deeplearning4j_tpu.datavec.records import (  # noqa: F401
     RecordReader,
     RegexLineRecordReader,
     SVMLightRecordReader,
+    TfidfRecordReader,
     TransformProcessRecordReader,
     WavFileRecordReader,
     ArrowRecordReader,

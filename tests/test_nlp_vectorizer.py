@@ -67,3 +67,33 @@ class TestTfidf:
         v = TfidfVectorizer().fit(DOCS)
         x = v.transform("zebra quagga")
         np.testing.assert_allclose(x, 0.0)
+
+
+class TestTfidfRecordReader:
+    def test_directory_corpus(self, tmp_path):
+        from deeplearning4j_tpu.datavec import TfidfRecordReader
+
+        (tmp_path / "pos").mkdir()
+        (tmp_path / "neg").mkdir()
+        (tmp_path / "pos" / "a.txt").write_text("good great good")
+        (tmp_path / "neg" / "b.txt").write_text("bad awful")
+        rr = TfidfRecordReader(str(tmp_path))
+        recs = list(rr)
+        assert len(recs) == 2 and rr.labels() == ["neg", "pos"]
+        vocab_n = len(rr.vectorizer.vocab)
+        assert all(len(r) == vocab_n + 1 for r in recs)
+        # label index appended; tf-idf of "good" (tf=2, df=1, N=2)
+        import math
+
+        pos_row = [r for r in recs if r[-1] == 1][0]
+        gi = rr.vectorizer.index_of("good")
+        np.testing.assert_allclose(pos_row[gi], 2 * math.log10(2.0),
+                                   rtol=1e-6)
+
+    def test_explicit_documents(self):
+        from deeplearning4j_tpu.datavec import TfidfRecordReader
+
+        rr = TfidfRecordReader(documents=[("x y", "a"), ("y z", "b")],
+                               append_label=False)
+        recs = list(rr)
+        assert len(recs[0]) == len(rr.vectorizer.vocab)
