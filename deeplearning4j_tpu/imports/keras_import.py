@@ -63,6 +63,18 @@ def _act(cfg, default="identity"):
     return _ACT[a]
 
 
+def _global_pool(cfg, pooling_type):
+    """Global pooling builder with config guards: GlobalPoolingLayer pools
+    every spatial axis assuming channels_last and always collapses rank —
+    refuse loudly what it cannot honor instead of mis-pooling."""
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise KerasImportError(
+            "GlobalPooling requires channels_last (got channels_first)")
+    if cfg.get("keepdims", False):
+        raise KerasImportError("GlobalPooling keepdims=True unsupported")
+    return L.GlobalPoolingLayer(pooling_type=pooling_type)
+
+
 def _pad(cfg):
     return "SAME" if cfg.get("padding", "valid") == "same" else "VALID"
 
@@ -789,10 +801,14 @@ _LAYER_BUILDERS = {
     # DenseLayer flattens >2D input itself (channels_last order matches)
     "Flatten": lambda cfg, w: (None, {}),
     "Activation": lambda cfg, w: (L.ActivationLayer(activation=_act(cfg)), {}),
-    "GlobalMaxPooling2D": lambda cfg, w: (L.GlobalPoolingLayer(pooling_type="max"), {}),
-    "GlobalAveragePooling2D": lambda cfg, w: (L.GlobalPoolingLayer(pooling_type="avg"), {}),
-    "GlobalMaxPooling1D": lambda cfg, w: (L.GlobalPoolingLayer(pooling_type="max"), {}),
-    "GlobalAveragePooling1D": lambda cfg, w: (L.GlobalPoolingLayer(pooling_type="avg"), {}),
+    # GlobalPoolingLayer pools every spatial axis (channels_last, rank-5
+    # NDHWC included); _global_pool guards the configs it cannot honor
+    "GlobalMaxPooling2D": lambda cfg, w: (_global_pool(cfg, "max"), {}),
+    "GlobalAveragePooling2D": lambda cfg, w: (_global_pool(cfg, "avg"), {}),
+    "GlobalMaxPooling1D": lambda cfg, w: (_global_pool(cfg, "max"), {}),
+    "GlobalAveragePooling1D": lambda cfg, w: (_global_pool(cfg, "avg"), {}),
+    "GlobalMaxPooling3D": lambda cfg, w: (_global_pool(cfg, "max"), {}),
+    "GlobalAveragePooling3D": lambda cfg, w: (_global_pool(cfg, "avg"), {}),
     "ZeroPadding2D": lambda cfg, w: (L.ZeroPaddingLayer(
         padding=tuple(cfg["padding"]) if isinstance(cfg["padding"], (list, tuple))
         else cfg["padding"]), {}),

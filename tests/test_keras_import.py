@@ -525,3 +525,29 @@ def test_conv2d_transpose_no_bias_valid(rng, tmp_path):
     ])
     x = rng.normal(size=(1, 6, 6, 2)).astype(np.float32)
     _roundtrip(model, x, tmp_path, atol=1e-4)
+
+
+def test_global_pooling_3d(rng, tmp_path):
+    tf.keras.utils.set_random_seed(5)
+    model = tf.keras.Sequential([
+        tf.keras.Input((4, 4, 4, 2)),
+        tf.keras.layers.Conv3D(3, (2, 2, 2), padding="same"),
+        tf.keras.layers.GlobalAveragePooling3D(),
+    ])
+    x = rng.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+    _roundtrip(model, x, tmp_path, atol=1e-5)
+
+
+def test_global_pooling_guards(rng, tmp_path):
+    """channels_first / keepdims configs must fail LOUDLY, not mis-pool."""
+    from deeplearning4j_tpu.imports.keras_import import KerasImportError
+
+    tf.keras.utils.set_random_seed(6)
+    model = tf.keras.Sequential([
+        tf.keras.Input((4, 4, 2)),
+        tf.keras.layers.GlobalAveragePooling2D(keepdims=True),
+    ])
+    path = str(tmp_path / "kd.h5")
+    model.save(path)
+    with pytest.raises(KerasImportError, match="keepdims"):
+        KerasModelImport.import_keras_model_and_weights(path)
