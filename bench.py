@@ -16,7 +16,9 @@ XLA recompiles; timed steady-state steps; completion forced by fetching the
 final scalar loss to the host (block_until_ready alone does not synchronize
 through the remote-chip tunnel). The whole jitted train step is measured:
 forward, reverse AD, updater, parameter write. bfloat16 compute with fp32
-accumulation — the MXU-native policy.
+accumulation — the MXU-native policy. EVERY metric is median-of-3 with an
+explicit ``noise`` field (half the min-max spread over the median — the DP
+proxy's r4 definition, extended to all metrics per VERDICT r5 weak #2).
 """
 
 from __future__ import annotations
@@ -30,6 +32,17 @@ import time
 import numpy as np
 
 NORTH_STAR_IMG_PER_SEC = 8000.0  # BASELINE.json north_star, TPU v5e per chip
+
+
+def _med3(measure, runs: int = 3):
+    """median-of-N measurement + spread (VERDICT r5 weak #2: EVERY bench
+    metric carries an explicit noise field, not just the DP proxy). Returns
+    (median, noise_string); noise = half the min-max spread over the median,
+    the same definition the DP proxy has used since r4."""
+    vals = sorted(measure() for _ in range(runs))
+    med = vals[runs // 2]
+    noise = (vals[-1] - vals[0]) / 2.0 / med if med else 0.0
+    return med, f"±{round(100 * noise, 1)}% ({runs}-sample spread/2)"
 
 
 def _bench_net(net, x, y, steps: int, min_seconds: float = 2.0):
@@ -60,11 +73,12 @@ def bench_resnet50(batch: int, image: int, steps: int):
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, image, image, 3)).astype(np.float32)
     labels = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, size=batch)]
-    ips = _bench_net(net, x, y=labels, steps=steps)
+    ips, noise = _med3(lambda: _bench_net(net, x, y=labels, steps=steps))
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "model": f"zoo.ResNet50 {image}px classes=1000 B={batch} bf16",
         "value": round(ips, 2),
+        "noise": noise,
         "unit": "images/sec/chip",
         # vs the 8,000 img/s/chip v5e north star (BASELINE.json); this chip's
         # measured conv ceiling puts the derated roof far lower — BASELINE.md.
@@ -87,11 +101,12 @@ def bench_bert(batch: int, seq: int, steps: int, tiny: bool = False):
     seg = np.zeros((batch, seq))
     x = np.stack([tok, seg], axis=-1).astype(np.int32)
     labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=batch)]
-    sps = _bench_net(net, x, y=labels, steps=steps)
+    sps, noise = _med3(lambda: _bench_net(net, x, y=labels, steps=steps))
     return {
         "metric": "bert_base_finetune_samples_per_sec_per_chip",
         "model": f"zoo.bert.Bert.{'tiny' if tiny else 'base'} B={batch} seq={seq} bf16",
         "value": round(sps, 2),
+        "noise": noise,
         "unit": "samples/sec/chip",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
     }
@@ -218,22 +233,24 @@ def bench_attention_2k(batch: int = 4, seq: int = 2048, k_lo: int = 8,
         return best
 
     lo_fn, hi_fn = make_many(k_lo), make_many(k_hi)
-    dt = None
-    for _ in range(3):  # jitter can make t_hi <= t_lo; retry, never clamp
-        t_lo = timed(lo_fn)
-        t_hi = timed(hi_fn)
-        if t_hi > t_lo:
-            dt = (t_hi - t_lo) / (k_hi - k_lo)
-            break
-    if dt is None:
+
+    def one_fit():
+        for _ in range(3):  # jitter can make t_hi <= t_lo; retry, never clamp
+            t_lo = timed(lo_fn)
+            t_hi = timed(hi_fn)
+            if t_hi > t_lo:
+                return (t_hi - t_lo) / (k_hi - k_lo)
         raise RuntimeError(
             f"two-point fit invalid after retries (t_lo={t_lo:.4f}s >= "
             f"t_hi={t_hi:.4f}s): session latency noise exceeds the "
             "device-time delta; not reporting a corrupted number")
+
+    dt, noise = _med3(one_fit)
     return {
         "metric": "flash_attention_seq2048_tokens_per_sec",
         "model": f"flash fwd+bwd B={batch} H={H} S={seq} D={D} bf16",
         "value": round(batch * seq / dt),
+        "noise": noise,
         "unit": "tokens/sec",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
     }
@@ -270,32 +287,60 @@ def bench_lstm_char_rnn(batch: int = 128, seq: int = 128, vocab: int = 96,
     for _ in range(4):
         net._fit_batch(x, y)
     float(net.score_value)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        net._fit_batch(x, y)
-    float(net.score_value)
-    dt = (time.perf_counter() - t0) / steps
+
+    def one_run():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            net._fit_batch(x, y)
+        float(net.score_value)
+        return (time.perf_counter() - t0) / steps
+
+    dt, noise = _med3(one_run)
     return {
         "metric": "lstm_char_rnn_train_tokens_per_sec",
         "model": f"2xLSTM(H={hidden}) char-RNN B={batch} T={seq} V={vocab} bf16",
         "value": round(batch * seq / dt),
+        "noise": noise,
         "unit": "tokens/sec",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
     }
 
 
 def bench_lenet(batch: int, steps: int):
-    import __graft_entry__ as ge
+    """Fallback metric (BASELINE config #1): LeNet-5 MNIST built directly on
+    the nn DSL — deliberately independent of the zoo, because this path runs
+    exactly when the flagship zoo model is what broke (VERDICT r5 weak #3:
+    the old fallback built ResNet-50 via the zoo and fed it MNIST shapes, so
+    it crashed whenever it was needed)."""
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                              OutputLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.nn.updaters import Adam
 
-    net = ge._flagship()
+    conf = (
+        NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3)).list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                padding="VALID", activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                padding="VALID", activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_in=500, n_out=10))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
     labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
-    ips = _bench_net(net, x, y=labels, steps=steps)
+    ips, noise = _med3(lambda: _bench_net(net, x, y=labels, steps=steps))
     return {
         "metric": "lenet_mnist_train_images_per_sec",
-        "model": f"LeNet-5 MNIST B={batch}",
+        "model": f"LeNet-5 MNIST B={batch} (nn DSL, zoo-independent)",
         "value": round(ips, 2),
+        "noise": noise,
         "unit": "images/sec",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
     }

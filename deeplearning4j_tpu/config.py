@@ -17,6 +17,10 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
 - ``DL4J_TPU_NAN_PANIC``   — raise on NaN/Inf op outputs  (ProfilerConfig.nanPanic)
 - ``DL4J_TPU_COMPUTE_DTYPE`` — default compute dtype for new configs
   ("float32" | "bfloat16")   (ND4J default dtype)
+- ``DL4J_TPU_REMAT_POLICY`` — default selective-remat policy for new configs
+  ("none" | "full" | "save_conv" | … — see util/xla_tuning.py; TPU-native,
+  no reference equivalent). The fusion-sweep harness uses this to A/B
+  policies without code changes.
 """
 
 from __future__ import annotations
@@ -44,6 +48,10 @@ class Environment:
         self.nan_panic = _env_bool("DL4J_TPU_NAN_PANIC")
         self.default_compute_dtype = os.environ.get(
             "DL4J_TPU_COMPUTE_DTYPE", "float32")
+        self.default_remat_policy = (
+            os.environ.get("DL4J_TPU_REMAT_POLICY") or None)
+        if self.default_remat_policy == "none":
+            self.default_remat_policy = None
         self._profiler = None
 
     @classmethod

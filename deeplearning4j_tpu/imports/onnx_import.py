@@ -659,11 +659,24 @@ def _o_pool(m, node):
                                     name=node.outputs[0]))
 
 
+def _spatial_axes(x):
+    """All spatial axes of an N,C,spatial... input — the Global*Pool ops are
+    defined over every spatial dim, so rank-5 (N,C,D,H,W) pools (2, 3, 4),
+    not a hardcoded (2, 3)."""
+    shp = x.shape
+    if shp is None:
+        raise NotImplementedError("Global pooling with unknown input rank")
+    if len(shp) < 3:
+        raise NotImplementedError(
+            f"Global pooling needs an N,C,spatial... input, got rank {len(shp)}")
+    return tuple(range(2, len(shp)))
+
+
 @orule("GlobalAveragePool")
 def _o_gap(m, node):
     x = m.get(node.inputs[0])
     m.set(node.outputs[0], m.sd._op("mean", [x], attrs=dict(
-        axis=(2, 3), keepdims=True), name=node.outputs[0]))
+        axis=_spatial_axes(x), keepdims=True), name=node.outputs[0]))
 
 
 @orule("BatchNormalization")
@@ -990,7 +1003,7 @@ def _o_elu(m, node):
 def _o_gmp(m, node):
     x = m.get(node.inputs[0])
     m.set(node.outputs[0], m.sd._op("max", [x], attrs=dict(
-        axis=(2, 3), keepdims=True), name=node.outputs[0]))
+        axis=_spatial_axes(x), keepdims=True), name=node.outputs[0]))
 
 
 @orule("ConvTranspose")
@@ -1607,7 +1620,7 @@ def _o_global_lp_pool(m, node):
     p = float(node.attr("p", 2))
     ap = m.sd._op("pow", [m.sd._op("abs", [x]),
                           m.sd.constant(np.float32(p))])
-    s = m.sd._op("sum", [ap], attrs=dict(axis=(2, 3), keepdims=True))
+    s = m.sd._op("sum", [ap], attrs=dict(axis=_spatial_axes(x), keepdims=True))
     m.set(node.outputs[0], m.sd._op(
         "pow", [s, m.sd.constant(np.float32(1.0 / p))],
         name=node.outputs[0]))

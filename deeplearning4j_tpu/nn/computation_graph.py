@@ -61,6 +61,12 @@ class ComputationGraphConfiguration:
     input_shapes: Optional[List[Tuple[int, ...]]] = None  # excl. batch, per input
     compute_dtype: str = "float32"
     tbptt_length: int = 0  # >0: truncated-BPTT segment length (tBPTTLength)
+    # Fusion-boundary engineering (util/xla_tuning.py): named selective-remat
+    # policy, stage boundaries as node names (each named node ENDS a stage),
+    # optional optimization barriers at the boundaries.
+    remat_policy: Optional[str] = None
+    remat_stages: Optional[Tuple[str, ...]] = None
+    stage_barriers: bool = False
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -75,6 +81,10 @@ class ComputationGraphConfiguration:
                 else None,
                 "compute_dtype": self.compute_dtype,
                 "tbptt_length": self.tbptt_length,
+                "remat_policy": self.remat_policy,
+                "remat_stages": list(self.remat_stages)
+                if self.remat_stages else None,
+                "stage_barriers": self.stage_barriers,
                 "nodes": [
                     {
                         "name": n.name,
@@ -112,6 +122,10 @@ class ComputationGraphConfiguration:
             else None,
             compute_dtype=d.get("compute_dtype", "float32"),
             tbptt_length=d.get("tbptt_length", 0),
+            remat_policy=d.get("remat_policy"),
+            remat_stages=tuple(d["remat_stages"])
+            if d.get("remat_stages") else None,
+            stage_barriers=d.get("stage_barriers", False),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -156,6 +170,7 @@ class GraphBuilder:
         self._outputs: List[str] = []
         self._input_shapes: Optional[List[tuple]] = None
         self._tbptt: Optional[int] = None
+        self._stage_ends: List[str] = []
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -183,6 +198,20 @@ class GraphBuilder:
         self._tbptt = k
         return self
 
+    def stage_boundary(self, *node_names: str) -> "GraphBuilder":
+        """Mark remat/fusion stage boundaries: each named node ENDS a stage
+        (util/xla_tuning.py). With no names, the last added node ends the
+        stage. Boundaries are inert until a remat policy or stage barriers
+        are configured on the parent builder."""
+        if not node_names:
+            if not self._nodes:
+                raise ValueError("stage_boundary() before any node")
+            node_names = (self._nodes[-1].name,)
+        for n in node_names:
+            if n not in self._stage_ends:
+                self._stage_ends.append(n)
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         if not self._inputs:
             raise ValueError("add_inputs required")
@@ -207,6 +236,9 @@ class GraphBuilder:
             compute_dtype=self._p._compute_dtype if self._p else "float32",
             tbptt_length=self._tbptt if self._tbptt is not None
             else (self._p._tbptt_length if self._p else 0),
+            remat_policy=getattr(self._p, "_remat_policy", None),
+            remat_stages=tuple(self._stage_ends) or None,
+            stage_barriers=getattr(self._p, "_stage_barriers", False),
         )
 
 
@@ -285,6 +317,72 @@ class ComputationGraph:
                 raise ValueError(
                     f"SharedLayer {n.name!r} references unknown source "
                     f"{n.node.source!r}")
+        self._segments = self._build_segments()
+
+    # ------------------------------------------- fusion-boundary segmentation
+    def _build_segments(self):
+        """Partition the topo order into remat/fusion stages
+        (util/xla_tuning.py). Returns (stages, keep_after, tail) or None when
+        no policy/barrier is configured: ``stages`` is a list of node lists
+        (each wrapped in jax.checkpoint per the policy), ``keep_after[k]``
+        the activation names still consumed after stage k (everything else
+        is dropped at the boundary — that IS the remat saving), ``tail`` the
+        unwrapped remainder containing the loss heads."""
+        conf = self.conf
+        active = (conf.remat_policy not in (None, "none")) or conf.stage_barriers
+        if not active:
+            return None
+        names = {n.name for n in self.topo}
+        out_names = set(conf.outputs)
+        bounds = [s for s in (conf.remat_stages or ())]
+        for s in bounds:
+            if s not in names:
+                raise ValueError(f"remat stage boundary {s!r} is not a node")
+            if s in out_names:
+                raise ValueError(
+                    f"remat stage boundary {s!r} is an output layer — the "
+                    "loss head always runs in the unwrapped tail")
+        bound_set = set(bounds)
+        stages, cur = [], []
+        if not bound_set:
+            # no markers: the whole body before the first output node is
+            # one stage (whole-graph remat — the measured-rejected r5
+            # candidate, kept available for A/B harness runs)
+            for n in self.topo:
+                if n.name in out_names:
+                    break
+                cur.append(n)
+            stages, tail = [cur], self.topo[len(cur):]
+        else:
+            for n in self.topo:
+                cur.append(n)
+                if n.name in bound_set:
+                    stages.append(cur)
+                    cur = []
+            tail = cur
+        if not tail:
+            raise ValueError("remat stages consume every node — the loss "
+                             "head must stay outside the last boundary")
+        for k, stage in enumerate(stages):
+            swallowed = [n.name for n in stage if n.name in out_names]
+            if swallowed:
+                # an output inside a checkpointed stage would run plain
+                # .apply() instead of compute_loss(), silently dropping its
+                # loss (and gradients) from training — refuse loudly
+                raise ValueError(
+                    f"output node(s) {swallowed} fall inside remat stage "
+                    f"{k} (boundary {stage[-1].name!r}): every output/loss "
+                    "head must stay in the unwrapped tail — move or remove "
+                    "the boundaries that precede auxiliary heads")
+        # liveness at each boundary: names consumed by any later stage/tail
+        groups = stages + [tail]
+        keep_after = [set() for _ in stages]
+        consumed: set = set()
+        for k in range(len(groups) - 1, 0, -1):
+            for n in groups[k]:
+                consumed.update(n.inputs)
+            keep_after[k - 1] = set(consumed)
+        return stages, keep_after, tail
 
     # ------------------------------------------------------------------ init
     def init(self, input_shapes=None) -> "ComputationGraph":
@@ -429,6 +527,12 @@ class ComputationGraph:
         """Sum of output-layer losses + regularization. labels: dict
         output-name -> labels array. ``mask``/``label_mask``: (B,T) feature/
         label masks for sequence graphs (single shared mask, like MLN)."""
+        if self._segments is not None and mask is None and label_mask is None:
+            # fusion-boundary path: stage-segmented remat/barriers (masked
+            # sequence graphs keep the plain path — masks thread through the
+            # flat loop, and the conv stages remat targets carry no masks)
+            return self._loss_remat(params, states, inputs, labels, keys,
+                                    weights)
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
         new_states = dict(states)
@@ -464,6 +568,98 @@ class ComputationGraph:
                 h, ns = lyr.apply(
                     cparams[pkey], states[pkey], x, training=True,
                     key=keys[n.name], **self._mask_kw(lyr, mk, x),
+                )
+                acts[n.name] = h
+                new_states[pkey] = ns
+        reg = sum(
+            (
+                n.node.regularization(params[n.name])
+                for n in self.topo
+                if n.is_layer
+            ),
+            start=0.0,
+        )
+        return loss + reg, new_states
+
+    def _loss_remat(self, params, states, inputs, labels, keys, weights=None):
+        """_loss with the topo order split into remat/fusion stages
+        (``_build_segments``): each stage runs inside ``jax.checkpoint``
+        under the configured policy (save conv/dot outputs, recompute cheap
+        elementwise/BN — util/xla_tuning.py), activations dead past a
+        boundary are dropped there, and ``stage_barriers`` fences fusion at
+        each boundary. Values and gradients are exactly those of the plain
+        path — remat changes only what XLA keeps live across fwd/bwd."""
+        from deeplearning4j_tpu.util import xla_tuning
+
+        stages, keep_after, tail = self._segments
+        wrap, policy = xla_tuning.resolve_policy(self.conf.remat_policy)
+        acts = {k: self._cast(v) for k, v in inputs.items()}
+        cparams = self._cast_params(params)
+        new_states = dict(states)
+
+        def stage_runner(nodes):
+            def run(seg_params, seg_states, seg_keys, acts_in):
+                a = dict(acts_in)
+                st = {}
+                for n in nodes:
+                    if n.is_layer:
+                        x = self._gather_input(a, n)
+                        lyr, pkey = self._resolve_shared(n.node, n.name)
+                        h, ns = lyr.apply(
+                            seg_params[pkey], seg_states[pkey], x,
+                            training=True, key=seg_keys[n.name],
+                        )
+                        a[n.name] = h
+                        st[pkey] = ns
+                    else:
+                        a[n.name] = n.node.apply(*self._gather_input(a, n))
+                return a, st
+            return run
+
+        for k, nodes in enumerate(stages):
+            run = stage_runner(nodes)
+            if wrap:
+                run = jax.checkpoint(run, policy=policy)
+            pkeys = {self._resolve_shared(n.node, n.name)[1]
+                     for n in nodes if n.is_layer}
+            acts_out, st = run(
+                {p: cparams[p] for p in pkeys},
+                {p: states[p] for p in pkeys},
+                {n.name: keys[n.name] for n in nodes if n.is_layer},
+                acts,
+            )
+            new_states.update(st)
+            acts = {name: v for name, v in acts_out.items()
+                    if name in keep_after[k]}
+            if self.conf.stage_barriers:
+                acts = xla_tuning.barrier(acts)
+        # unwrapped tail: remaining nodes + the loss heads (same arithmetic
+        # as the plain _loss loop, maskless)
+        out_names = set(self.conf.outputs)
+        loss = 0.0  # weak-typed: stays fp64 under the gradcheck's enable_x64
+        for n in tail:
+            if not n.is_layer:
+                acts[n.name] = n.node.apply(*self._gather_input(acts, n))
+                continue
+            x = self._gather_input(acts, n)
+            if n.name in out_names:
+                if not hasattr(n.node, "compute_loss"):
+                    raise ValueError(
+                        f"output {n.name!r} must be an OutputLayer/LossLayer"
+                    )
+                out_loss = n.node.compute_loss(
+                    cparams[n.name], states[n.name], x, labels[n.name],
+                    training=True, key=keys[n.name], weights=weights,
+                )
+                loss = loss + out_loss.astype(
+                    jnp.promote_types(out_loss.dtype, jnp.float32)
+                )
+                acts[n.name] = x
+            else:
+                lyr, pkey = self._resolve_shared(n.node, n.name)
+                h, ns = lyr.apply(
+                    cparams[pkey], states[pkey], x, training=True,
+                    key=keys[n.name],
                 )
                 acts[n.name] = h
                 new_states[pkey] = ns

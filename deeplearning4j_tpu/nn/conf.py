@@ -51,6 +51,13 @@ class MultiLayerConfiguration:
     input_shape: Optional[Tuple[int, ...]] = None  # excl. batch
     compute_dtype: str = "float32"  # 'bfloat16' for MXU mixed precision
     tbptt_length: int = 0  # >0: truncated-BPTT segment length (tBPTTLength)
+    # Fusion-boundary engineering (util/xla_tuning.py): named selective-remat
+    # policy applied per stage, stage boundaries as layer indices (the layer
+    # at the index starts the next stage), and optional optimization
+    # barriers at the boundaries.
+    remat_policy: Optional[str] = None
+    remat_stages: Optional[Tuple[int, ...]] = None
+    stage_barriers: bool = False
 
     def to_json(self) -> str:
         return json.dumps(
@@ -60,6 +67,10 @@ class MultiLayerConfiguration:
                 "input_shape": list(self.input_shape) if self.input_shape else None,
                 "compute_dtype": self.compute_dtype,
                 "tbptt_length": self.tbptt_length,
+                "remat_policy": self.remat_policy,
+                "remat_stages": list(self.remat_stages)
+                if self.remat_stages else None,
+                "stage_barriers": self.stage_barriers,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -85,6 +96,10 @@ class MultiLayerConfiguration:
             input_shape=tuple(d["input_shape"]) if d["input_shape"] else None,
             compute_dtype=d.get("compute_dtype", "float32"),
             tbptt_length=d.get("tbptt_length", 0),
+            remat_policy=d.get("remat_policy"),
+            remat_stages=tuple(d["remat_stages"])
+            if d.get("remat_stages") else None,
+            stage_barriers=d.get("stage_barriers", False),
         )
 
 
@@ -111,8 +126,19 @@ class Builder:
 
         self._weight_init: Optional[str] = None
         self._activation: Optional[str] = None
-        self._compute_dtype = get_environment().default_compute_dtype
+        env = get_environment()
+        self._compute_dtype = env.default_compute_dtype
         self._tbptt_length = 0
+        self._remat_policy = env.default_remat_policy
+        if self._remat_policy is not None:
+            from deeplearning4j_tpu.util import xla_tuning
+
+            try:  # same fail-fast as remat_policy(): a typo'd env var must
+                # not survive until jit tracing of the first train step
+                xla_tuning.resolve_policy(self._remat_policy)
+            except ValueError as e:
+                raise ValueError(f"DL4J_TPU_REMAT_POLICY: {e}") from None
+        self._stage_barriers = False
 
     def seed(self, s: int) -> "Builder":
         self._seed = s
@@ -147,6 +173,26 @@ class Builder:
         fit() splits the time axis into length-k segments, carrying recurrent
         state forward with gradients stopped at segment boundaries."""
         self._tbptt_length = k
+        return self
+
+    def remat_policy(self, name: Optional[str]) -> "Builder":
+        """Selective-rematerialization policy for the jitted train step
+        (util/xla_tuning.py): 'none'/None (off), 'full' (per-stage remat),
+        'save_conv' (save conv outputs, recompute BN/elementwise), 'save_dots',
+        'save_conv_dots', 'save_all'. Stage boundaries come from
+        ``stage_boundary()`` markers on the list/graph builder; with no
+        markers the whole body before the loss head is one stage."""
+        from deeplearning4j_tpu.util import xla_tuning
+
+        if name is not None and name != "none":
+            xla_tuning.resolve_policy(name)  # fail fast on unknown names
+        self._remat_policy = name
+        return self
+
+    def stage_barriers(self, on: bool = True) -> "Builder":
+        """Place ``lax.optimization_barrier`` on the activations at every
+        stage boundary, forbidding XLA from fusing across stages."""
+        self._stage_barriers = on
         return self
 
     def list(self) -> "ListBuilder":
@@ -187,9 +233,19 @@ class ListBuilder:
         self._p = parent
         self._layers: List[L.Layer] = []
         self._input_shape = None
+        self._stage_bounds: List[int] = []
 
     def layer(self, lyr: L.Layer) -> "ListBuilder":
         self._layers.append(lyr)
+        return self
+
+    def stage_boundary(self) -> "ListBuilder":
+        """Mark a remat/fusion stage boundary after the last added layer
+        (the next ``layer()`` starts a new stage)."""
+        if not self._layers:
+            raise ValueError("stage_boundary() before any layer()")
+        if self._layers and len(self._layers) not in self._stage_bounds:
+            self._stage_bounds.append(len(self._layers))
         return self
 
     def set_input_type(self, shape) -> "ListBuilder":
@@ -204,4 +260,7 @@ class ListBuilder:
             input_shape=self._input_shape,
             compute_dtype=self._p._compute_dtype,
             tbptt_length=self._p._tbptt_length,
+            remat_policy=self._p._remat_policy,
+            remat_stages=tuple(self._stage_bounds) or None,
+            stage_barriers=self._p._stage_barriers,
         )

@@ -58,6 +58,33 @@ class MultiLayerNetwork:
         self._loss_mask_aware = hasattr(self.layers[-1], "compute_loss") and (
             "mask" in inspect.signature(self.layers[-1].compute_loss).parameters
         )
+        self._segments = self._build_segments()
+
+    # ------------------------------------------- fusion-boundary segmentation
+    def _build_segments(self):
+        """Partition the layer stack into remat/fusion stages
+        (util/xla_tuning.py). Returns (list of (start, end) index pairs,
+        tail_start) or None when no policy/barrier is configured. The loss
+        head (and anything after the last boundary) always runs unwrapped."""
+        conf = self.conf
+        active = (getattr(conf, "remat_policy", None) not in (None, "none")
+                  or getattr(conf, "stage_barriers", False))
+        if not active:
+            return None
+        n = len(self.layers)
+        bounds = sorted(set(conf.remat_stages or ()))
+        for b in bounds:
+            if not 0 < b < n:
+                raise ValueError(
+                    f"remat stage boundary {b} out of range (1..{n - 1}); "
+                    "the loss head always runs in the unwrapped tail")
+        if not bounds:
+            bounds = [n - 1]  # whole body before the loss head = one stage
+        spans, start = [], 0
+        for b in bounds:
+            spans.append((start, b))
+            start = b
+        return spans, start
 
     # ------------------------------------------------------------------ init
     def init(self, input_shape=None) -> "MultiLayerNetwork":
@@ -178,9 +205,67 @@ class MultiLayerNetwork:
 
     def _loss(self, params, states, x, y, keys, weights=None, mask=None,
               label_mask=None):
+        if self._segments is not None and mask is None and label_mask is None:
+            # fusion-boundary path (util/xla_tuning.py): masked sequence
+            # nets keep the plain path — remat targets the conv stacks
+            return self._loss_remat(params, states, x, y, keys, weights)
         loss, (new_states, _) = self._loss_body(
             params, states, None, x, y, keys, weights, mask, label_mask)
         return loss, new_states
+
+    def _loss_remat(self, params, states, x, y, keys, weights=None):
+        """_loss with the layer stack split into remat/fusion stages: each
+        stage runs inside ``jax.checkpoint`` under the configured policy,
+        ``stage_barriers`` fences fusion at the boundaries. Exact same values
+        and gradients as the plain path (remat only changes what XLA keeps
+        live across fwd/bwd)."""
+        from deeplearning4j_tpu.util import xla_tuning
+
+        spans, tail_start = self._segments
+        wrap, policy = xla_tuning.resolve_policy(self.conf.remat_policy)
+        h = self._cast(x)
+        cparams = self._cast_params(params)
+        new_states = [None] * len(self.layers)
+
+        def stage_runner(a, b):
+            def run(seg_params, seg_states, seg_keys, h):
+                st = []
+                for j, i in enumerate(range(a, b)):
+                    h, ns = self.layers[i].apply(
+                        seg_params[j], seg_states[j], h, training=True,
+                        key=seg_keys[j])
+                    st.append(ns)
+                return h, st
+            return run
+
+        for a, b in spans:
+            run = stage_runner(a, b)
+            if wrap:
+                run = jax.checkpoint(run, policy=policy)
+            h, st = run([cparams[i] for i in range(a, b)],
+                        [states[i] for i in range(a, b)],
+                        [keys[i] for i in range(a, b)], h)
+            new_states[a:b] = st
+            if self.conf.stage_barriers:
+                h = xla_tuning.barrier(h)
+        for i in range(tail_start, len(self.layers) - 1):
+            h, ns = self.layers[i].apply(cparams[i], states[i], h,
+                                         training=True, key=keys[i])
+            new_states[i] = ns
+        out = self.layers[-1]
+        if not hasattr(out, "compute_loss"):
+            raise ValueError("last layer must be an OutputLayer/LossLayer")
+        loss_kw = {} if weights is None else {"weights": weights}
+        loss = out.compute_loss(
+            cparams[-1], states[-1], h, y, training=True, key=keys[-1],
+            **loss_kw,
+        )
+        new_states[-1] = states[-1]
+        reg = sum(
+            (lyr.regularization(params[i]) for i, lyr in enumerate(self.layers)),
+            start=jnp.asarray(0.0),
+        )
+        return loss.astype(jnp.float32) + reg, new_states
 
     # ------------------------------------------------------------ train step
     def make_step_fn(self, weighted: bool = False):

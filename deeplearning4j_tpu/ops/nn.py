@@ -22,8 +22,18 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from deeplearning4j_tpu.ops.registry import op
+
+# checkpoint_name tags let selective-remat policies (util/xla_tuning.py)
+# target the expensive conv/dot outputs by name: 'save_conv' keeps these and
+# recomputes the cheap BN/elementwise epilogue in the backward pass. The tag
+# is an identity outside a jax.checkpoint region. The names are shared with
+# the policy definitions — a drift would silently degrade 'save_conv' to
+# full recompute (the +32% r5-rejected behaviour), so there is one source.
+from deeplearning4j_tpu.util.xla_tuning import CONV_OUT as _CONV_OUT
+from deeplearning4j_tpu.util.xla_tuning import DOT_OUT as _DOT_OUT
 
 # ---------------------------------------------------------------------------
 # Convolutions
@@ -86,7 +96,7 @@ def conv2d(
     if b is not None:
         bshape = (1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1)
         out = out + b.reshape(bshape).astype(out.dtype)
-    return out
+    return checkpoint_name(out, _CONV_OUT)
 
 
 @op("conv1d", "conv")
@@ -116,7 +126,7 @@ def conv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME", dilation=(1, 1, 1), 
     if b is not None:
         bshape = (1, 1, 1, 1, -1) if data_format.endswith("C") else (1, -1, 1, 1, 1)
         out = out + b.reshape(bshape).astype(out.dtype)
-    return out
+    return checkpoint_name(out, _CONV_OUT)
 
 
 @op("depthwise_conv2d", "conv", aliases=("sconv2d_depthwise",))
@@ -149,7 +159,7 @@ def deconv2d(x, w, b=None, strides=(1, 1), padding="SAME", data_format="NHWC"):
     if b is not None:
         bshape = (1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1)
         out = out + b.reshape(bshape).astype(out.dtype)
-    return out
+    return checkpoint_name(out, _CONV_OUT)
 
 
 @op("upsampling2d", "conv")
@@ -664,7 +674,7 @@ def bias_add(x, b, data_format="NHWC"):
 def xw_plus_b(x, w, b):
     acc = jnp.promote_types(x.dtype, jnp.float32)
     out = jnp.matmul(x, w, preferred_element_type=acc).astype(x.dtype)
-    return out + b.astype(out.dtype)
+    return checkpoint_name(out + b.astype(out.dtype), _DOT_OUT)
 
 
 @op("batch_dot", "nn_misc")
@@ -1104,7 +1114,12 @@ def nll_loss(log_probs, target, weight=None, reduction="mean",
         return picked
     if reduction == "sum":
         return jnp.sum(picked)
-    return jnp.sum(picked) / jnp.maximum(jnp.sum(w_el), 1e-12)
+    # weight-normalized mean; an all-ignored batch (weight sum exactly 0)
+    # returns 0, not sum/1e-12 garbage (torch F.nll_loss returns nan there,
+    # ONNX leaves it undefined — 0 is the useful total-loss contribution)
+    w_sum = jnp.sum(w_el)
+    return jnp.where(w_sum > 0, jnp.sum(picked) / jnp.maximum(w_sum, 1e-12),
+                     jnp.zeros((), lp.dtype))
 
 
 @op("max_unpool2d", "pooling", differentiable=False)

@@ -6,6 +6,7 @@ from deeplearning4j_tpu.util.checkpoint import (
     ShardedCheckpointer,
     ShardedCheckpointListener,
 )
+from deeplearning4j_tpu.util import xla_tuning
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 from deeplearning4j_tpu.util.packed import PackedTrainer, StatePacker
 from deeplearning4j_tpu.util.profiler import (
@@ -29,5 +30,5 @@ __all__ = [
     "FaultTolerantTrainer", "OpProfiler", "ProfilerConfig", "StepTimer",
     "NaNPanicError", "check_numerics", "device_trace", "CrashReportingUtil",
     "FileStatsStorage", "InMemoryStatsStorage", "StatsListener", "to_csv",
-    "PackedTrainer", "StatePacker",
+    "PackedTrainer", "StatePacker", "xla_tuning",
 ]

@@ -236,17 +236,28 @@ class Darknet19(ZooModel):
 class ResNet50(ZooModel):
     """zoo/model/ResNet50.java — BASELINE config #2 and the flagship bench
     model. ResNet-v1 bottleneck layout (stride on the first 1x1, as in the
-    reference/Keras); NHWC; every block is conv→bn→relu chains XLA fuses."""
+    reference/Keras); NHWC; every block is conv→bn→relu chains XLA fuses.
+
+    ``remat_policy``/``stage_barriers`` engage the fusion-boundary subsystem
+    (util/xla_tuning.py): residual-stage boundaries (stem, res2–res5) are
+    always recorded in the config; a named policy selectively rematerializes
+    each stage in the backward pass (save conv outputs, recompute the cheap
+    BN/elementwise epilogue), barriers fence XLA fusion at the boundaries.
+    The default stays ``None`` per the measured record — see BASELINE.md's
+    fusion-sweep table before changing it."""
 
     updater: object = None
+    remat_policy: Optional[str] = None
+    stage_barriers: bool = False
 
     def conf(self):
         h, w, c = self.input_shape
-        gb = (
-            self._builder()
-            .graph_builder()
-            .add_inputs("input")
-        )
+        b = self._builder()
+        if self.remat_policy is not None:
+            b.remat_policy(self.remat_policy)
+        if self.stage_barriers:
+            b.stage_barriers(True)
+        gb = b.graph_builder().add_inputs("input")
 
         def conv_bn(name, inp, n_out, k, stride=(1, 1), relu=True, pad="SAME"):
             gb.add_layer(
@@ -277,6 +288,7 @@ class ResNet50(ZooModel):
         x = conv_bn("stem", "input", 64, 7, stride=(2, 2))
         gb.add_layer("stem_pool", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), padding="SAME"), x)
         x = "stem_pool"
+        gb.stage_boundary("stem_pool")
         stages = [
             ("res2", 3, (64, 64, 256), (1, 1)),
             ("res3", 4, (128, 128, 512), (2, 2)),
@@ -287,6 +299,7 @@ class ResNet50(ZooModel):
             x = bottleneck(f"{sname}a", x, filters, stride, project=True)
             for i in range(1, blocks):
                 x = bottleneck(f"{sname}{chr(ord('a') + i)}", x, filters, (1, 1), project=False)
+            gb.stage_boundary(x)  # stage end (res2c_out … res5c_out)
         gb.add_layer("avgpool", GlobalPoolingLayer(), x)
         gb.add_layer("output", OutputLayer(n_in=2048, n_out=self.num_classes), "avgpool")
         gb.set_outputs("output")

@@ -232,6 +232,29 @@ class TestScatterPool:
         gref = np.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True))
         np.testing.assert_allclose(gy, gref, rtol=1e-5)
 
+    def test_global_pools_rank5(self):
+        """ADVICE r5: the Global*Pool rules hardcoded spatial axes (2, 3), so
+        a rank-5 (N,C,D,H,W) input silently pooled only two of its three
+        spatial dims; axes now derive from input rank."""
+        x = R.normal(size=(2, 3, 2, 4, 4)).astype(np.float32)
+        refs = {
+            "GlobalLpPool": np.sqrt((x ** 2).sum(axis=(2, 3, 4),
+                                                 keepdims=True)),
+            "GlobalAveragePool": x.mean(axis=(2, 3, 4), keepdims=True),
+            "GlobalMaxPool": x.max(axis=(2, 3, 4), keepdims=True),
+        }
+        for op_t, ref in refs.items():
+            attrs = [_onnx_attr_i("p", 2)] if op_t == "GlobalLpPool" else []
+            model = _onnx_model(
+                nodes=[_onnx_node(op_t, ["x"], ["y"], *attrs)],
+                initializers=[],
+                inputs=[_onnx_input("x", x.shape)],
+                outputs=["y"],
+            )
+            (y,) = _run(model, {"x": x}, ["y"])
+            assert y.shape == (2, 3, 1, 1, 1), op_t
+            np.testing.assert_allclose(y, ref, rtol=1e-5, err_msg=op_t)
+
     def test_upsample_nearest(self):
         x = R.normal(size=(1, 2, 3, 3)).astype(np.float32)
         model = _onnx_model(

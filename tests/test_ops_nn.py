@@ -233,3 +233,21 @@ def test_conv3d_bias_ncdhw(rng):
     np.testing.assert_allclose(np.asarray(out) - np.asarray(out0),
                                np.array([10.0, 20.0]).reshape(1, 2, 1, 1, 1)
                                * np.ones_like(out0), rtol=1e-5)
+
+
+def test_nll_loss_all_targets_ignored_returns_zero():
+    """ADVICE r5: mean reduction with every target == ignore_index used to
+    divide by the 1e-12 clamp and return picked.sum() * 1e12 garbage; an
+    all-ignored batch contributes exactly 0 loss (and 0 gradient)."""
+    lp = jnp.asarray(np.log(np.full((3, 4), 0.25, np.float32)))
+    target = jnp.asarray([9, 9, 9])
+    out = ops.exec_op("nll_loss", lp, target, ignore_index=9)
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+    # gradient stays finite/zero rather than 1e12-scaled
+    g = jax.grad(lambda l: ops.exec_op("nll_loss", l, target,
+                                       ignore_index=9))(lp)
+    np.testing.assert_allclose(np.asarray(g), 0.0)
+    # mixed batch still weight-normalizes over the non-ignored elements
+    mixed = jnp.asarray([0, 9, 2])
+    out = ops.exec_op("nll_loss", lp, mixed, ignore_index=9)
+    np.testing.assert_allclose(np.asarray(out), np.log(4.0), rtol=1e-6)
