@@ -36,6 +36,15 @@ for h in /usr/include/jpeglib.h /usr/include/png.h; do
     if [ -e "$h" ]; then echo "  $h: present"; else echo "  $h: MISSING (image leg will skip)"; fi
 done
 
+echo "== step: Host-pipeline tests (2-worker multiprocess ETL leg) =="
+# ISSUE 2: the async host-pipeline suite under a FORCED 2-worker executor —
+# DL4J_TPU_ETL_WORKERS pins the worker count so the multiprocess merge path
+# (not the auto-sized or serial fallback) is what the bit-identity tests hit.
+DL4J_TPU_ETL_WORKERS=2 \
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/test_host_pipeline.py -q
+
 echo "== step: Test (pytest, JAX_PLATFORMS=cpu, 8 virtual devices) =="
 JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

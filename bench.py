@@ -306,12 +306,9 @@ def bench_lstm_char_rnn(batch: int = 128, seq: int = 128, vocab: int = 96,
     }
 
 
-def bench_lenet(batch: int, steps: int):
-    """Fallback metric (BASELINE config #1): LeNet-5 MNIST built directly on
-    the nn DSL — deliberately independent of the zoo, because this path runs
-    exactly when the flagship zoo model is what broke (VERDICT r5 weak #3:
-    the old fallback built ResNet-50 via the zoo and fed it MNIST shapes, so
-    it crashed whenever it was needed)."""
+def _build_lenet(seed: int = 0, sync_every: int = 1):
+    """LeNet-5 MNIST on the nn DSL, zoo-independent (shared by the fallback
+    metric and the host-pipeline overlap metric)."""
     from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
                                        NeuralNetConfiguration)
     from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
@@ -319,7 +316,8 @@ def bench_lenet(batch: int, steps: int):
     from deeplearning4j_tpu.nn.updaters import Adam
 
     conf = (
-        NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3)).list()
+        NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+        .sync_every(sync_every).list()
         .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
                                 padding="VALID", activation="relu"))
         .layer(SubsamplingLayer(kernel_size=(2, 2)))
@@ -331,7 +329,16 @@ def bench_lenet(batch: int, steps: int):
         .set_input_type(InputType.convolutional(28, 28, 1))
         .build()
     )
-    net = MultiLayerNetwork(conf).init()
+    return MultiLayerNetwork(conf).init()
+
+
+def bench_lenet(batch: int, steps: int):
+    """Fallback metric (BASELINE config #1): LeNet-5 MNIST built directly on
+    the nn DSL — deliberately independent of the zoo, because this path runs
+    exactly when the flagship zoo model is what broke (VERDICT r5 weak #3:
+    the old fallback built ResNet-50 via the zoo and fed it MNIST shapes, so
+    it crashed whenever it was needed)."""
+    net = _build_lenet()
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
     labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
@@ -343,6 +350,106 @@ def bench_lenet(batch: int, steps: int):
         "noise": noise,
         "unit": "images/sec",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
+    }
+
+
+class _SlowIterator:
+    """DataSetIterator facade injecting a fixed ETL delay per batch — the
+    A/B load for the host-pipeline overlap metric (sleep-based = I/O-shaped
+    ETL; a CPU-bound transform could not overlap on this 1-core host —
+    docs/HOST_PIPELINE.md measurement-ceiling note)."""
+
+    def __init__(self, base, delay_s: float):
+        self.base = base
+        self.delay_s = delay_s
+
+    def __iter__(self):
+        for ds in self.base:
+            time.sleep(self.delay_s)
+            yield ds
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+def bench_host_pipeline(batch: int = 64, n_batches: int = 12):
+    """host_pipeline_overlap: LeNet-5 fit wall-time under an injected slow
+    transform divided by compute-only wall-time. Serial feeding pays
+    compute + ETL per step (ratio ≈ 2× when the injected delay equals the
+    step time); the device-prefetch iterator (AsyncDataSetIterator,
+    sync_every>1 orchestration) overlaps ETL + device_put of batch k+1 under
+    batch k's compute — target ≤ 1.15×. Median-of-3 on the RATIOS with the
+    standard noise field; the serial ratio is reported alongside so both
+    ends of the A/B are in the table (ISSUE 2 acceptance)."""
+    import jax
+
+    from deeplearning4j_tpu.data import (ArrayDataSetIterator,
+                                         AsyncDataSetIterator)
+
+    net = _build_lenet(sync_every=max(2, n_batches // 2))
+
+    class _Observer:  # a listener must be installed for the coalesced
+        count = 0     # dispatch path to be IN the measured loop (with no
+                      # listeners the dispatcher skips the fetch entirely)
+        def iteration_done(self, model, iteration, epoch):
+            self.count += 1
+
+    net.set_listeners(_Observer())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch * n_batches, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, len(x))]
+    xd, yd = jax.device_put(x[:batch]), jax.device_put(y[:batch])
+    for _ in range(4):  # warm past every recompile
+        net._fit_batch(xd, yd)
+    float(net.score_value)
+
+    def compute_only():
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            net._fit_batch(xd, yd)
+        float(net.score_value)
+        return time.perf_counter() - t0
+
+    t_step = compute_only() / n_batches
+    # 0.8x a step of compute: heavy enough that serial feeding pays ~1.8-2x,
+    # light enough that a working overlap can actually hide it — at exactly
+    # 1.0x the pipeline is critically balanced and every ms of worker/queue
+    # overhead lands in the ratio instead of under the compute
+    delay = 0.8 * t_step
+
+    def fit_wall(iterator):
+        t0 = time.perf_counter()
+        net.fit(iterator, epochs=1)
+        float(net.score_value)
+        return time.perf_counter() - t0
+
+    def one_run():
+        it = lambda: ArrayDataSetIterator(x, y, batch=batch)  # noqa: E731
+        t_c = compute_only()
+        t_serial = fit_wall(_SlowIterator(it(), delay))
+        t_pref = fit_wall(AsyncDataSetIterator(_SlowIterator(it(), delay),
+                                               buffer_size=2))
+        return t_pref / t_c, t_serial / t_c
+
+    runs = sorted(one_run() for _ in range(3))
+    overlap = runs[1][0]
+    serial = sorted(r[1] for r in runs)[1]
+    spread = (runs[-1][0] - runs[0][0]) / 2.0 / overlap if overlap else 0.0
+    return {
+        "metric": "host_pipeline_overlap",
+        "model": (f"LeNet-5 B={batch} x{n_batches} batches, injected ETL "
+                  f"{delay * 1e3:.1f} ms/batch (0.8x step), prefetch "
+                  "buffer=2, coalesced sync"),
+        "value": round(overlap, 4),
+        "noise": f"±{round(100 * spread, 1)}% (3-sample spread/2)",
+        "unit": "x compute-only wall (1.0 = ETL fully hidden)",
+        "serial_ratio": round(serial, 4),  # the no-prefetch end of the A/B
+        # ≤ 1.0 means the ≤1.15x overlap target is met (BASELINE.md)
+        "vs_baseline": round(overlap / 1.15, 4),
     }
 
 
@@ -385,6 +492,12 @@ def main():
             hidden=512 if on_tpu else 32, steps=60 if on_tpu else 3))
     except Exception as e:
         print(f"lstm bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+    try:
+        extra.append(bench_host_pipeline(batch=64 if on_tpu else 16,
+                                         n_batches=24))
+    except Exception as e:
+        print(f"host pipeline bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     result["extra_metrics"] = extra
     print(json.dumps(result))
 

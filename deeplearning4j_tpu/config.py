@@ -21,6 +21,14 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   ("none" | "full" | "save_conv" | … — see util/xla_tuning.py; TPU-native,
   no reference equivalent). The fusion-sweep harness uses this to A/B
   policies without code changes.
+- ``DL4J_TPU_SYNC_EVERY`` — default ``sync_every`` for new configs (≥1):
+  fit() fetches the per-step loss to the host every N steps and dispatches
+  TrainingListener callbacks in coalesced batches instead of risking a
+  device sync per iteration (docs/HOST_PIPELINE.md; TPU-native, no
+  reference equivalent — the JVM listener bus had no device round-trip).
+- ``DL4J_TPU_ETL_WORKERS`` — worker-process count for the multiprocess
+  TransformProcess executor (datavec/executor.py); 0/unset = one per host
+  core, capped at 8 (the reference sizes Spark executors the same way).
 """
 
 from __future__ import annotations
@@ -34,6 +42,19 @@ def _env_bool(name: str, default: bool = False) -> bool:
     if v is None:
         return default
     return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int, floor: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        n = int(v.strip())
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}") from None
+    if n < floor:
+        raise ValueError(f"{name} must be >= {floor}, got {n}")
+    return n
 
 
 class Environment:
@@ -52,6 +73,8 @@ class Environment:
             os.environ.get("DL4J_TPU_REMAT_POLICY") or None)
         if self.default_remat_policy == "none":
             self.default_remat_policy = None
+        self.default_sync_every = _env_int("DL4J_TPU_SYNC_EVERY", 1, floor=1)
+        self.etl_workers = _env_int("DL4J_TPU_ETL_WORKERS", 0, floor=0)
         self._profiler = None
 
     @classmethod

@@ -16,6 +16,10 @@ from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     DataSetIterator,
     MnistDataSetIterator,
 )
+from deeplearning4j_tpu.data.prefetch import (  # noqa: F401
+    AsyncDataSetIterator,
+    PrefetchStalledError,
+)
 from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
     DataNormalization,
     ImagePreProcessingScaler,

@@ -1,0 +1,181 @@
+"""Device-prefetch iterator — overlap host ETL + transfer with device compute.
+
+Reference parity: org/deeplearning4j/datasets/iterator/AsyncDataSetIterator
+.java (+ AsyncMultiDataSetIterator): a background thread drains the base
+iterator into a bounded blocking queue so ``fit()`` never waits on ETL —
+path-cite, mount empty this round.
+
+TPU-native extension: the worker does not just *read ahead*, it stages batch
+k+1 onto the DEVICE (``jax.device_put``) while batch k's train step is still
+executing. ``device_put`` is an async enqueue on the PJRT stream, so the
+host→device copy of k+1 rides under k's compute; when fit() receives the
+DataSet its arrays are already device-resident and ``jnp.asarray`` is a
+no-op. This is the input half of the paper's "keep the accelerator fed"
+budget — the other half (coalesced loss fetch) is ``sync_every`` in
+nn/conf.py.
+
+Donation safety: the train step donates params/optimizer state, NEVER the
+batch arrays, and ``device_put`` always allocates FRESH buffers — the
+in-flight transfer of batch k+1 cannot alias or mutate batch k's buffers
+(asserted by tests/test_host_pipeline.py). Worker exceptions are captured
+and re-raised in the consuming thread (the original exception object keeps
+its worker-side traceback); a stalled worker trips ``timeout`` instead of
+hanging fit() forever.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+
+class PrefetchStalledError(RuntimeError):
+    """The prefetch worker produced nothing within ``timeout`` seconds."""
+
+
+def _stage_tree(x, put):
+    """device_put leaves of a DataSet field (arrays, or lists for
+    MultiDataSet)."""
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        return [_stage_tree(v, put) for v in x]
+    return put(np.asarray(x) if not hasattr(x, "devices") else x)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Wrap ANY DataSetIterator with background prefetch + device staging.
+
+    ``buffer_size=2`` is the classic double buffer: one batch in compute,
+    one staged on device, the worker building the next. ``device_put=False``
+    degrades to plain host-side read-ahead (the reference's behavior).
+    ``device``: optional explicit jax.Device / Sharding for the staged
+    arrays (defaults to jax's current default device).
+    """
+
+    def __init__(self, base, buffer_size: int = 2, device_put: bool = True,
+                 device=None, timeout: float = 120.0):
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        self.base = base
+        self.buffer_size = buffer_size
+        self.device_put = device_put
+        self.device = device
+        self.timeout = timeout
+        self._queue: Optional[_queue.Queue] = None
+        self._stop: Optional[threading.Event] = None
+        self._worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- plumbing
+    def batch_size(self):
+        # datavec's RecordReaderDataSetIterator family stores batch_size as
+        # an int ATTRIBUTE shadowing the DataSetIterator method
+        bs = getattr(self.base, "batch_size", None)
+        return bs() if callable(bs) else bs
+
+    def reset(self):
+        if not self._shutdown():
+            # the old worker is wedged INSIDE the base iterator; resetting
+            # and re-iterating the same base under it would interleave two
+            # threads' mutations of one iterator's state
+            raise PrefetchStalledError(
+                f"cannot reset: previous prefetch worker is still wedged in "
+                f"{type(self.base).__name__}")
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def _shutdown(self) -> bool:
+        """Stop + reap the worker. False when it outlived the join timeout
+        (stuck in the base iterator) — the base is NOT safe to reuse."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:  # unblock a worker stuck in put()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+        worker = self._worker
+        if worker is not None:
+            worker.join(timeout=5.0)
+        self._queue = self._stop = self._worker = None
+        return worker is None or not worker.is_alive()
+
+    # -------------------------------------------------------------- staging
+    def _stage(self, ds):
+        if not self.device_put:
+            return ds
+        import jax
+
+        def put(x):
+            return jax.device_put(x, self.device)
+
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet(
+                _stage_tree(ds.features, put), _stage_tree(ds.labels, put),
+                _stage_tree(ds.features_masks, put),
+                _stage_tree(ds.labels_masks, put))
+        if isinstance(ds, DataSet):
+            return DataSet(
+                _stage_tree(ds.features, put), _stage_tree(ds.labels, put),
+                _stage_tree(ds.features_mask, put),
+                _stage_tree(ds.labels_mask, put))
+        return ds  # unknown batch type: pass through untouched
+
+    # --------------------------------------------------------------- worker
+    @staticmethod
+    def _put(q, stop, item) -> bool:
+        """Stop-aware bounded put; False when the consumer abandoned us."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _produce(self, q, stop):
+        try:
+            for ds in self.base:
+                if not self._put(q, stop, ("ok", self._stage(ds))):
+                    return
+            self._put(q, stop, ("end", None))
+        except BaseException as e:  # noqa: BLE001 — crosses the thread gap
+            self._put(q, stop, ("error", e))
+
+    # ------------------------------------------------------------- iterator
+    def __iter__(self):
+        if not self._shutdown():
+            raise PrefetchStalledError(
+                f"cannot re-iterate: previous prefetch worker is still "
+                f"wedged in {type(self.base).__name__}")
+        q: _queue.Queue = _queue.Queue(maxsize=self.buffer_size)
+        stop = threading.Event()
+        worker = threading.Thread(
+            target=self._produce, args=(q, stop),
+            name="dl4j-tpu-prefetch", daemon=True)
+        self._queue, self._stop, self._worker = q, stop, worker
+        worker.start()
+        try:
+            while True:
+                try:
+                    kind, payload = q.get(timeout=self.timeout)
+                except _queue.Empty:
+                    raise PrefetchStalledError(
+                        f"prefetch worker produced no batch for "
+                        f"{self.timeout}s (base iterator "
+                        f"{type(self.base).__name__} wedged?)") from None
+                if kind == "end":
+                    return
+                if kind == "error":
+                    # the exception object carries its worker-side traceback
+                    raise payload
+                yield payload
+        finally:
+            stop.set()

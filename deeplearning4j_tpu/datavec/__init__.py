@@ -38,3 +38,9 @@ from deeplearning4j_tpu.datavec.iterators import (  # noqa: F401
     RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator,
 )
+from deeplearning4j_tpu.datavec.executor import (  # noqa: F401
+    LocalTransformExecutor,
+    MultiProcessTransformExecutor,
+    ParallelTransformRecordReader,
+    TransformExecutionError,
+)

@@ -2008,3 +2008,77 @@ def _o_unique(m, node):
     for i, o in enumerate(node.outputs):
         if o:
             m.set(o, m.sd.constant(outs[i], name=o), const_val=outs[i])
+
+
+@orule("Hardmax")
+def _o_hardmax(m, node):
+    """Opset-13 semantics: one-hot of the argmax along ``axis`` (default -1).
+    Registry ops only: argmax drops the axis, onehot re-inserts it there."""
+    x = m.get(node.inputs[0])
+    axis = int(node.attr("axis", -1))
+    shape = x.shape
+    if shape is None:
+        raise NotImplementedError("Hardmax requires a static input shape")
+    ax = axis % len(shape)
+    idx = m.sd._op("argmax", [x], attrs=dict(axis=ax))
+    m.set(node.outputs[0], m.sd._op(
+        "onehot", [idx],
+        attrs=dict(depth=int(shape[ax]), on_value=1.0, off_value=0.0,
+                   axis=ax if ax != len(shape) - 1 else -1,
+                   dtype=x.dtype or np.float32),  # ONNX: out type == in type
+        name=node.outputs[0]))
+
+
+@orule("NonMaxSuppression")
+def _o_nms(m, node):
+    """Wires to the registry's greedy ``non_max_suppression`` op (ops/image
+    .py), once per (batch, class). ONNX emits a DYNAMIC (num_selected, 3)
+    tensor; XLA needs static shapes, so the output here is the padded static
+    variant — (B*C*max_out, 3) int32 triples [batch, class, box] with unused
+    slots filled by [-1, -1, -1] (the registry op's own padding convention,
+    same compromise as the waived SparseTensor decoders)."""
+    boxes_v, scores_v = m.get(node.inputs[0]), m.get(node.inputs[1])
+    max_out = (int(np.asarray(m.const(node.inputs[2])).ravel()[0])
+               if m.has_input(node, 2) else 0)
+    iou_th = (float(np.asarray(m.const(node.inputs[3])).ravel()[0])
+              if m.has_input(node, 3) else 0.0)
+    score_th = (float(np.asarray(m.const(node.inputs[4])).ravel()[0])
+                if m.has_input(node, 4) else None)
+    center = bool(node.attr("center_point_box", 0))
+    bs, ss = boxes_v.shape, scores_v.shape
+    if bs is None or ss is None:
+        raise NotImplementedError("NonMaxSuppression requires static shapes")
+    B, N, C = int(bs[0]), int(bs[1]), int(ss[1])
+    m_eff = min(max_out, N) if max_out > 0 else 0
+
+    def nms_all(bx, sc):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.image import non_max_suppression
+
+        if m_eff == 0:  # spec: max_output_boxes_per_class defaults to 0
+            return jnp.zeros((0, 3), jnp.int32)
+        if center:  # [x_center, y_center, width, height]
+            xc, yc, w, h = (bx[..., i] for i in range(4))
+            bx = jnp.stack([yc - h / 2, xc - w / 2,
+                            yc + h / 2, xc + w / 2], axis=-1)
+        else:  # [y1, x1, y2, x2], either diagonal pair: normalize corners
+            b0, b1, b2, b3 = (bx[..., i] for i in range(4))
+            bx = jnp.stack([jnp.minimum(b0, b2), jnp.minimum(b1, b3),
+                            jnp.maximum(b0, b2), jnp.maximum(b1, b3)],
+                           axis=-1)
+        rows = []
+        for b in range(B):
+            for c in range(C):
+                sel = non_max_suppression(
+                    bx[b], sc[b, c], m_eff, iou_threshold=iou_th,
+                    score_threshold=(-jnp.inf if score_th is None
+                                     else score_th))
+                keep = sel >= 0
+                rows.append(jnp.stack(
+                    [jnp.where(keep, b, -1), jnp.where(keep, c, -1), sel],
+                    axis=-1))
+        return jnp.concatenate(rows, axis=0).astype(jnp.int32)
+
+    m.set(node.outputs[0], m.sd.custom_op(nms_all, boxes_v, scores_v,
+                                          name=node.outputs[0]))

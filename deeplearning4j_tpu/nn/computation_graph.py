@@ -67,6 +67,9 @@ class ComputationGraphConfiguration:
     remat_policy: Optional[str] = None
     remat_stages: Optional[Tuple[str, ...]] = None
     stage_barriers: bool = False
+    # Sync-free step orchestration (docs/HOST_PIPELINE.md): coalesce the loss
+    # fetch + TrainingListener dispatch into one host round-trip per window.
+    sync_every: int = 1
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -85,6 +88,7 @@ class ComputationGraphConfiguration:
                 "remat_stages": list(self.remat_stages)
                 if self.remat_stages else None,
                 "stage_barriers": self.stage_barriers,
+                "sync_every": self.sync_every,
                 "nodes": [
                     {
                         "name": n.name,
@@ -126,6 +130,7 @@ class ComputationGraphConfiguration:
             remat_stages=tuple(d["remat_stages"])
             if d.get("remat_stages") else None,
             stage_barriers=d.get("stage_barriers", False),
+            sync_every=d.get("sync_every", 1),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -239,6 +244,7 @@ class GraphBuilder:
             remat_policy=getattr(self._p, "_remat_policy", None),
             remat_stages=tuple(self._stage_ends) or None,
             stage_barriers=getattr(self._p, "_stage_barriers", False),
+            sync_every=getattr(self._p, "_sync_every", 1),
         )
 
 
@@ -289,9 +295,14 @@ class ComputationGraph:
         self.epoch = 0
         self.listeners: list = []
         self.score_value: float = float("nan")
+        self.last_iteration_wall_ns = None  # set during coalesced dispatch
         self._train_step = None
         self._it_dev = None   # device-resident iteration counter
         self._it_sync = -1    # host iteration the device counter mirrors
+        from deeplearning4j_tpu.nn.listeners import CoalescingListenerDispatcher
+
+        self._dispatcher = CoalescingListenerDispatcher(
+            self, getattr(conf, "sync_every", 1))
         self._updaters: Dict[str, Any] = {}
         for n in self.topo:
             if n.is_layer:
@@ -799,6 +810,7 @@ class ComputationGraph:
                                  seg(inputs, s), seg(labs, s), sub, ms, lms))
             self.iteration += 1
             losses.append(loss)
+        self._dispatcher.flush()  # keep cross-path dispatch ordering intact
         self.score_value = float(jnp.mean(jnp.stack(losses)))
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
@@ -1007,6 +1019,7 @@ class ComputationGraph:
         return self
 
     def _end_epoch(self):
+        self._dispatcher.flush()  # epoch-end callbacks see a complete epoch
         self.epoch += 1
         for lst in self.listeners:
             if hasattr(lst, "on_epoch_end"):
@@ -1043,8 +1056,9 @@ class ComputationGraph:
         self.last_features = tuple(features)  # for activation-stats listeners
         self.iteration += 1
         self._it_sync = self.iteration
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        # sync_every=1: immediate dispatch (legacy cadence); >1: coalesced
+        # windows — one host round-trip per window (docs/HOST_PIPELINE.md)
+        self._dispatcher.iteration_done(loss, self.iteration, self.epoch)
 
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):

@@ -58,6 +58,11 @@ class MultiLayerConfiguration:
     remat_policy: Optional[str] = None
     remat_stages: Optional[Tuple[int, ...]] = None
     stage_barriers: bool = False
+    # Sync-free step orchestration (docs/HOST_PIPELINE.md): fit() fetches the
+    # per-step loss and dispatches TrainingListener callbacks every
+    # ``sync_every`` iterations (coalesced, one host round-trip per window)
+    # instead of exposing a device sync point every iteration.
+    sync_every: int = 1
 
     def to_json(self) -> str:
         return json.dumps(
@@ -71,6 +76,7 @@ class MultiLayerConfiguration:
                 "remat_stages": list(self.remat_stages)
                 if self.remat_stages else None,
                 "stage_barriers": self.stage_barriers,
+                "sync_every": self.sync_every,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -100,6 +106,7 @@ class MultiLayerConfiguration:
             remat_stages=tuple(d["remat_stages"])
             if d.get("remat_stages") else None,
             stage_barriers=d.get("stage_barriers", False),
+            sync_every=d.get("sync_every", 1),
         )
 
 
@@ -139,6 +146,7 @@ class Builder:
             except ValueError as e:
                 raise ValueError(f"DL4J_TPU_REMAT_POLICY: {e}") from None
         self._stage_barriers = False
+        self._sync_every = env.default_sync_every
 
     def seed(self, s: int) -> "Builder":
         self._seed = s
@@ -193,6 +201,19 @@ class Builder:
         """Place ``lax.optimization_barrier`` on the activations at every
         stage boundary, forbidding XLA from fusing across stages."""
         self._stage_barriers = on
+        return self
+
+    def sync_every(self, n: int) -> "Builder":
+        """Fetch training metrics and dispatch TrainingListener callbacks
+        every ``n`` iterations (coalesced, one host round-trip per window)
+        instead of exposing a per-iteration device sync point. Listeners
+        still receive EVERY iteration's scalar loss, already materialized.
+        ``n=1`` (default) keeps the legacy immediate cadence. Trade-off
+        (docs/HOST_PIPELINE.md): NaN panic / early-stopping style listeners
+        observe a step up to ``n-1`` iterations late."""
+        if n < 1:
+            raise ValueError(f"sync_every must be >= 1, got {n}")
+        self._sync_every = int(n)
         return self
 
     def list(self) -> "ListBuilder":
@@ -263,4 +284,5 @@ class ListBuilder:
             remat_policy=self._p._remat_policy,
             remat_stages=tuple(self._stage_bounds) or None,
             stage_barriers=self._p._stage_barriers,
+            sync_every=self._p._sync_every,
         )

@@ -8,11 +8,29 @@ Listener cost note: reading ``model.get_score()`` forces a device→host
 transfer of one scalar. ScoreIterationListener only does this every
 ``print_iterations`` — keeping the device pipeline free to run ahead
 (the async-dispatch equivalent of the reference's listener cadence).
+
+Sync-free orchestration (docs/HOST_PIPELINE.md): with ``sync_every > 1`` on
+the network conf, fit() routes iteration callbacks through
+:class:`CoalescingListenerDispatcher` — per-step device losses accumulate on
+device and are fetched in ONE stacked transfer per window, then listeners
+run back-to-back with already-materialized floats. Listeners still see every
+iteration (same (iteration, epoch, score) stream), just up to ``n-1``
+iterations late. Time-based listeners should read the push-time wall clock
+via :func:`iteration_wall_ns` instead of ``time.perf_counter`` — under
+coalesced dispatch "now" is flush time, not step time.
 """
 
 from __future__ import annotations
 
 import time
+
+
+def iteration_wall_ns(model) -> int:
+    """Wall-clock for the iteration being dispatched: the push-time stamp
+    under coalesced dispatch (model.last_iteration_wall_ns), or now under
+    the legacy immediate cadence."""
+    ns = getattr(model, "last_iteration_wall_ns", None)
+    return ns if ns is not None else time.perf_counter_ns()
 
 
 class TrainingListener:
@@ -21,6 +39,58 @@ class TrainingListener:
 
     def on_epoch_end(self, model) -> None:
         pass
+
+
+class CoalescingListenerDispatcher:
+    """Batches TrainingListener dispatch across a ``sync_every`` window.
+
+    Per step, fit() pushes the DEVICE loss scalar (no transfer, no sync) with
+    its iteration/epoch and a wall-clock stamp. Every ``sync_every`` pushes —
+    or at a flush point (epoch end, TBPTT handoff, end of fit) — the pending
+    losses are stacked and fetched in one host round-trip, then every
+    listener receives every pending iteration in order, with
+    ``model.score_value`` already a Python float. With ``sync_every=1`` or
+    no listeners installed the dispatcher is pass-through: exactly the
+    legacy cadence (and with no listeners, NO loss is ever fetched — the
+    device pipeline runs completely free)."""
+
+    def __init__(self, model, sync_every: int = 1):
+        self.model = model
+        self.sync_every = max(1, int(sync_every))
+        self._pending: list = []  # (iteration, epoch, device_loss, wall_ns)
+
+    def iteration_done(self, loss, iteration: int, epoch: int) -> None:
+        model = self.model
+        if self.sync_every <= 1:
+            for lst in model.listeners:
+                lst.iteration_done(model, iteration, epoch)
+            return
+        if not model.listeners:
+            return  # nobody observing: keep the step chain sync-free
+        self._pending.append((iteration, epoch, loss, time.perf_counter_ns()))
+        if len(self._pending) >= self.sync_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Fetch all pending losses in one transfer and dispatch in order."""
+        if not self._pending:
+            return
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        pending, self._pending = self._pending, []
+        vals = np.asarray(
+            jax.device_get(jnp.stack([jnp.asarray(p[2]) for p in pending])))
+        model = self.model
+        try:
+            for (it, ep, _, wall_ns), val in zip(pending, vals):
+                model.score_value = float(val)
+                model.last_iteration_wall_ns = wall_ns
+                for lst in model.listeners:
+                    lst.iteration_done(model, it, ep)
+        finally:
+            model.last_iteration_wall_ns = None
 
 
 class ScoreIterationListener(TrainingListener):
@@ -43,14 +113,14 @@ class PerformanceListener(TrainingListener):
         self._last_iter = 0
 
     def iteration_done(self, model, iteration, epoch):
-        now = time.perf_counter()
+        now = iteration_wall_ns(model) / 1e9  # step time under coalescing
         if self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
             return
         if iteration - self._last_iter >= self.frequency:
             dt = now - self._last_time
-            ips = (iteration - self._last_iter) / dt
+            ips = (iteration - self._last_iter) / dt if dt > 0 else float("inf")
             self.log(f"iteration {iteration}: {ips:.1f} iter/sec")
             self._last_time = now
             self._last_iter = iteration

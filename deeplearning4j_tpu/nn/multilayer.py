@@ -43,9 +43,14 @@ class MultiLayerNetwork:
         self.epoch = 0
         self.listeners: list = []
         self.score_value: float = float("nan")
+        self.last_iteration_wall_ns = None  # set during coalesced dispatch
         self._train_step = None
         self._it_dev = None   # device-resident iteration counter
         self._it_sync = -1    # host iteration the device counter mirrors
+        from deeplearning4j_tpu.nn.listeners import CoalescingListenerDispatcher
+
+        self._dispatcher = CoalescingListenerDispatcher(
+            self, getattr(conf, "sync_every", 1))
         self._updaters = [
             (lyr.updater or conf.updater or upd.Sgd(0.1)) for lyr in conf.layers
         ]
@@ -346,6 +351,7 @@ class MultiLayerNetwork:
         return self
 
     def _end_epoch(self):
+        self._dispatcher.flush()  # epoch-end callbacks see a complete epoch
         self.epoch += 1
         for lst in self.listeners:
             if hasattr(lst, "on_epoch_end"):
@@ -417,6 +423,7 @@ class MultiLayerNetwork:
                                  sub, ms, lms))
             self.iteration += 1
             losses.append(loss)
+        self._dispatcher.flush()  # keep cross-path dispatch ordering intact
         self.score_value = float(jnp.mean(jnp.stack(losses)))
         self.last_features = x  # full sequence, not the last TBPTT segment
         for lst in self.listeners:
@@ -483,8 +490,10 @@ class MultiLayerNetwork:
         self.last_features = x   # for listeners collecting activation stats
         self.iteration += 1
         self._it_sync = self.iteration
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        # sync_every=1: immediate dispatch (legacy cadence); >1: the device
+        # loss is queued and listeners fire in coalesced windows — one host
+        # round-trip per window instead of a sync point every iteration
+        self._dispatcher.iteration_done(loss, self.iteration, self.epoch)
 
     # -------------------------------------------------------------- pretrain
     def pretrain(self, data, epochs: int = 1):

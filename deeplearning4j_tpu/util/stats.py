@@ -103,7 +103,12 @@ class StatsListener:
         self._prev_params = None
 
     def iteration_done(self, model, iteration, epoch):
-        now = time.perf_counter_ns()
+        # push-time stamp under coalesced (sync_every>1) dispatch — at flush
+        # the callbacks run back-to-back, so perf_counter here would report
+        # near-zero iter_ms for every coalesced iteration
+        from deeplearning4j_tpu.nn.listeners import iteration_wall_ns
+
+        now = iteration_wall_ns(model)
         iter_ms = None if self._last_ns is None else (now - self._last_ns) / 1e6
         self._last_ns = now
         if iteration % self.frequency:

@@ -21,6 +21,60 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 from deeplearning4j_tpu.parallel import distributed  # noqa: E402
 
 
+def _local_dp(nproc, pid):
+    """``--local-dp`` mode (the __graft_entry__ DCN dryrun): prove the
+    CONTROL plane — gRPC coordinator bootstrap, global device view, process
+    roles — then run the DP step on this process's own addressable devices.
+    The cross-process data plane is probed but allowed to be unavailable:
+    this jaxlib's CPU backend rejects multiprocess computations ("Multiprocess
+    computations aren't implemented on the CPU backend"), a backend ceiling,
+    not a bootstrap defect — on TPU the same program spans the global mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_global, n_local = len(jax.devices()), len(jax.local_devices())
+    mesh = Mesh(np.array(jax.local_devices()), ("data",))
+
+    D = 8
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(D,)).astype(np.float32)
+    B = 4 * n_local
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    Y = X @ w_true
+    x = jax.device_put(X, NamedSharding(mesh, P("data")))
+    y = jax.device_put(Y, NamedSharding(mesh, P("data")))
+    w = jax.device_put(np.zeros((D,), np.float32), NamedSharding(mesh, P()))
+
+    @jax.jit
+    def step(w, x, y):
+        g = jax.grad(lambda w: jnp.mean((x @ w - y) ** 2))(w)
+        return w - 0.2 * g
+
+    for _ in range(30):
+        w = step(w, x, y)
+    err = float(np.abs(np.asarray(jax.device_get(w)) - w_true).max())
+
+    # opportunistic global-step probe: works on real multi-host backends,
+    # expected to be rejected by the CPU backend
+    try:
+        gmesh = distributed.global_mesh().mesh
+        xg = jax.make_array_from_process_local_data(
+            NamedSharding(gmesh, P("data")), X[: B // nproc])
+        jax.jit(lambda a: a * 2.0)(xg).block_until_ready()
+        global_step = "ok"
+    except Exception as e:  # noqa: BLE001
+        global_step = f"unavailable ({type(e).__name__})"
+
+    print(json.dumps({
+        "pid": pid,
+        "coordinator": distributed.is_coordinator(),
+        "n_devices_global": n_global,
+        "n_devices_local": n_local,
+        "local_dp_err": round(err, 6),
+        "global_step": global_step,
+    }), flush=True)
+    distributed.shutdown()
+
+
 def main():
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     distributed.initialize(coordinator=coordinator, num_processes=nproc,
@@ -28,6 +82,10 @@ def main():
     assert distributed.process_count() == nproc
     assert distributed.process_index() == pid
     assert distributed.is_coordinator() == (pid == 0)
+
+    if len(sys.argv) > 4 and sys.argv[4] == "--local-dp":
+        _local_dp(nproc, pid)
+        return
 
     tmesh = distributed.global_mesh()
     mesh = tmesh.mesh

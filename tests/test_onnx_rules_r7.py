@@ -1,0 +1,132 @@
+"""Round-7 ONNX importer tail (VERDICT Missing #1): NonMaxSuppression wired
+to the registry op, Hardmax added. Goldens: protomini-authored graphs against
+the ONNX spec's own NMS example vectors and a numpy Hardmax reference (no
+onnx package in the image — same strategy as the r5 rule tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.imports import import_onnx
+
+from test_imports import (  # noqa: E402
+    _onnx_attr_i,
+    _onnx_input,
+    _onnx_model,
+    _onnx_node,
+    _onnx_tensor,
+)
+
+R = np.random.default_rng(17)
+
+
+def _run(model_bytes, feeds, outs):
+    sd = import_onnx(model_bytes)
+    res = sd.output(feeds, outs)
+    return [np.asarray(res[o]) for o in outs]
+
+
+class TestHardmax:
+    @pytest.mark.parametrize("axis", [-1, 0, 1])
+    def test_matches_numpy(self, axis):
+        x = R.normal(size=(4, 5)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("Hardmax", ["x"], ["y"],
+                              _onnx_attr_i("axis", axis))],
+            initializers=[], inputs=[_onnx_input("x", (4, 5))], outputs=["y"])
+        (y,) = _run(model, {"x": x}, ["y"])
+        golden = np.zeros_like(x)
+        idx = np.argmax(x, axis=axis)
+        if axis % 2 == 0:
+            golden[idx, np.arange(5)] = 1.0
+        else:
+            golden[np.arange(4), idx] = 1.0
+        np.testing.assert_allclose(y, golden)
+
+    def test_default_axis_rank3(self):
+        x = R.normal(size=(2, 3, 4)).astype(np.float32)
+        model = _onnx_model(
+            nodes=[_onnx_node("Hardmax", ["x"], ["y"])],
+            initializers=[], inputs=[_onnx_input("x", (2, 3, 4))],
+            outputs=["y"])
+        (y,) = _run(model, {"x": x}, ["y"])
+        assert y.shape == x.shape
+        np.testing.assert_allclose(y.sum(axis=-1), np.ones((2, 3)))
+        np.testing.assert_allclose(np.argmax(y, axis=-1),
+                                   np.argmax(x, axis=-1))
+
+
+def _nms_model(num_boxes, num_classes=1, batch=1, center=0, with_score_th=False):
+    inputs = ["boxes", "scores", "max_out", "iou_th"]
+    inits = [
+        _onnx_tensor("max_out", np.asarray([3], np.int64)),
+        _onnx_tensor("iou_th", np.asarray([0.5], np.float32)),
+    ]
+    if with_score_th:
+        inputs.append("score_th")
+        inits.append(_onnx_tensor("score_th", np.asarray([0.4], np.float32)))
+    return _onnx_model(
+        nodes=[_onnx_node("NonMaxSuppression", inputs, ["sel"],
+                          _onnx_attr_i("center_point_box", center))],
+        initializers=inits,
+        inputs=[_onnx_input("boxes", (batch, num_boxes, 4)),
+                _onnx_input("scores", (batch, num_classes, num_boxes))],
+        outputs=["sel"])
+
+
+# the ONNX spec's own test vectors (onnx/backend/test/case/node/nonmaxsuppression.py)
+_SPEC_BOXES = np.asarray([[
+    [0.0, 0.0, 1.0, 1.0], [0.0, 0.1, 1.0, 1.1], [0.0, -0.1, 1.0, 0.9],
+    [0.0, 10.0, 1.0, 11.0], [0.0, 10.1, 1.0, 11.1], [0.0, 100.0, 1.0, 101.0],
+]], np.float32)
+_SPEC_SCORES = np.asarray([[[0.9, 0.75, 0.6, 0.95, 0.5, 0.3]]], np.float32)
+
+
+class TestNonMaxSuppression:
+    def test_spec_suppress_by_iou(self):
+        (sel,) = _run(_nms_model(6), {"boxes": _SPEC_BOXES,
+                                      "scores": _SPEC_SCORES}, ["sel"])
+        assert sel.shape == (3, 3)  # padded static variant: B*C*max_out rows
+        np.testing.assert_array_equal(
+            sel, np.asarray([[0, 0, 3], [0, 0, 0], [0, 0, 5]]))
+
+    def test_spec_score_threshold(self):
+        (sel,) = _run(_nms_model(6, with_score_th=True),
+                      {"boxes": _SPEC_BOXES, "scores": _SPEC_SCORES}, ["sel"])
+        # score_threshold 0.4 drops box 5 (0.3): third slot is -1 padding
+        np.testing.assert_array_equal(
+            sel, np.asarray([[0, 0, 3], [0, 0, 0], [-1, -1, -1]]))
+
+    def test_center_point_box_and_flipped_corners(self):
+        # same boxes expressed center-form must select identically
+        corners = _SPEC_BOXES[0]
+        centers = np.stack([
+            (corners[:, 1] + corners[:, 3]) / 2,  # x_center
+            (corners[:, 0] + corners[:, 2]) / 2,  # y_center
+            corners[:, 3] - corners[:, 1],        # width
+            corners[:, 0] - corners[:, 2],        # height (sign-free)
+        ], axis=-1)[None].astype(np.float32)
+        (sel_center,) = _run(
+            _nms_model(6, center=1),
+            {"boxes": np.abs(centers), "scores": _SPEC_SCORES}, ["sel"])
+        # flipped diagonal corners ([y2,x2,y1,x1]) normalize to the same boxes
+        flipped = _SPEC_BOXES[:, :, [2, 3, 0, 1]]
+        (sel_flip,) = _run(_nms_model(6),
+                           {"boxes": flipped, "scores": _SPEC_SCORES},
+                           ["sel"])
+        expected = np.asarray([[0, 0, 3], [0, 0, 0], [0, 0, 5]])
+        np.testing.assert_array_equal(sel_center, expected)
+        np.testing.assert_array_equal(sel_flip, expected)
+
+    def test_two_classes_two_batches(self):
+        boxes = np.concatenate([_SPEC_BOXES, _SPEC_BOXES])  # (2, 6, 4)
+        scores = np.concatenate(
+            [np.concatenate([_SPEC_SCORES, _SPEC_SCORES], axis=1)] * 2
+        )  # (2, 2, 6)
+        (sel,) = _run(_nms_model(6, num_classes=2, batch=2),
+                      {"boxes": boxes, "scores": scores}, ["sel"])
+        assert sel.shape == (2 * 2 * 3, 3)
+        per = np.asarray([3, 0, 5])
+        expected = np.concatenate([
+            np.stack([np.full(3, b), np.full(3, c), per], axis=-1)
+            for b in range(2) for c in range(2)])
+        np.testing.assert_array_equal(sel, expected)
