@@ -45,6 +45,19 @@ JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/test_host_pipeline.py -q
 
+echo "== step: Compile-cache tests (persistent cache, two runs warm/cold) =="
+# ISSUE 3: the bucketing/compile-once suite twice against ONE persistent
+# compilation_cache_dir (second run starts warm), then the sweep's --ci
+# assertions: warm-process compile count drops (cache hits > 0), bucketed
+# ragged epoch adds 0 extra traces, unbucketed adds >= 1.
+CC_DIR=$(mktemp -d /tmp/dl4j-ci-compile-cache.XXXXXX)
+JAX_PLATFORMS=cpu DL4J_TPU_COMPILE_CACHE="$CC_DIR" \
+    python -m pytest tests/test_compile_cache.py -q
+JAX_PLATFORMS=cpu DL4J_TPU_COMPILE_CACHE="$CC_DIR" \
+    python -m pytest tests/test_compile_cache.py -q
+JAX_PLATFORMS=cpu python benchmarks/compile_cache_sweep.py --ci
+rm -rf "$CC_DIR"
+
 echo "== step: Test (pytest, JAX_PLATFORMS=cpu, 8 virtual devices) =="
 JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

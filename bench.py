@@ -453,6 +453,159 @@ def bench_host_pipeline(batch: int = 64, n_batches: int = 12):
     }
 
 
+_RECOMPILE_CHILD = r"""
+import json, sys, time
+T0 = time.perf_counter()   # process-start reference for cold-start wall
+import jax
+jax.config.update("jax_platforms", "cpu")
+cache_dir = sys.argv[1] if len(sys.argv) > 1 and sys.argv[1] != "-" else None
+if cache_dir:
+    from deeplearning4j_tpu.util.compile_cache import enable_persistent_cache
+    enable_persistent_cache(cache_dir)
+import numpy as np
+from deeplearning4j_tpu.util import get_watcher
+
+w = get_watcher()   # install monitoring hooks BEFORE any compile happens
+from deeplearning4j_tpu.zoo import ResNet50
+
+# flagship topology, CPU-sized (the scaling child's config: same graph and
+# collective structure as 224px, small enough for the 1-core host)
+net = ResNet50(num_classes=16, input_shape=(32, 32, 3)).init()
+if cache_dir:
+    # full compile-once chain: the AOT lowering store (skips the warm
+    # process's Python trace + MLIR build) on top of the persistent cache
+    # (skips its backend compile) — docs/COMPILE_CACHE.md
+    import os
+    net.warmup(shapes=[(8, 32, 32, 3)], inference=False,
+               export_dir=os.path.join(cache_dir, "aot"))
+rng = np.random.default_rng(0)
+x = jax.device_put(rng.normal(size=(8, 32, 32, 3)).astype(np.float32))
+y = jax.device_put(np.eye(16, dtype=np.float32)[rng.integers(0, 16, 8)])
+step_walls = []
+t_first_done = None
+for _ in range(6):
+    t0 = time.perf_counter()
+    net._fit_batch(x, y)
+    float(net.score_value)   # completion fence per step (wall attribution)
+    step_walls.append(time.perf_counter() - t0)
+    if t_first_done is None:
+        t_first_done = time.perf_counter()
+# first stable step: first index whose wall is within 2x the best tail step
+floor = min(step_walls[1:])
+stable_at = next(i for i, t in enumerate(step_walls) if t <= 2 * floor)
+print(json.dumps({
+    "cold_start_s": round(t_first_done - T0, 3),  # launch -> first step done
+    "first_step_s": round(step_walls[0], 3),
+    "steady_step_s": round(floor, 4),
+    "steps_to_stable": stable_at,
+    "backend_compiles": w.backend_compiles,
+    "persistent_cache_hits": w.persistent_cache_hits,
+}))
+"""
+
+
+def bench_recompile_overhead(runs: int = 3):
+    """recompile_overhead: warm-persistent-cache cold-PROCESS start over the
+    uncached cold start, on the flagship-topology CPU-sized model (ResNet-50
+    32px — the scaling child's config). Each sample spawns two child
+    processes against one fresh ``compilation_cache_dir``: the first pays
+    every XLA compile (and populates the cache), the second deserializes.
+    Cold start = process launch to first completed train step. Target:
+    warm/cold <= 0.5 (BASELINE.md); median-of-{runs} with the standard
+    ``noise`` field. Also reports the ragged-tail compile-count A/B (0 extra
+    traces bucketed vs >= 1 unbucketed) measured in-process."""
+    import shutil
+    import tempfile
+
+    def child(cache_dir):
+        # scrub inherited DL4J_TPU_* knobs: an ambient compile-cache or
+        # bucketing env var would corrupt the cold/uncached baseline
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("DL4J_TPU_")}
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", _RECOMPILE_CHILD, cache_dir or "-"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = [l for l in out.stdout.strip().splitlines()
+                if l.startswith("{")][-1]
+        return json.loads(line)
+
+    pairs = []
+
+    def one_ratio():
+        td = tempfile.mkdtemp(prefix="dl4j_cc_bench_")
+        try:
+            cold = child(td)   # empty dir: every compile is real + persisted
+            warm = child(td)   # same dir, fresh process: deserialize
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+        r = warm["cold_start_s"] / cold["cold_start_s"]
+        pairs.append((r, cold, warm))
+        return r
+
+    ratio, noise = _med3(one_ratio, runs=runs)
+    # every reported companion figure comes from the MEDIAN-ratio sample —
+    # not run order — so the record is one internally consistent run
+    _, cold_med_run, warm_med_run = sorted(
+        pairs, key=lambda p: p[0])[len(pairs) // 2]
+    cold_med = cold_med_run["cold_start_s"]
+    warm_med = warm_med_run["cold_start_s"]
+    bucketed, unbucketed = _ragged_tail_traces()
+    return {
+        "metric": "recompile_overhead",
+        "model": ("zoo.ResNet50 32px classes=16 B=8 fp32 (flagship topology,"
+                  " CPU-sized); persistent XLA cache + AOT lowering store,"
+                  " cold vs warm process"),
+        "value": round(ratio, 4),
+        "noise": noise,
+        "unit": "x uncached cold-process start (lower is better)",
+        "cold_start_s": cold_med,
+        "warm_start_s": warm_med,
+        "warm_cache_hits": warm_med_run["persistent_cache_hits"],
+        "steps_to_stable_cold": cold_med_run["steps_to_stable"],
+        # ragged-tail epoch (N % B != 0): extra train-step traces beyond the
+        # first — 0 under bucketing, >= 1 without (compile_cache_sweep.py
+        # demonstrates the same on full epochs)
+        "ragged_extra_traces_bucketed": bucketed,
+        "ragged_extra_traces_unbucketed": unbucketed,
+        # <= 1.0 means the <= 0.5x warm-start target is met (BASELINE.md)
+        "vs_baseline": round(ratio / 0.5, 4),
+    }
+
+
+def _ragged_tail_traces():
+    """(bucketed, unbucketed) EXTRA train-step traces for a ragged-tail
+    epoch (beyond the one expected full-batch compile)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.util import get_watcher
+
+    def run(buckets):
+        # explicit on both axes so an ambient DL4J_TPU_BUCKETS can never
+        # bucket the "unbucketed" baseline of this A/B
+        b = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+             .batch_buckets(buckets).seq_buckets(None))
+        conf = (b.list()
+                .layer(DenseLayer(n_in=16, n_out=32, activation="relu"))
+                .layer(OutputLayer(n_in=32, n_out=10))
+                .set_input_type(InputType.feed_forward(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 16)).astype(np.float32)  # 20 % 8 = 4 ragged
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 20)]
+        w = get_watcher()
+        with w.scope() as s:
+            net.fit(ArrayDataSetIterator(x, y, batch=8), epochs=2)
+            return s.traces_of("MultiLayerNetwork.train_step") - 1
+    return run((8,)), run(None)
+
+
 def main():
     import jax
 
@@ -497,6 +650,11 @@ def main():
                                          n_batches=24))
     except Exception as e:
         print(f"host pipeline bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        extra.append(bench_recompile_overhead())
+    except Exception as e:
+        print(f"recompile overhead bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     result["extra_metrics"] = extra
     print(json.dumps(result))

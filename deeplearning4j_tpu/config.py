@@ -29,6 +29,14 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
 - ``DL4J_TPU_ETL_WORKERS`` — worker-process count for the multiprocess
   TransformProcess executor (datavec/executor.py); 0/unset = one per host
   core, capped at 8 (the reference sizes Spark executors the same way).
+- ``DL4J_TPU_BUCKETS`` — default shape-bucketing spec for new configs
+  ("pow2" | "batch=8,16,32;seq=pow2" — data/bucketing.py,
+  docs/COMPILE_CACHE.md): ragged batches pad to a fixed bucket set so the
+  jitted step compiles once per bucket. TPU-native; the closest reference
+  knob is cudnnAlgoMode's compile-once-per-shape algo selection.
+- ``DL4J_TPU_COMPILE_CACHE`` — directory for the persistent on-disk XLA
+  compilation cache (util/compile_cache.py): a restarted process
+  deserializes executables instead of recompiling. Empty/unset = off.
 """
 
 from __future__ import annotations
@@ -75,7 +83,11 @@ class Environment:
             self.default_remat_policy = None
         self.default_sync_every = _env_int("DL4J_TPU_SYNC_EVERY", 1, floor=1)
         self.etl_workers = _env_int("DL4J_TPU_ETL_WORKERS", 0, floor=0)
+        self.default_buckets = os.environ.get("DL4J_TPU_BUCKETS") or None
+        self.compile_cache_dir = (
+            os.environ.get("DL4J_TPU_COMPILE_CACHE") or None)
         self._profiler = None
+        self._compile_cache_applied = False
 
     @classmethod
     def get_instance(cls) -> "Environment":
@@ -121,6 +133,15 @@ class Environment:
         # profiling never install competing exec_op hooks; only touch its
         # config while the FLAGS own the hook — a user-started profiler's
         # settings are never clobbered by unrelated setter calls
+        # persistent compilation cache: wire jax_compilation_cache_dir once
+        # (idempotent; later enable_persistent_cache() calls can re-point it)
+        if self.compile_cache_dir and not self._compile_cache_applied:
+            from deeplearning4j_tpu.util.compile_cache import (
+                enable_persistent_cache)
+
+            enable_persistent_cache(self.compile_cache_dir)
+            self._compile_cache_applied = True
+
         want_hook = self.profiling or self.nan_panic or self.debug
         prof = OpProfiler.get_instance()
         if want_hook:

@@ -7,6 +7,7 @@ round. ETL breadth (DataVec record readers, TransformProcess) arrives in the
 utils/etl milestone.
 """
 
+from deeplearning4j_tpu.data.bucketing import BucketingPolicy  # noqa: F401
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.data.image_iterator import (  # noqa: F401
     AsyncImageDataSetIterator,

@@ -1,0 +1,309 @@
+"""Shape bucketing — pad ragged batches/sequences to a small fixed bucket set
+so the jitted train/eval step compiles ONCE per bucket instead of once per
+distinct shape (docs/COMPILE_CACHE.md).
+
+Every ragged last batch (N % B != 0), TBPTT remainder, and odd eval batch is
+a fresh XLA program. A :class:`BucketingPolicy` rounds the batch dim (and
+optionally the time dim) up to the nearest bucket, padding with zeros, and
+carries a per-example validity weight vector so the padded rows contribute
+EXACTLY zero to losses and gradients:
+
+- padded feature/label rows are all-zero; per-example weight 0 gates them
+  out of the loss sum (the ``weights`` path every OutputLayer already has);
+- the weighted-mean normalizer divides by the REAL example count via a
+  reciprocal multiply that is bit-identical to ``jnp.mean`` of the unpadded
+  batch (ops/nn.py ``_weighted_mean`` — XLA strength-reduces divide-by-
+  constant to multiply-by-reciprocal, so the padded path must multiply by
+  the runtime reciprocal to land on the same bits);
+- when bucketing is active, weights are attached to EVERY batch (all-ones
+  for full batches), keeping one jit signature for the whole epoch — a
+  ragged tail then triggers ZERO extra traces.
+
+Bit-identity holds for row-independent topologies (dense, conv forward,
+recurrent): see docs/COMPILE_CACHE.md "when not to bucket" for the two
+exceptions (BatchNorm training statistics see padded rows; conv WEIGHT
+gradients reassociate across batch sizes at ulp level).
+
+Time-axis bucketing pads (B, T, F) sequences to a bucketed T with zero
+features and zero label-mask entries, creating masks when the batch had
+none — mask-aware layers and loss heads already gate on them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+BucketSpec = Union[None, str, Tuple[int, ...]]  # None | "pow2" | explicit
+
+
+def dev_weights(cache: dict, size: int, real: int):
+    """Device-resident 0/1 loss-weights vector, memoized in ``cache`` by
+    (size, real-count) — the prefix-ones structure is fully determined by
+    the pair. fit() threads one of these on EVERY batch (ones when nothing
+    was padded), so re-uploading a host vector per step never happens.
+    Shared by MultiLayerNetwork and ComputationGraph."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (int(size), int(real))
+    w = cache.get(key)
+    if w is None:
+        arr = np.zeros(key[0], np.float32)
+        arr[:key[1]] = 1.0
+        w = jax.device_put(jnp.asarray(arr))
+        cache[key] = w
+    return w
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1)."""
+    if n <= 1:
+        return 1
+    return 1 << (int(n) - 1).bit_length()
+
+
+def _normalize(spec: BucketSpec) -> BucketSpec:
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        if spec.lower() != "pow2":
+            raise ValueError(
+                f"bucket spec must be 'pow2' or an explicit size list, "
+                f"got {spec!r}")
+        return "pow2"
+    sizes = tuple(sorted({int(s) for s in spec}))
+    if not sizes or any(s < 1 for s in sizes):
+        raise ValueError(f"bucket sizes must be positive ints, got {spec!r}")
+    return sizes
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketingPolicy:
+    """Rounding rules for the batch and time axes.
+
+    ``batch_buckets`` / ``seq_buckets``: ``None`` (axis not bucketed),
+    ``"pow2"`` (round up to the next power of two), or an explicit sorted
+    size list (round up to the smallest bucket >= n; sizes ABOVE the largest
+    bucket pass through unpadded — each such size keeps its own compile,
+    loudly visible in the CompileWatcher)."""
+
+    batch_buckets: BucketSpec = None
+    seq_buckets: BucketSpec = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "batch_buckets",
+                           _normalize(self.batch_buckets))
+        object.__setattr__(self, "seq_buckets", _normalize(self.seq_buckets))
+
+    # ------------------------------------------------------------- factories
+    @staticmethod
+    def from_conf(conf) -> Optional["BucketingPolicy"]:
+        """Policy from a network conf's knobs, or None when both are off."""
+        bb = getattr(conf, "batch_buckets", None)
+        sb = getattr(conf, "seq_buckets", None)
+        if bb is None and sb is None:
+            return None
+        return BucketingPolicy(batch_buckets=bb, seq_buckets=sb)
+
+    @staticmethod
+    def from_spec(spec: str) -> Optional["BucketingPolicy"]:
+        """Parse the ``DL4J_TPU_BUCKETS`` string form:
+
+        - ``"pow2"``                     → batch axis pow2
+        - ``"batch=8,16,32"``            → explicit batch buckets
+        - ``"batch=pow2;seq=64,128"``    → both axes
+        - ``""`` / ``"none"``            → None (off)
+        """
+        spec = (spec or "").strip()
+        if not spec or spec.lower() == "none":
+            return None
+        if "=" not in spec:
+            return BucketingPolicy(batch_buckets=_normalize(spec))
+        kw = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip().lower()
+            if key not in ("batch", "seq"):
+                raise ValueError(
+                    f"DL4J_TPU_BUCKETS: unknown axis {key!r} "
+                    "(want batch=…;seq=…)")
+            val = val.strip()
+            kw[key + "_buckets"] = (
+                val if val.lower() == "pow2"
+                else tuple(int(v) for v in val.split(",") if v.strip()))
+        return BucketingPolicy(**kw)
+
+    def to_spec(self) -> str:
+        parts = []
+        for axis, spec in (("batch", self.batch_buckets),
+                           ("seq", self.seq_buckets)):
+            if spec is None:
+                continue
+            parts.append(
+                f"{axis}={spec if spec == 'pow2' else ','.join(map(str, spec))}")
+        return ";".join(parts)
+
+    # -------------------------------------------------------------- rounding
+    @staticmethod
+    def _round(n: int, spec: BucketSpec) -> int:
+        if spec is None:
+            return n
+        if spec == "pow2":
+            return next_pow2(n)
+        for b in spec:
+            if b >= n:
+                return b
+        return n  # above the largest bucket: pass through, own compile
+
+    def bucket_batch(self, n: int) -> int:
+        return self._round(int(n), self.batch_buckets)
+
+    def bucket_seq(self, t: int) -> int:
+        return self._round(int(t), self.seq_buckets)
+
+    # --------------------------------------------------------------- padding
+    @staticmethod
+    def _pad_axis(a: np.ndarray, axis: int, target: int) -> np.ndarray:
+        if a.shape[axis] == target:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, target - a.shape[axis])
+        return np.pad(a, widths)
+
+    def pad_batch(self, x, y, mask=None, label_mask=None):
+        """Pad one training batch to its buckets.
+
+        Returns ``(x, y, mask, label_mask, weights)`` as host numpy arrays
+        (padding runs on the host so no pad-program compiles pollute the
+        compile counts). ``weights`` is ALWAYS a (B',) float32 0/1 vector —
+        attached even to full batches so the jit signature stays constant
+        across the epoch. Time padding extends/creates (B, T) masks with
+        zeros over the padded steps; 2-D (per-sequence) labels keep their
+        shape."""
+        x = np.asarray(x)
+        y = np.asarray(y)
+        mask = None if mask is None else np.asarray(mask)
+        label_mask = None if label_mask is None else np.asarray(label_mask)
+        n = x.shape[0]
+
+        if self.seq_buckets is not None and x.ndim == 3:
+            t = x.shape[1]
+            tp = self.bucket_seq(t)
+            if mask is None:
+                mask = np.ones((n, t), np.float32)
+            if label_mask is None and y.ndim == 3:
+                label_mask = np.ones((n, t), np.float32)
+            if tp != t:
+                x = self._pad_axis(x, 1, tp)
+                mask = self._pad_axis(mask, 1, tp)
+                if y.ndim == 3:
+                    y = self._pad_axis(y, 1, tp)
+                if label_mask is not None:
+                    label_mask = self._pad_axis(label_mask, 1, tp)
+
+        np_ = self.bucket_batch(n)
+        weights = np.zeros(np_, np.float32)
+        weights[:n] = 1.0
+        if np_ != n:
+            x = self._pad_axis(x, 0, np_)
+            y = self._pad_axis(y, 0, np_)
+            if mask is not None:
+                mask = self._pad_axis(mask, 0, np_)
+            if label_mask is not None:
+                label_mask = self._pad_axis(label_mask, 0, np_)
+        return x, y, mask, label_mask, weights
+
+    def pad_graph_batch(self, features: Sequence, labels: Sequence,
+                        mask=None, label_mask=None):
+        """ComputationGraph form: ``features``/``labels`` are lists of
+        (B, ...) arrays; masks are a shared array, a name→array dict, or
+        None. Returns the same structure plus the (B',) weights vector."""
+        feats = [np.asarray(f) for f in features]
+        labs = [np.asarray(l) for l in labels]
+        n = feats[0].shape[0]
+
+        def pad_seq_leaf(a):
+            if self.seq_buckets is None or a is None or a.ndim != 3:
+                return a
+            return self._pad_axis(a, 1, self.bucket_seq(a.shape[1]))
+
+        def pad_seq_mask(m):
+            if self.seq_buckets is None or m is None:
+                return m
+            return self._pad_axis(m, 1, self.bucket_seq(m.shape[1]))
+
+        def map_mask(m, fn):
+            if m is None:
+                return None
+            if isinstance(m, dict):
+                return {k: (None if v is None else fn(np.asarray(v)))
+                        for k, v in m.items()}
+            return fn(np.asarray(m))
+
+        feats = [pad_seq_leaf(f) for f in feats]
+        labs = [pad_seq_leaf(l) for l in labs]
+        mask = map_mask(mask, pad_seq_mask)
+        label_mask = map_mask(label_mask, pad_seq_mask)
+
+        np_ = self.bucket_batch(n)
+        weights = np.zeros(np_, np.float32)
+        weights[:n] = 1.0
+        if np_ != n:
+            batch_pad = lambda a: self._pad_axis(a, 0, np_)  # noqa: E731
+            feats = [batch_pad(f) for f in feats]
+            labs = [batch_pad(l) for l in labs]
+            mask = map_mask(mask, batch_pad)
+            label_mask = map_mask(label_mask, batch_pad)
+        return feats, labs, mask, label_mask, weights
+
+    def pad_inference_batch(self, x) -> Tuple[np.ndarray, int]:
+        """Pad a forward/eval batch (rows only); returns (padded, real_n).
+        Row-independent layers leave the real rows bit-identical; callers
+        slice ``[:real_n]``."""
+        x = np.asarray(x)
+        n = x.shape[0]
+        np_ = self.bucket_batch(n)
+        return (self._pad_axis(x, 0, np_) if np_ != n else x), n
+
+    def pad_segment(self, arrays: Any, mask, label_mask, seg_len: int):
+        """Normalize one TBPTT segment onto the (B, seg_len) signature: the
+        tail remainder (T < seg_len) pads up with zero features/labels and
+        zero mask entries, and FULL segments get all-ones masks when the
+        batch had none — so every segment, tail or not, traces exactly one
+        program. ``arrays`` is a dict of name→array (ComputationGraph) or a
+        (x, y) tuple (MultiLayerNetwork)."""
+
+        def pad_t(a):
+            return (None if a is None else
+                    (self._pad_axis(np.asarray(a), 1, seg_len)
+                     if getattr(a, "ndim", 0) == 3
+                     and a.shape[1] < seg_len else np.asarray(a)))
+
+        leaves = list(arrays.values()) if isinstance(arrays, dict) else arrays
+        ref = next((a for a in leaves if getattr(a, "ndim", 0) == 3),
+                   leaves[0])
+        n, t = ref.shape[0], min(ref.shape[1], seg_len)
+        if mask is None:
+            mask = np.ones((n, t), np.float32)
+        if label_mask is None:
+            label_mask = np.ones((n, t), np.float32)
+
+        def pad_m(m):
+            if m is None:
+                return None
+            if isinstance(m, dict):
+                return {k: pad_m(v) for k, v in m.items()}
+            m = np.asarray(m)
+            return self._pad_axis(m, 1, seg_len) if m.shape[1] < seg_len else m
+
+        if isinstance(arrays, dict):
+            out = {k: pad_t(v) for k, v in arrays.items()}
+        else:
+            out = tuple(pad_t(v) for v in arrays)
+        return out, pad_m(mask), pad_m(label_mask)

@@ -1455,6 +1455,34 @@ def _q_range(np_dtype):
     return float(info.min), float(info.max)
 
 
+def _q_per_axis(m, scale_name, scale_var, op_name):
+    """Per-axis detection for Quantize/DequantizeLinear (ADVICE r5 #2).
+
+    A 1-D scale of size > 1 means per-axis. The const value decides when
+    available; otherwise the DECLARED shape must — a non-constant 1-D
+    scale of unknown size must fail loudly, never silently broadcast
+    per-tensor along the wrong axis."""
+    sc_val = m.const_vals.get(scale_name)
+    if sc_val is not None:
+        return sc_val.ndim == 1 and sc_val.size > 1
+    shape = scale_var.shape
+    if shape is None:
+        raise NotImplementedError(
+            f"{op_name}: scale {scale_name!r} is not a constant and has no "
+            "declared shape — cannot decide per-tensor vs per-axis")
+    if len(shape) == 0 or (len(shape) == 1 and shape[0] == 1):
+        return False
+    if len(shape) == 1:
+        if shape[0] is None or shape[0] < 0:
+            raise NotImplementedError(
+                f"{op_name}: scale {scale_name!r} has dynamic size "
+                f"{shape} — cannot decide per-tensor vs per-axis")
+        return True
+    raise NotImplementedError(
+        f"{op_name}: scale {scale_name!r} has rank-{len(shape)} shape "
+        f"{shape}; the spec allows scalar or 1-D only")
+
+
 @orule("QuantizeLinear")
 def _o_quantize_linear(m, node):
     x = m.get(node.inputs[0])
@@ -1468,9 +1496,7 @@ def _o_quantize_linear(m, node):
     else:
         qdt = np.dtype(np.uint8)
     qmin, qmax = _q_range(qdt)
-    sc_shape = m.const_vals.get(node.inputs[1])
-    per_axis = (sc_shape is not None and sc_shape.ndim == 1
-                and sc_shape.size > 1)
+    per_axis = _q_per_axis(m, node.inputs[1], scale, "QuantizeLinear")
     if per_axis:
         if rank is None:
             raise NotImplementedError("per-axis QuantizeLinear needs rank")
@@ -1495,8 +1521,7 @@ def _o_dequantize_linear(m, node):
     axis = node.attr("axis", 1)
     rank = len(x.shape) if x.shape is not None else None
     xf = m.sd._op("cast", [x], attrs=dict(dtype=np.float32))
-    sc_val = m.const_vals.get(node.inputs[1])
-    per_axis = sc_val is not None and sc_val.ndim == 1 and sc_val.size > 1
+    per_axis = _q_per_axis(m, node.inputs[1], scale, "DequantizeLinear")
     if m.has_input(node, 2):
         zp = m.sd._op("cast", [m.get(node.inputs[2])],
                       attrs=dict(dtype=np.float32))

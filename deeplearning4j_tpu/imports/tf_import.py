@@ -1877,10 +1877,28 @@ def _tf_diag_part(m, node):
 
 @rule("MatrixDiagV3")
 def _tf_matrix_diag_v3(m, node):
-    k = m.const(m.inputs(node)[1])
+    ins = m.inputs(node)
+    k = m.const(ins[1])
     if np.any(np.asarray(k) != 0):
         raise UnsupportedOpError("MatrixDiagV3 k != 0")
-    m.set(node.name, m.sd._op("matrix_diag", [m.get(m.inputs(node)[0])],
+    # inputs 2-4 (num_rows, num_cols, padding_value) shape the output: the
+    # lowering only implements the square/default form, so non-default
+    # values must fail loudly instead of yielding a silently wrong square
+    # matrix (ADVICE r5 #4)
+    for idx, name, default in ((2, "num_rows", -1), (3, "num_cols", -1)):
+        if len(ins) > idx:
+            v = np.asarray(m.const(ins[idx]))
+            if np.any(v != default):
+                raise UnsupportedOpError(
+                    f"MatrixDiagV3 {name}={v.tolist()} (only the default "
+                    f"{default} square form is supported)")
+    if len(ins) > 4:
+        pv = np.asarray(m.const(ins[4]))
+        if np.any(pv != 0):
+            raise UnsupportedOpError(
+                f"MatrixDiagV3 padding_value={pv.tolist()} (only 0 "
+                "is supported)")
+    m.set(node.name, m.sd._op("matrix_diag", [m.get(ins[0])],
                               name=node.name))
 
 
@@ -1930,8 +1948,14 @@ def _tf_segment_extra(m, node):
     ns = int(np.asarray(seg_val).max()) + 1
     opn = {"SegmentMax": "segment_max", "SegmentMin": "segment_min",
            "SegmentProd": "segment_prod"}[node.op]
-    m.set(node.name, m.sd._op(opn, [data, seg],
-                              attrs=dict(num_segments=ns), name=node.name))
+    attrs = dict(num_segments=ns)
+    if node.op in ("SegmentMax", "SegmentMin"):
+        # SORTED SegmentMax/Min document a 0 fill for empty segments; the
+        # unsorted kernels these lower to fill with dtype ±lowest/highest
+        # instead (ADVICE r5 #5). SegmentProd's identity fill (1) already
+        # matches TF.
+        attrs["empty_fill"] = 0
+    m.set(node.name, m.sd._op(opn, [data, seg], attrs=attrs, name=node.name))
 
 
 @rule("TensorScatterAdd")

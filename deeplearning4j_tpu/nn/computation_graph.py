@@ -32,10 +32,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.nn import vertices as V
-from deeplearning4j_tpu.nn.conf import _detuple
+from deeplearning4j_tpu.nn.conf import (_buckets_from_json, _buckets_to_json,
+                                        _detuple)
+from deeplearning4j_tpu.nn.multilayer import _dispatch_sig, _struct_of
+from deeplearning4j_tpu.util.compile_watcher import note_trace
 
 
 @dataclasses.dataclass
@@ -70,6 +74,11 @@ class ComputationGraphConfiguration:
     # Sync-free step orchestration (docs/HOST_PIPELINE.md): coalesce the loss
     # fetch + TrainingListener dispatch into one host round-trip per window.
     sync_every: int = 1
+    # Shape bucketing (docs/COMPILE_CACHE.md, data/bucketing.py): pad ragged
+    # batches (and optionally the time axis) to a fixed bucket set so the
+    # jitted step compiles once per bucket. None | "pow2" | explicit tuple.
+    batch_buckets: Any = None
+    seq_buckets: Any = None
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -89,6 +98,8 @@ class ComputationGraphConfiguration:
                 if self.remat_stages else None,
                 "stage_barriers": self.stage_barriers,
                 "sync_every": self.sync_every,
+                "batch_buckets": _buckets_to_json(self.batch_buckets),
+                "seq_buckets": _buckets_to_json(self.seq_buckets),
                 "nodes": [
                     {
                         "name": n.name,
@@ -131,6 +142,8 @@ class ComputationGraphConfiguration:
             if d.get("remat_stages") else None,
             stage_barriers=d.get("stage_barriers", False),
             sync_every=d.get("sync_every", 1),
+            batch_buckets=_buckets_from_json(d.get("batch_buckets")),
+            seq_buckets=_buckets_from_json(d.get("seq_buckets")),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -245,6 +258,8 @@ class GraphBuilder:
             remat_stages=tuple(self._stage_ends) or None,
             stage_barriers=getattr(self._p, "_stage_barriers", False),
             sync_every=getattr(self._p, "_sync_every", 1),
+            batch_buckets=getattr(self._p, "_batch_buckets", None),
+            seq_buckets=getattr(self._p, "_seq_buckets", None),
         )
 
 
@@ -329,6 +344,18 @@ class ComputationGraph:
                     f"SharedLayer {n.name!r} references unknown source "
                     f"{n.node.source!r}")
         self._segments = self._build_segments()
+        # Shape bucketing (data/bucketing.py) + AOT-warmed executables
+        self._bucketing = BucketingPolicy.from_conf(conf)
+        self._aot_steps: dict = {}
+        self._aot_forward: dict = {}
+        # device-resident 0/1 weights cache — fit always threads weights so
+        # bucketed == unbucketed program (data/bucketing.py dev_weights)
+        self._w_cache: dict = {}
+
+    def _dev_weights(self, size: int, real: int):
+        from deeplearning4j_tpu.data.bucketing import dev_weights
+
+        return dev_weights(self._w_cache, size, real)
 
     # ------------------------------------------- fusion-boundary segmentation
     def _build_segments(self):
@@ -515,6 +542,7 @@ class ComputationGraph:
     def _forward(self, params, states, inputs, *, training, keys=None,
                  mask=None):
         """inputs: dict name->array. Returns (dict name->activation, states)."""
+        note_trace("ComputationGraph.forward", inputs, mask)  # trace-time only
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
         new_states = dict(states)
@@ -699,7 +727,7 @@ class ComputationGraph:
         }
 
     def _loss_tbptt(self, params, states, carries, inputs, labels, keys,
-                    mask=None, label_mask=None):
+                    mask=None, label_mask=None, weights=None):
         """_loss variant for one TBPTT segment: recurrent nodes take carries
         in and hand carries out; gradients truncate at the segment boundary
         because the incoming carry is a plain argument."""
@@ -723,7 +751,7 @@ class ComputationGraph:
                       if isinstance(label_mask, dict) else label_mask)
                 out_loss = n.node.compute_loss(
                     cparams[n.name], states[n.name], x, labels[n.name],
-                    training=True, key=keys[n.name],
+                    training=True, key=keys[n.name], weights=weights,
                     **self._loss_mask_kw(n.node, mk, lm, x),
                 )
                 loss = loss + out_loss.astype(
@@ -759,12 +787,15 @@ class ComputationGraph:
         layer_names = [n.name for n in self.topo if n.is_layer]
 
         def step(params, states, opts, carries, iteration, inputs, labels,
-                 key, mask, label_mask):
+                 key, mask, label_mask, weights=None):
+            note_trace("ComputationGraph.tbptt_step", inputs, labels, weights,
+                       mask, label_mask)
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 self._loss_tbptt, has_aux=True
-            )(params, states, carries, inputs, labels, keys, mask, label_mask)
+            )(params, states, carries, inputs, labels, keys, mask, label_mask,
+              weights)
             new_params, new_opts = dict(params), dict(opts)
             for name in layer_names:
                 if not grads[name]:
@@ -783,6 +814,32 @@ class ComputationGraph:
         truncated; every segment is one updater step (update-per-segment, as
         in the reference)."""
         k = self.conf.tbptt_length
+        real_n = next(iter(inputs.values())).shape[0]
+        if self._bucketing is not None:
+            # batch axis: pad rows + 0/1 weights (segments pad individually
+            # below — no whole-sequence time padding here). Keep the whole
+            # segment loop in HOST numpy: pad_segment would otherwise sync
+            # device->host for every segment slice.
+            inputs = {kk: np.asarray(v) for kk, v in inputs.items()}
+            labs = {kk: np.asarray(v) for kk, v in labs.items()}
+            to_np = lambda m: (m if m is None else  # noqa: E731
+                               ({kk: (None if v is None else np.asarray(v))
+                                 for kk, v in m.items()}
+                                if isinstance(m, dict) else np.asarray(m)))
+            mask, label_mask = to_np(mask), to_np(label_mask)
+            npad = self._bucketing.bucket_batch(real_n)
+            if npad != real_n:
+                bpad = lambda a: (None if a is None else  # noqa: E731
+                                  np.pad(a, [(0, npad - real_n)] +
+                                         [(0, 0)] * (np.ndim(a) - 1)))
+                inputs = {kk: bpad(v) for kk, v in inputs.items()}
+                labs = {kk: bpad(v) for kk, v in labs.items()}
+                pad_m = lambda m: (m if m is None else  # noqa: E731
+                                   ({kk: bpad(v) for kk, v in m.items()}
+                                    if isinstance(m, dict) else bpad(m)))
+                mask, label_mask = pad_m(mask), pad_m(label_mask)
+        weights = self._dev_weights(
+            next(iter(inputs.values())).shape[0], real_n)
         T = next(v.shape[1] for v in inputs.values() if v.ndim == 3)
         ref = next(iter(inputs.values()))
         carries = self._init_carries(ref.shape[0], self._cast(ref).dtype)
@@ -803,11 +860,19 @@ class ComputationGraph:
         for s in range(0, T, k):
             ms = seg_mask(mask, s)
             lms = seg_mask(label_mask, s)
+            seg_in, seg_lab = seg(inputs, s), seg(labs, s)
+            if self._bucketing is not None:
+                # tail remainder pads to k; full segments get all-ones masks
+                # — one jit signature for every segment (data/bucketing.py)
+                seg_in, ms, lms = self._bucketing.pad_segment(
+                    seg_in, ms, lms, k)
+                seg_lab, _, _ = self._bucketing.pad_segment(
+                    seg_lab, None, None, k)
             self._rng_key, sub = jax.random.split(self._rng_key)
             (self.params, self.states, self.opt_states, carries, loss) = (
                 self._tbptt_step(self.params, self.states, self.opt_states,
                                  carries, jnp.asarray(self.iteration),
-                                 seg(inputs, s), seg(labs, s), sub, ms, lms))
+                                 seg_in, seg_lab, sub, ms, lms, weights))
             self.iteration += 1
             losses.append(loss)
         self._dispatcher.flush()  # keep cross-path dispatch ordering intact
@@ -934,13 +999,16 @@ class ComputationGraph:
         """Iteration counter + RNG-key evolution live INSIDE the jitted step
         (see MultiLayerNetwork._build_train_step: avoids two host round-trips
         per step through the remote-chip tunnel)."""
-        base = self.make_step_fn()
+        base = self.make_step_fn(weighted=True)
 
         def step(params, states, opt_states, iteration, key, inputs, labels,
-                 mask=None, label_mask=None):
+                 weights=None, mask=None, label_mask=None):
+            # trace-time only: one retrace == one CompileWatcher line
+            note_trace("ComputationGraph.train_step", inputs, labels, weights,
+                       mask, label_mask)
             new_key, sub = jax.random.split(key)
             p, s, o, loss = base(params, states, opt_states, iteration,
-                                 inputs, labels, sub,
+                                 inputs, labels, sub, weights=weights,
                                  mask=mask, label_mask=label_mask)
             return p, s, o, loss, iteration + 1, new_key
 
@@ -1008,8 +1076,10 @@ class ComputationGraph:
             for ds in data:
                 feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
                 labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
+                # raw arrays through: _fit_batch pads (bucketing) on the
+                # host before the one host->device transfer
                 self._fit_batch(
-                    [jnp.asarray(f) for f in feats], [jnp.asarray(l) for l in labs],
+                    list(feats), list(labs),
                     mask=_mask_dict(ds, self.conf.inputs,
                                     "features_mask", "features_masks"),
                     label_mask=_mask_dict(ds, self.conf.outputs,
@@ -1030,35 +1100,136 @@ class ComputationGraph:
             features = [features]
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
-        inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
-        labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
         if (self.conf.tbptt_length
-                and any(v.ndim == 3 for v in inputs.values())
-                and all(v.ndim == 3 for v in labs.values())
-                and next(v.shape[1] for v in inputs.values()
-                         if v.ndim == 3) > self.conf.tbptt_length):
+                and any(np.ndim(v) == 3 for v in features)
+                and all(np.ndim(v) == 3 for v in labels)
+                and next(v.shape[1] for v in features
+                         if np.ndim(v) == 3) > self.conf.tbptt_length):
             # per-sequence (2-D) labels cannot be segmented: whole-sequence
             # BPTT instead, as the reference's doTruncatedBPTT does
+            inputs = dict(zip(self.conf.inputs,
+                              [jnp.asarray(f) for f in features]))
+            labs = dict(zip(self.conf.outputs,
+                            [jnp.asarray(l) for l in labels]))
             return self._fit_batch_tbptt(
                 inputs, labs, mask=_as_mask(mask),
                 label_mask=_as_mask(label_mask))
+        real_n = np.shape(features[0])[0]
+        if self._bucketing is not None:
+            # host-side padding: every batch carries the 0/1 weights vector
+            # so the epoch keeps one jit signature per bucket
+            features, labels, mask, label_mask, _ = (
+                self._bucketing.pad_graph_batch(features, labels, mask,
+                                                label_mask))
+        # always-weighted: ones over real rows, zeros over padding
+        weights = self._dev_weights(np.shape(features[0])[0], real_n)
+        inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in features]))
+        labs = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labels]))
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._jit_train_step()
         if self._it_dev is None or self._it_sync != self.iteration:
             self._it_dev = jax.device_put(jnp.asarray(self.iteration, jnp.int32))
+        mk, lmk = _as_mask(mask), _as_mask(label_mask)
+        step = self._aot_steps.get(
+            _dispatch_sig(inputs, labs, weights, mk, lmk), self._train_step)
         (self.params, self.states, self.opt_states, loss,
-         self._it_dev, self._rng_key) = self._train_step(
+         self._it_dev, self._rng_key) = step(
             self.params, self.states, self.opt_states, self._it_dev,
-            self._rng_key, inputs, labs,
-            mask=_as_mask(mask), label_mask=_as_mask(label_mask),
+            self._rng_key, inputs, labs, weights, mk, lmk,
         )
         self.score_value = loss
-        self.last_features = tuple(features)  # for activation-stats listeners
+        # activation-stats listeners must never see fabricated padding rows
+        self.last_features = tuple(
+            f if real_n == np.shape(f)[0] else f[:real_n] for f in features)
         self.iteration += 1
         self._it_sync = self.iteration
         # sync_every=1: immediate dispatch (legacy cadence); >1: coalesced
         # windows — one host round-trip per window (docs/HOST_PIPELINE.md)
         self._dispatcher.iteration_done(loss, self.iteration, self.epoch)
+
+    # ------------------------------------------------------------ AOT warmup
+    def warmup(self, shapes=None, *, train=True, inference=True,
+               dtype=jnp.float32, export_dir=None):
+        """Ahead-of-time compile the train step / inference forward for every
+        bucket (``jit(...).lower().compile()``) — the ComputationGraph twin
+        of :meth:`MultiLayerNetwork.warmup`. ``shapes``: iterable of batch
+        signatures; each entry is one shape per graph input INCLUDING the
+        batch dim (a bare tuple is accepted for single-input graphs, e.g.
+        ``[(8, 32), (16, 32)]``). Defaults to the explicit ``batch_buckets``
+        list x ``conf.input_shapes``. ``export_dir``: on-disk AOT lowering
+        store (util/aot_store.py) — a later process deserializes the
+        lowered module and skips the Python trace; see
+        :meth:`MultiLayerNetwork.warmup` for the donation trade-off.
+        Returns the number of executables built/loaded."""
+        if not self.params:
+            raise ValueError("init() the graph before warmup()")
+        store = None
+        if export_dir is not None:
+            from deeplearning4j_tpu.util.aot_store import AotStore
+
+            store = AotStore(export_dir)
+        if shapes is None:
+            if self.conf.input_shapes is None:
+                raise ValueError(
+                    "warmup() needs shapes= or conf.input_shapes")
+            if (self._bucketing is None
+                    or not isinstance(self._bucketing.batch_buckets, tuple)):
+                raise ValueError(
+                    "warmup() without shapes= needs explicit batch_buckets "
+                    "on the conf (pow2 has no finite bucket list)")
+            shapes = [
+                [(b,) + tuple(s) for s in self.conf.input_shapes]
+                for b in self._bucketing.batch_buckets
+            ]
+        built = 0
+        p_s, s_s, o_s = (_struct_of(self.params), _struct_of(self.states),
+                         _struct_of(self.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        key_s = _struct_of(self._rng_key)
+        for entry in shapes:
+            if entry and not isinstance(entry[0], (list, tuple)):
+                entry = [entry]  # single-input graph, bare shape
+            if len(entry) != len(self.conf.inputs):
+                raise ValueError(
+                    f"warmup entry has {len(entry)} shapes for "
+                    f"{len(self.conf.inputs)} graph inputs")
+            b = int(entry[0][0])
+            ins_s = {
+                name: jax.ShapeDtypeStruct(tuple(int(d) for d in shape),
+                                           dtype)
+                for name, shape in zip(self.conf.inputs, entry)
+            }
+            labs_s = {
+                name: jax.ShapeDtypeStruct((b,) + tuple(self._shape_of[name]),
+                                           jnp.float32)
+                for name in self.conf.outputs
+            }
+            # fit always threads a weights vector (ones when unbucketed)
+            w_s = jax.ShapeDtypeStruct((b,), jnp.float32)
+            if train:
+                if self._train_step is None:
+                    self._train_step = self._jit_train_step()
+                sig = _dispatch_sig(ins_s, labs_s, w_s, None, None)
+                if sig not in self._aot_steps:
+                    self._aot_steps[sig] = self._aot_build(
+                        store, "cg_train_step", sig, self._train_step,
+                        (p_s, s_s, o_s, it_s, key_s, ins_s, labs_s, w_s,
+                         None, None), {})
+                    built += 1
+            if inference:
+                fsig = (False, _dispatch_sig(ins_s, None))
+                if fsig not in self._aot_forward:
+                    self._aot_forward[fsig] = self._aot_build(
+                        store, "cg_forward", fsig, self._forward_jit,
+                        (p_s, s_s, ins_s), {"mask": None})
+                    built += 1
+        return built
+
+    def _aot_build(self, store, tag, sig, jit_fn, args, kwargs):
+        from deeplearning4j_tpu.util.aot_store import aot_build
+
+        return aot_build(store, tag, self.conf.to_json(), sig, jit_fn,
+                         args, kwargs)
 
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):
@@ -1079,11 +1250,20 @@ class ComputationGraph:
         ``train=True`` uses training-mode statistics but no dropout (no RNG
         threaded, matching the reference's output(train)). ``mask``: (B,T)
         feature mask for sequence graphs."""
+        real_n = None
+        if self._bucketing is not None and mask is None:
+            padded = [self._bucketing.pad_inference_batch(x) for x in inputs]
+            if any(p.shape[0] != n for p, n in padded):
+                real_n = padded[0][1]
+            inputs = [p for p, _ in padded]
         ins = dict(zip(self.conf.inputs, [jnp.asarray(x) for x in inputs]))
+        mk = None if mask is None else jnp.asarray(mask)
         fwd = self._forward_train_jit if train else self._forward_jit
-        acts, _ = fwd(self.params, self.states, ins,
-                      mask=None if mask is None else jnp.asarray(mask))
+        aot = self._aot_forward.get((bool(train), _dispatch_sig(ins, mk)))
+        acts, _ = (aot or fwd)(self.params, self.states, ins, mask=mk)
         outs = [acts[name] for name in self.conf.outputs]
+        if real_n is not None:
+            outs = [o[:real_n] for o in outs]
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs):
@@ -1104,11 +1284,17 @@ class ComputationGraph:
                                         "labels_mask", "labels_masks")
         feats = x if isinstance(x, (list, tuple)) else [x]
         labs = y if isinstance(y, (list, tuple)) else [y]
+        real_n = np.shape(feats[0])[0]
+        if self._bucketing is not None:
+            feats, labs, mask, label_mask, _ = (
+                self._bucketing.pad_graph_batch(feats, labs, mask,
+                                                label_mask))
+        weights = self._dev_weights(np.shape(feats[0])[0], real_n)
         inputs = dict(zip(self.conf.inputs, [jnp.asarray(f) for f in feats]))
         labels = dict(zip(self.conf.outputs, [jnp.asarray(l) for l in labs]))
         loss = self._loss_eval(
             self.params, self.states, inputs, labels,
-            _as_mask(mask), _as_mask(label_mask))
+            _as_mask(mask), _as_mask(label_mask), weights)
         return float(loss)
 
     @functools.cached_property
@@ -1117,7 +1303,10 @@ class ComputationGraph:
         MultiLayerNetwork.score parity."""
         out_names = set(self.conf.outputs)
 
-        def eval_loss(params, states, inputs, labels, mask, label_mask):
+        def eval_loss(params, states, inputs, labels, mask, label_mask,
+                      weights=None):
+            note_trace("ComputationGraph.loss_eval", inputs, labels, mask,
+                       label_mask, weights)
             acts = {k: self._cast(v) for k, v in inputs.items()}
             cparams = self._cast_params(params)
             produced = dict(mask) if isinstance(mask, dict) else None
@@ -1135,7 +1324,7 @@ class ComputationGraph:
                           if isinstance(label_mask, dict) else label_mask)
                     loss = loss + n.node.compute_loss(
                         cparams[n.name], states[n.name], x, labels[n.name],
-                        training=False,
+                        training=False, weights=weights,
                         **self._loss_mask_kw(n.node, mk, lm, x),
                     )
                     acts[n.name] = x

@@ -63,6 +63,12 @@ class MultiLayerConfiguration:
     # ``sync_every`` iterations (coalesced, one host round-trip per window)
     # instead of exposing a device sync point every iteration.
     sync_every: int = 1
+    # Shape bucketing (docs/COMPILE_CACHE.md): pad ragged batches (and
+    # optionally the time axis) up to a fixed bucket set so the jitted step
+    # compiles once per bucket, not once per shape. None (off), "pow2", or
+    # an explicit size tuple per axis — see data/bucketing.py.
+    batch_buckets: Any = None
+    seq_buckets: Any = None
 
     def to_json(self) -> str:
         return json.dumps(
@@ -77,6 +83,8 @@ class MultiLayerConfiguration:
                 if self.remat_stages else None,
                 "stage_barriers": self.stage_barriers,
                 "sync_every": self.sync_every,
+                "batch_buckets": _buckets_to_json(self.batch_buckets),
+                "seq_buckets": _buckets_to_json(self.seq_buckets),
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -107,7 +115,22 @@ class MultiLayerConfiguration:
             if d.get("remat_stages") else None,
             stage_barriers=d.get("stage_barriers", False),
             sync_every=d.get("sync_every", 1),
+            batch_buckets=_buckets_from_json(d.get("batch_buckets")),
+            seq_buckets=_buckets_from_json(d.get("seq_buckets")),
         )
+
+
+def _buckets_to_json(spec):
+    """Bucket spec → JSON value: None | "pow2" | [sizes]."""
+    if spec is None or spec == "pow2":
+        return spec
+    return list(spec)
+
+
+def _buckets_from_json(v):
+    if v is None or v == "pow2":
+        return v
+    return tuple(v)
 
 
 def _detuple(v):
@@ -147,6 +170,18 @@ class Builder:
                 raise ValueError(f"DL4J_TPU_REMAT_POLICY: {e}") from None
         self._stage_barriers = False
         self._sync_every = env.default_sync_every
+        self._batch_buckets = None
+        self._seq_buckets = None
+        if env.default_buckets:
+            from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+
+            try:  # fail fast: a typo'd env spec must not survive to fit()
+                pol = BucketingPolicy.from_spec(env.default_buckets)
+            except ValueError as e:
+                raise ValueError(f"DL4J_TPU_BUCKETS: {e}") from None
+            if pol is not None:
+                self._batch_buckets = pol.batch_buckets
+                self._seq_buckets = pol.seq_buckets
 
     def seed(self, s: int) -> "Builder":
         self._seed = s
@@ -214,6 +249,33 @@ class Builder:
         if n < 1:
             raise ValueError(f"sync_every must be >= 1, got {n}")
         self._sync_every = int(n)
+        return self
+
+    def batch_buckets(self, spec) -> "Builder":
+        """Shape bucketing for the batch axis (docs/COMPILE_CACHE.md):
+        ``"pow2"`` or an explicit size list (e.g. ``[8, 16, 32]``). Ragged
+        batches pad up to the nearest bucket with zero rows carrying loss
+        weight 0 — losses/gradients stay bit-identical to unpadded execution
+        while the jitted step keeps ONE signature per bucket. ``None``
+        turns it off."""
+        from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+
+        if spec is not None:  # fail fast on malformed specs
+            BucketingPolicy(batch_buckets=spec)
+        self._batch_buckets = spec
+        return self
+
+    def seq_buckets(self, spec) -> "Builder":
+        """Shape bucketing for the time axis: pad (B, T, F) sequences up to
+        a bucketed T with zero features and zero-mask entries (masks are
+        created when the batch had none). Also pads TBPTT tail segments to
+        the full segment length. ``"pow2"``, an explicit size list, or
+        ``None`` (off)."""
+        from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+
+        if spec is not None:
+            BucketingPolicy(seq_buckets=spec)
+        self._seq_buckets = spec
         return self
 
     def list(self) -> "ListBuilder":
@@ -285,4 +347,6 @@ class ListBuilder:
             remat_stages=tuple(self._stage_bounds) or None,
             stage_barriers=self._p._stage_barriers,
             sync_every=self._p._sync_every,
+            batch_buckets=self._p._batch_buckets,
+            seq_buckets=self._p._seq_buckets,
         )

@@ -93,6 +93,37 @@ class CoalescingListenerDispatcher:
             model.last_iteration_wall_ns = None
 
 
+class RecompileListener(TrainingListener):
+    """Recompile observability on the listener bus (docs/COMPILE_CACHE.md):
+    after a ``grace`` of initial iterations (the expected cold compiles),
+    any NEW trace of a watched function is logged with its per-shape
+    attribution — the signal that a ragged batch / TBPTT remainder / eval
+    shape is silently paying trace+compile in the training loop. Collected
+    events stay on ``.events`` for tests and harnesses."""
+
+    def __init__(self, grace: int = 1, log_fn=print):
+        from deeplearning4j_tpu.util.compile_watcher import get_watcher
+
+        self.grace = grace
+        self.log = log_fn
+        self.events: list = []  # (iteration, fn_name, new_trace_count)
+        self._watcher = get_watcher()
+        self._seen: dict = dict(self._watcher.traces)
+
+    def iteration_done(self, model, iteration, epoch):
+        cur = self._watcher.traces
+        for fn, n in cur.items():
+            prev = self._seen.get(fn, 0)
+            if n > prev and iteration > self.grace:
+                self.events.append((iteration, fn, n - prev))
+                shapes = self._watcher.shapes.get(fn, {})
+                last = next(reversed(list(shapes))) if shapes else "?"
+                self.log(
+                    f"RECOMPILE at iteration {iteration}: {fn} retraced "
+                    f"(+{n - prev}, total {n}) for signature {last}")
+        self._seen = dict(cur)
+
+
 class ScoreIterationListener(TrainingListener):
     def __init__(self, print_iterations: int = 10, log_fn=print):
         self.print_iterations = print_iterations

@@ -27,7 +27,13 @@ def mcxent_probs(probs, labels, eps=1e-7, weights=None):
     p = jnp.clip(probs, eps, 1.0)
     per = -jnp.sum(labels * jnp.log(p), axis=-1)
     if weights is not None:
-        return jnp.sum(per * weights) / jnp.maximum(jnp.sum(weights), 1e-12)
+        if weights.ndim < per.ndim:
+            weights = weights.reshape(
+                weights.shape + (1,) * (per.ndim - weights.ndim))
+        w = jnp.broadcast_to(weights, per.shape)
+        # reciprocal multiply, not divide — bit-identical to jnp.mean for
+        # 0/1 padding weights (see ops/nn.py _weighted_mean)
+        return jnp.sum(per * w) * (1.0 / jnp.maximum(jnp.sum(w), 1e-12))
     return jnp.mean(per)
 
 

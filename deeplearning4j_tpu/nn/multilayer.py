@@ -27,9 +27,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.util.compile_watcher import note_trace
+
+
+def _struct_of(tree):
+    """Pytree → matching ShapeDtypeStruct tree (AOT warmup operands)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def _dispatch_sig(*args):
+    """Shape/dtype signature of the data operands of one step/forward call —
+    the key for the AOT-compiled executable table (warmup). Handles arrays,
+    ShapeDtypeStructs, None, and (for ComputationGraph) dicts/lists of them."""
+    from deeplearning4j_tpu.util.compile_watcher import _shape_of
+
+    return tuple(_shape_of(a) for a in args)
 
 
 class MultiLayerNetwork:
@@ -64,6 +81,23 @@ class MultiLayerNetwork:
             "mask" in inspect.signature(self.layers[-1].compute_loss).parameters
         )
         self._segments = self._build_segments()
+        # Shape bucketing (data/bucketing.py): ragged batches pad to a fixed
+        # bucket set with 0-weighted rows; None when both knobs are off.
+        self._bucketing = BucketingPolicy.from_conf(conf)
+        # AOT-warmed executables (warmup()): dispatch signature → compiled.
+        self._aot_steps: dict = {}
+        self._aot_forward: dict = {}
+        # Device-resident 0/1 weight vectors keyed by (size, real-count):
+        # fit ALWAYS threads per-example weights (ones when unbucketed), so
+        # bucketed and unbucketed batches execute the SAME weighted-loss
+        # program — the bit-identity invariant (data/bucketing.py
+        # dev_weights).
+        self._w_cache: dict = {}
+
+    def _dev_weights(self, size: int, real: int):
+        from deeplearning4j_tpu.data.bucketing import dev_weights
+
+        return dev_weights(self._w_cache, size, real)
 
     # ------------------------------------------- fusion-boundary segmentation
     def _build_segments(self):
@@ -133,6 +167,7 @@ class MultiLayerNetwork:
         )
 
     def _forward(self, params, states, x, *, training, keys=None, mask=None):
+        note_trace("MultiLayerNetwork.forward", x, mask)  # trace-time only
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states = []
@@ -313,13 +348,17 @@ class MultiLayerNetwork:
         host->device transfer for the iteration counter, no tiny device
         program for jax.random.split — both cost whole round-trips through
         the remote-chip tunnel)."""
-        base = self.make_step_fn()
+        base = self.make_step_fn(weighted=True)
 
         def step(params, states, opt_states, iteration, key, x, y,
-                 mask=None, label_mask=None):
+                 weights=None, mask=None, label_mask=None):
+            # trace-time only: one retrace == one line in the CompileWatcher
+            note_trace("MultiLayerNetwork.train_step", x, y, weights, mask,
+                       label_mask)
             new_key, sub = jax.random.split(key)
             p, s, o, loss = base(params, states, opt_states, iteration, x, y,
-                                 sub, mask=mask, label_mask=label_mask)
+                                 sub, weights=weights, mask=mask,
+                                 label_mask=label_mask)
             return p, s, o, loss, iteration + 1, new_key
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
@@ -340,12 +379,12 @@ class MultiLayerNetwork:
             if hasattr(data, "reset"):
                 data.reset()
             for ds in data:
+                # arrays pass through untouched: _fit_batch pads (bucketing)
+                # on the HOST before the one host->device transfer
                 self._fit_batch(
-                    jnp.asarray(ds.features), jnp.asarray(ds.labels),
-                    mask=None if getattr(ds, "features_mask", None) is None
-                    else jnp.asarray(ds.features_mask),
-                    label_mask=None if getattr(ds, "labels_mask", None) is None
-                    else jnp.asarray(ds.labels_mask),
+                    ds.features, ds.labels,
+                    mask=getattr(ds, "features_mask", None),
+                    label_mask=getattr(ds, "labels_mask", None),
                 )
             self._end_epoch()
         return self
@@ -370,16 +409,19 @@ class MultiLayerNetwork:
         updaters = self._updaters
         n_layers = len(self.layers)
 
-        def seg_loss(params, states, carries, x, y, keys, mask, label_mask):
-            return self._loss_body(params, states, carries, x, y, keys, None,
-                                   mask, label_mask)
+        def seg_loss(params, states, carries, x, y, keys, weights, mask,
+                     label_mask):
+            return self._loss_body(params, states, carries, x, y, keys,
+                                   weights, mask, label_mask)
 
         def step(params, states, opt_states, carries, iteration, x, y, key,
-                 mask, label_mask):
+                 mask, label_mask, weights=None):
+            note_trace("MultiLayerNetwork.tbptt_step", x, y, weights, mask,
+                       label_mask)
             keys = list(jax.random.split(key, n_layers))
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 seg_loss, has_aux=True
-            )(params, states, carries, x, y, keys, mask, label_mask)
+            )(params, states, carries, x, y, keys, weights, mask, label_mask)
             new_params, new_opts = [], []
             for i in range(n_layers):
                 if not grads[i]:
@@ -406,6 +448,29 @@ class MultiLayerNetwork:
         iteration (update-per-segment semantics — Adam bias correction and
         LR schedules advance per update, as in the reference)."""
         k = self.conf.tbptt_length
+        real_n = np.shape(x)[0]
+        if self._bucketing is not None:
+            # batch axis: pad rows + 0/1 weights (bit-identical, like the
+            # non-TBPTT path). Time axis is NOT whole-sequence padded here —
+            # each segment pads individually below, so every tail remainder
+            # lands on the same (B, k) signature. The whole segment loop
+            # stays in HOST numpy (slice/pad on host, ONE upload per step) —
+            # slicing a device array per segment would sync device->host
+            # for every pad_segment call.
+            x = np.asarray(x)
+            y = np.asarray(y)
+            npad = self._bucketing.bucket_batch(real_n)
+            if npad != real_n:
+                pad = lambda a: (None if a is None else  # noqa: E731
+                                 np.pad(np.asarray(a),
+                                        [(0, npad - real_n)] +
+                                        [(0, 0)] * (np.ndim(a) - 1)))
+                x, y, mask, label_mask = pad(x), pad(y), pad(mask), pad(label_mask)
+        else:
+            # unbucketed: device-resident slicing (no host round trips)
+            x = jnp.asarray(x)
+            y = jnp.asarray(y)
+        weights = self._dev_weights(np.shape(x)[0], real_n)
         T = x.shape[1]
         # carries live in the compute dtype: an fp32 carry would promote the
         # recurrent matmuls and silently drop the bf16/MXU policy
@@ -416,11 +481,17 @@ class MultiLayerNetwork:
             ys = y[:, s:s + k] if y.ndim == 3 else y
             ms = None if mask is None else mask[:, s:s + k]
             lms = None if label_mask is None else label_mask[:, s:s + k]
+            if self._bucketing is not None:
+                # pad the tail remainder up to k (masks zero over the pad)
+                # AND attach all-ones masks to full segments, so every
+                # segment — tail or not — shares ONE jit signature
+                (xs, ys), ms, lms = self._bucketing.pad_segment(
+                    (xs, ys), ms, lms, k)
             self._rng_key, sub = jax.random.split(self._rng_key)
             (self.params, self.states, self.opt_states, carries, loss) = (
                 self._tbptt_step(self.params, self.states, self.opt_states,
                                  carries, jnp.asarray(self.iteration), xs, ys,
-                                 sub, ms, lms))
+                                 sub, ms, lms, weights))
             self.iteration += 1
             losses.append(loss)
         self._dispatcher.flush()  # keep cross-path dispatch ordering intact
@@ -472,22 +543,51 @@ class MultiLayerNetwork:
         self._rnn_carries = None
 
     def _fit_batch(self, x, y, mask=None, label_mask=None):
+        # fit() passes DataSet arrays through raw (bucketing pads on the
+        # host); coerce list-typed inputs here without touching arrays that
+        # are already on device (np.asarray on a jnp array would sync)
+        if not hasattr(x, "ndim"):
+            x = np.asarray(x)
+        if not hasattr(y, "ndim"):
+            y = np.asarray(y)
+        if mask is not None and not hasattr(mask, "ndim"):
+            mask = np.asarray(mask)
+        if label_mask is not None and not hasattr(label_mask, "ndim"):
+            label_mask = np.asarray(label_mask)
         if (self.conf.tbptt_length and x.ndim == 3 and y.ndim == 3
                 and x.shape[1] > self.conf.tbptt_length):
             # per-sequence (2-D) labels cannot be segmented: fall back to
             # whole-sequence BPTT, as the reference's doTruncatedBPTT does
             return self._fit_batch_tbptt(x, y, mask=mask, label_mask=label_mask)
+        real_n = np.shape(x)[0]
+        if self._bucketing is not None:
+            # host-side padding (numpy): no pad-program compiles, and the
+            # weights vector is attached to EVERY batch so the epoch keeps
+            # one jit signature per bucket (ragged tail => 0 extra traces)
+            x, y, mask, label_mask, _ = self._bucketing.pad_batch(
+                x, y, mask, label_mask)
         if self._train_step is None:  # cleared by external training masters
             self._train_step = self._build_train_step()
         if self._it_dev is None or self._it_sync != self.iteration:
             self._it_dev = jax.device_put(jnp.asarray(self.iteration, jnp.int32))
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        # always-weighted: ones over the real rows, zeros over padding
+        weights = self._dev_weights(x.shape[0], real_n)
+        mask = None if mask is None else jnp.asarray(mask)
+        label_mask = None if label_mask is None else jnp.asarray(label_mask)
+        # AOT-warmed executable for this signature if warmup() built one
+        # (zero retrace/compile risk on the serving path), else the jit path
+        step = self._aot_steps.get(
+            _dispatch_sig(x, y, weights, mask, label_mask), self._train_step)
         (self.params, self.states, self.opt_states, loss,
-         self._it_dev, self._rng_key) = self._train_step(
+         self._it_dev, self._rng_key) = step(
             self.params, self.states, self.opt_states, self._it_dev,
-            self._rng_key, x, y, mask=mask, label_mask=label_mask,
+            self._rng_key, x, y, weights, mask, label_mask,
         )
         self.score_value = loss  # fetched lazily; float() forces transfer
-        self.last_features = x   # for listeners collecting activation stats
+        # activation-stats listeners must never see fabricated padding rows
+        self.last_features = x if real_n == x.shape[0] else x[:real_n]
         self.iteration += 1
         self._it_sync = self.iteration
         # sync_every=1: immediate dispatch (legacy cadence); >1: the device
@@ -550,6 +650,86 @@ class MultiLayerNetwork:
             self.score_value = loss
         return self
 
+    # ------------------------------------------------------------ AOT warmup
+    def warmup(self, shapes=None, *, train=True, inference=True,
+               dtype=jnp.float32, export_dir=None):
+        """Ahead-of-time compile the train step and/or inference forward for
+        every bucket BEFORE traffic arrives (``jit(...).lower().compile()``),
+        so the first real batch executes a pre-built binary instead of
+        paying trace+compile in the serving path (docs/COMPILE_CACHE.md).
+
+        ``shapes``: iterable of full input shapes INCLUDING the batch dim
+        (e.g. ``[(8, 28, 28, 1), (16, 28, 28, 1)]``). Defaults to the
+        explicit ``batch_buckets`` list x ``conf.input_shape``. The compiled
+        executables are kept per signature and dispatched directly by
+        fit()/output(); with a persistent compilation cache enabled the
+        lowering also lands on disk for the NEXT process.
+
+        ``export_dir``: directory for the on-disk AOT LOWERING store
+        (util/aot_store.py): the first process serializes the lowered
+        module, a later process deserializes it and skips the Python
+        trace + MLIR build entirely — combined with the persistent
+        compilation cache, a restarted server's warmup is deserialize-only.
+        Trade-off: the loaded path does not donate buffers (an extra
+        params/opt-state copy per step) — right for serving and short
+        fine-tunes. Returns the number of executables built/loaded."""
+        if not self.params:
+            raise ValueError("init() the network before warmup()")
+        if shapes is None:
+            if self.conf.input_shape is None:
+                raise ValueError("warmup() needs shapes= or conf.input_shape")
+            if (self._bucketing is None
+                    or not isinstance(self._bucketing.batch_buckets, tuple)):
+                raise ValueError(
+                    "warmup() without shapes= needs explicit batch_buckets "
+                    "on the conf (pow2 has no finite bucket list)")
+            shapes = [(b,) + tuple(self.conf.input_shape)
+                      for b in self._bucketing.batch_buckets]
+        store = None
+        if export_dir is not None:
+            from deeplearning4j_tpu.util.aot_store import AotStore
+
+            store = AotStore(export_dir)
+        built = 0
+        p_s, s_s, o_s = (_struct_of(self.params), _struct_of(self.states),
+                         _struct_of(self.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        key_s = _struct_of(self._rng_key)
+        for shape in shapes:
+            shape = tuple(int(d) for d in shape)
+            b = shape[0]
+            x_s = jax.ShapeDtypeStruct(shape, dtype)
+            y_s = jax.ShapeDtypeStruct((b,) + tuple(self._output_shape),
+                                       jnp.float32)
+            # fit always threads a weights vector (ones when unbucketed)
+            w_s = jax.ShapeDtypeStruct((b,), jnp.float32)
+            if train:
+                if self._train_step is None:
+                    self._train_step = self._build_train_step()
+                sig = _dispatch_sig(x_s, y_s, w_s, None, None)
+                if sig not in self._aot_steps:
+                    self._aot_steps[sig] = self._aot_build(
+                        store, "mln_train_step", sig, self._train_step,
+                        (p_s, s_s, o_s, it_s, key_s, x_s, y_s, w_s, None,
+                         None), {})
+                    built += 1
+            if inference:
+                # inference path pads rows but carries no weights; both
+                # train=False and train=True forwards share one lowering rule
+                fsig = (False, _dispatch_sig(x_s, None))
+                if fsig not in self._aot_forward:
+                    self._aot_forward[fsig] = self._aot_build(
+                        store, "mln_forward", fsig, self._forward_jit,
+                        (p_s, s_s, x_s), {"mask": None})
+                    built += 1
+        return built
+
+    def _aot_build(self, store, tag, sig, jit_fn, args, kwargs):
+        from deeplearning4j_tpu.util.aot_store import aot_build
+
+        return aot_build(store, tag, self.conf.to_json(), sig, jit_fn,
+                         args, kwargs)
+
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):
         """fn(params, states, x) -> output activations (serving wrappers)."""
@@ -565,11 +745,22 @@ class MultiLayerNetwork:
         apply() gives dense+activation, i.e. probabilities. ``train=True``
         uses training-mode statistics (e.g. batchnorm batch stats) but no
         dropout (no RNG is threaded, matching the reference's output(train)).
-        ``mask``: (B,T) feature mask (output(x, fMask) parity)."""
+        ``mask``: (B,T) feature mask (output(x, fMask) parity).
+
+        Under shape bucketing, a ragged batch pads up to its bucket and the
+        padded rows are sliced off the result — row-independent layers leave
+        the real rows bit-identical while eval keeps one compile per bucket."""
+        real_n = None
+        if self._bucketing is not None and mask is None:
+            x, real_n = self._bucketing.pad_inference_batch(x)
+            if real_n == x.shape[0]:
+                real_n = None
         mk = None if mask is None else jnp.asarray(mask)
+        x = jnp.asarray(x)
         fn = self._forward_train_jit if train else self._forward_jit
-        out, _ = fn(self.params, self.states, jnp.asarray(x), mask=mk)
-        return out
+        aot = self._aot_forward.get((bool(train), _dispatch_sig(x, mk)))
+        out, _ = (aot or fn)(self.params, self.states, x, mask=mk)
+        return out if real_n is None else out[:real_n]
 
     def feed_forward(self, x):
         """Per-layer activations (MultiLayerNetwork.feedForward parity)."""
@@ -587,18 +778,27 @@ class MultiLayerNetwork:
             x, y = dataset.features, dataset.labels
             mask = getattr(dataset, "features_mask", None)
             label_mask = getattr(dataset, "labels_mask", None)
+        real_n = np.shape(x)[0]
+        if self._bucketing is not None:
+            x, y, mask, label_mask, _ = self._bucketing.pad_batch(
+                x, y, mask, label_mask)
         mk = None if mask is None else jnp.asarray(mask)
         lmk = None if label_mask is None else jnp.asarray(label_mask)
+        x = jnp.asarray(x)
         loss, _ = self._loss_eval(
-            self.params, self.states, jnp.asarray(x), jnp.asarray(y), mk, lmk)
+            self.params, self.states, x, jnp.asarray(y), mk, lmk,
+            self._dev_weights(x.shape[0], real_n))
         return float(loss)
 
     @functools.cached_property
     def _loss_eval(self):
-        def eval_loss(params, states, x, y, mask, label_mask):
+        def eval_loss(params, states, x, y, mask, label_mask, weights=None):
+            note_trace("MultiLayerNetwork.loss_eval", x, y, mask, label_mask,
+                       weights)
             keys = [None] * len(self.layers)
-            loss, _ = self._loss_body(params, states, None, x, y, keys, None,
-                                      mask, label_mask, training=False)
+            loss, _ = self._loss_body(params, states, None, x, y, keys,
+                                      weights, mask, label_mask,
+                                      training=False)
             return loss, None
 
         return jax.jit(eval_loss)

@@ -480,8 +480,16 @@ def _weighted_mean(per_example, weights):
             weights = weights.reshape(
                 weights.shape + (1,) * (per_example.ndim - weights.ndim))
         wfull = jnp.broadcast_to(weights, per_example.shape)
-        return (jnp.sum(per_example * wfull)
-                / jnp.maximum(jnp.sum(wfull), 1e-12))
+        # reciprocal-MULTIPLY normalizer, not a divide: XLA strength-reduces
+        # jnp.mean's divide-by-constant into multiply-by-reciprocal, so a
+        # runtime divide here would land one ulp off the unweighted mean.
+        # With the multiply, a 0/1-weighted padded batch is BIT-identical to
+        # the unpadded jnp.mean path — the invariant shape bucketing
+        # (data/bucketing.py) is built on. All-zero weights yield loss 0
+        # (0 * the clamped reciprocal); fractional weight sums below 1 keep
+        # their true normalizer.
+        return jnp.sum(per_example * wfull) * (
+            1.0 / jnp.maximum(jnp.sum(wfull), 1e-12))
     return jnp.mean(per_example)
 
 

@@ -372,18 +372,35 @@ def segment_sum(data, segment_ids, num_segments):
     return jax.ops.segment_sum(data, segment_ids, num_segments)
 
 
-@op("segment_max", "segment", aliases=("unsorted_segment_max",), differentiable=False)
-def segment_max(data, segment_ids, num_segments):
+def _fill_empty_segments(out, segment_ids, num_segments, fill):
+    """Overwrite empty-segment rows (±inf/identity fill from the unsorted
+    kernels) with ``fill`` — TF's SORTED SegmentMax/Min document a 0 fill."""
     import jax.ops
 
-    return jax.ops.segment_max(data, segment_ids, num_segments)
+    counts = jax.ops.segment_sum(
+        jnp.ones(segment_ids.shape, jnp.int32), segment_ids, num_segments)
+    present = (counts > 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    return jnp.where(present, out, jnp.asarray(fill, out.dtype))
+
+
+@op("segment_max", "segment", aliases=("unsorted_segment_max",), differentiable=False)
+def segment_max(data, segment_ids, num_segments, empty_fill=None):
+    import jax.ops
+
+    out = jax.ops.segment_max(data, segment_ids, num_segments)
+    if empty_fill is None:
+        return out  # unsorted semantics: dtype-lowest fill
+    return _fill_empty_segments(out, segment_ids, num_segments, empty_fill)
 
 
 @op("segment_min", "segment", aliases=("unsorted_segment_min",), differentiable=False)
-def segment_min(data, segment_ids, num_segments):
+def segment_min(data, segment_ids, num_segments, empty_fill=None):
     import jax.ops
 
-    return jax.ops.segment_min(data, segment_ids, num_segments)
+    out = jax.ops.segment_min(data, segment_ids, num_segments)
+    if empty_fill is None:
+        return out  # unsorted semantics: dtype-highest fill
+    return _fill_empty_segments(out, segment_ids, num_segments, empty_fill)
 
 
 @op("segment_mean", "segment", aliases=("unsorted_segment_mean",), differentiable=False)

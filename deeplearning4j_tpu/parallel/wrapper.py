@@ -100,6 +100,42 @@ class ParallelWrapper:
         compiled all-reduce (averaging mode with frequency=1, exact)."""
         return self.model
 
+    def warmup(self, batch_sizes, input_shape=None, label_shape=None):
+        """AOT warmup of the sharded train step for each GLOBAL batch size
+        (docs/COMPILE_CACHE.md): runs one throwaway step per size on
+        zero-valued shadow state (params are donated — the real model state
+        is never touched), so the first real fit() batch executes a warm
+        executable. Shapes default to the model conf. Returns the number of
+        signatures primed."""
+        import numpy as np_
+
+        if self._sharded_step is None:
+            self._build()
+        model = self.model
+        conf = model.conf
+        in_shape = tuple(input_shape or conf.input_shape or ())
+        if not in_shape:
+            raise ValueError("warmup() needs input_shape (or conf.input_shape)")
+        out_shape = tuple(label_shape or getattr(model, "_output_shape", ()))
+        if not out_shape:
+            raise ValueError("warmup() needs label_shape")
+        zeros = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: jnp.zeros(a.shape, a.dtype), t)
+        primed = 0
+        for b in batch_sizes:
+            x = np_.zeros((int(b),) + in_shape, np_.float32)
+            y = np_.zeros((int(b),) + out_shape, np_.float32)
+            xs, ys, w = self._shard(x, y)
+            # shadow state, same shardings as the real one (replicated)
+            p = self.mesh.replicate(zeros(model.params), keep_existing=False)
+            s = self.mesh.replicate(zeros(model.states), keep_existing=False)
+            o = self.mesh.replicate(zeros(model.opt_states),
+                                    keep_existing=False)
+            self._sharded_step(p, s, o, jnp.asarray(0),
+                               xs, ys, jax.random.PRNGKey(0), w)
+            primed += 1
+        return primed
+
 
 class ParallelInference:
     """Throughput serving over the mesh (ParallelInference parity).
@@ -112,11 +148,26 @@ class ParallelInference:
 
     def __init__(self, model, mesh: Optional[TrainingMesh] = None,
                  batch_limit: int = 1024, batch_timeout_ms: float = 3.0,
-                 queue_limit: int = 256):
+                 queue_limit: int = 256, bucketing=None):
+        from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+
         self.model = model
         self.mesh = mesh or TrainingMesh(data=len(jax.devices()))
         self.batch_limit = batch_limit
         self.batch_timeout_ms = batch_timeout_ms
+        # Shape bucketing for serving (docs/COMPILE_CACHE.md): request
+        # batches round up to a bucket BEFORE mesh padding, bounding the
+        # number of compiled forward signatures under arbitrary traffic.
+        # Defaults to the model conf's policy; pass a BucketingPolicy, a
+        # spec string ("pow2" / "batch=8,16,32"), or False to disable.
+        if bucketing is None:
+            bucketing = BucketingPolicy.from_conf(
+                getattr(model, "conf", None))
+        elif bucketing is False:
+            bucketing = None
+        elif isinstance(bucketing, str):
+            bucketing = BucketingPolicy.from_spec(bucketing)
+        self.bucketing = bucketing
         self._params = self.mesh.replicate(model.params)
         self._states = self.mesh.replicate(model.states)
         self._fwd = jax.jit(model.make_forward_fn())
@@ -139,12 +190,45 @@ class ParallelInference:
             ]
             return np.concatenate(chunks, axis=0)
         d = self.mesh.data
-        pad = (d - n % d) % d
+        target = len(x)
+        if self.bucketing is not None:
+            # bucket first, then mesh-divisibility: one compiled forward per
+            # bucket instead of one per distinct (padded) request size
+            target = self.bucketing.bucket_batch(target)
+        target += (d - target % d) % d
+        pad = target - n
         if pad:
             x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
         xs = self.mesh.shard_batch(x)
         out = self._fwd(self._params, self._states, xs)
         return np.asarray(out)[:n]
+
+    def warmup(self, batch_sizes=None, input_shape=None):
+        """Pre-compile the serving forward for every bucket before traffic
+        (ParallelInference.warmup — docs/COMPILE_CACHE.md): one zero-batch
+        call per size primes the dispatch cache, so first-request latency is
+        execution-only. ``batch_sizes`` defaults to the explicit
+        ``batch_buckets`` list of the bucketing policy; ``input_shape``
+        (excl. batch) defaults to the model conf. Returns the number of
+        signatures primed."""
+        if batch_sizes is None:
+            if (self.bucketing is None
+                    or not isinstance(self.bucketing.batch_buckets, tuple)):
+                raise ValueError(
+                    "warmup() without batch_sizes needs an explicit "
+                    "batch_buckets bucketing policy")
+            batch_sizes = self.bucketing.batch_buckets
+        conf = getattr(self.model, "conf", None)
+        shape = tuple(input_shape
+                      or getattr(conf, "input_shape", None)
+                      or (getattr(conf, "input_shapes", None) or [()])[0])
+        if not shape:
+            raise ValueError("warmup() needs input_shape (or conf.input_shape)")
+        primed = 0
+        for b in batch_sizes:
+            self.output(np.zeros((int(b),) + shape, np.float32))
+            primed += 1
+        return primed
 
     # ----------------------------------------------------- dynamic batching
     def output_async(self, x) -> "Future":

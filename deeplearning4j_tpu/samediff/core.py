@@ -464,6 +464,96 @@ class TrainingConfig:
         )
 
 
+# ---------------------------------------------------------------------------
+# Cross-instance executable cache (docs/COMPILE_CACHE.md): a fresh SameDiff
+# built from the same serialized graph (model reload, importer re-run) would
+# otherwise re-trace + re-compile every output() signature from scratch —
+# its per-instance _jit_cache starts empty. Structurally identical graphs
+# produce identical traces, so the jitted runner is shared process-wide,
+# keyed by a structural fingerprint + the call signature. Arrays are ARGUMENTS
+# of the runner (values don't bake into the trace), so instances with
+# different weights share one executable. Bounded FIFO; thread-safety follows
+# the GIL like the rest of the session layer.
+# ---------------------------------------------------------------------------
+_EXEC_CACHE: "Dict[Tuple[str, Any], Any]" = {}
+_EXEC_CACHE_MAX = 256
+
+
+def _trace_nodes(nodes, values: Dict[str, Any], targets: Sequence[str]):
+    """Run ``nodes`` (recorded topologically) until all targets computed.
+    Module-level so the cross-instance executable cache can close over a
+    node-list SNAPSHOT instead of a whole SameDiff instance — a cached
+    runner must never pin a dropped graph's weights/device buffers."""
+    needed = set(targets)
+    # backward pass marking needed nodes
+    required: set = set()
+    for node in reversed(nodes):
+        if any(o in needed for o in node.outputs):
+            required.add(id(node))
+            for i in node.inputs:
+                if isinstance(i, str):
+                    needed.add(i)
+    for node in nodes:
+        if id(node) not in required:
+            continue
+        args = []
+        for i in node.inputs:
+            if isinstance(i, tuple):
+                args.append(None if i[0] == "__none__" else i[1])
+            else:
+                args.append(values[i])
+        if node.op.startswith("__cf_"):
+            out = _exec_cf(node, args)
+        else:
+            out = registry.exec_op(node.op, *args, **node.attrs)
+        if len(node.outputs) == 1:
+            values[node.outputs[0]] = out
+        else:
+            for o, val in zip(node.outputs, out):
+                values[o] = val
+    return [values[t] for t in targets]
+
+
+def _exec_cache_get(key):
+    return _EXEC_CACHE.get(key)
+
+
+def _exec_cache_put(key, fn):
+    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+        _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+    _EXEC_CACHE[key] = fn
+
+
+def _stable_digest(obj) -> str:
+    """Deterministic digest of attrs/structures that bake into a trace:
+    containers recurse, ndarrays hash shape+dtype+bytes, everything else
+    falls back to repr (stable for the literal types attrs carry)."""
+    import hashlib
+
+    h = hashlib.sha256()
+
+    def feed(o):
+        if isinstance(o, dict):
+            h.update(b"{")
+            for k in sorted(o, key=str):
+                feed(k)
+                feed(o[k])
+            h.update(b"}")
+        elif isinstance(o, (list, tuple)):
+            h.update(b"[")
+            for v in o:
+                feed(v)
+            h.update(b"]")
+        elif isinstance(o, np.ndarray):
+            h.update(f"nd{o.shape}{o.dtype}".encode())
+            h.update(np.ascontiguousarray(o).tobytes())
+        else:
+            h.update(repr(o).encode())
+
+    feed(obj)
+    return h.hexdigest()
+
+
 # "getitem" lowering registered once, here (serializable index spec).
 def _merge_opt_state(fresh, old):
     """Conform a saved/stale optimizer state to a freshly-initialized one:
@@ -770,38 +860,30 @@ class SameDiff:
         self._train_step = None
         self._device_cache = None
         self._grad_fn_cache.clear()
+        self._fingerprint = None
+
+    def fingerprint(self) -> str:
+        """Structural fingerprint of the graph: ops, wiring, attrs, stored
+        array shapes/dtypes (NOT values — they are runner arguments). Two
+        SameDiff instances with equal fingerprints trace to the same program,
+        which is what keys the cross-instance executable cache."""
+        fp = getattr(self, "_fingerprint", None)
+        if fp is None:
+            fp = _stable_digest([
+                [(n.op, n.inputs, n.outputs, n.attrs) for n in self._nodes],
+                sorted((k, v.shape, str(v.dtype))
+                       for k, v in self._arrays.items()),
+                sorted((k, spec[0], str(spec[1]))
+                       for k, spec in self._ph_specs.items()),
+                sorted(self._poison_vars),
+            ])
+            self._fingerprint = fp
+        return fp
 
     # -- execution ----------------------------------------------------------
     def _trace(self, values: Dict[str, Any], targets: Sequence[str]):
         """Run nodes (recorded topologically) until all targets computed."""
-        needed = set(targets)
-        # backward pass marking needed nodes
-        required: set = set()
-        for node in reversed(self._nodes):
-            if any(o in needed for o in node.outputs):
-                required.add(id(node))
-                for i in node.inputs:
-                    if isinstance(i, str):
-                        needed.add(i)
-        for node in self._nodes:
-            if id(node) not in required:
-                continue
-            args = []
-            for i in node.inputs:
-                if isinstance(i, tuple):
-                    args.append(None if i[0] == "__none__" else i[1])
-                else:
-                    args.append(values[i])
-            if node.op.startswith("__cf_"):
-                out = _exec_cf(node, args)
-            else:
-                out = registry.exec_op(node.op, *args, **node.attrs)
-            if len(node.outputs) == 1:
-                values[node.outputs[0]] = out
-            else:
-                for o, val in zip(node.outputs, out):
-                    values[o] = val
-        return [values[t] for t in targets]
+        return _trace_nodes(self._nodes, values, targets)
 
     def _missing_check(self, feeds, targets):
         have = set(feeds) | set(self._arrays)
@@ -937,11 +1019,25 @@ class SameDiff:
                         "imported with a dynamic batch dim) — its value "
                         "would silently reach runtime arithmetic as -1; "
                         "re-export with static shapes")
-            def run(arrays, phs):
-                vals = dict(arrays)
-                vals.update(phs)
-                return self._trace(vals, outputs)
-            fn = jax.jit(run)
+            # cross-instance executable cache: a structurally identical
+            # graph (fresh reload of the same model) reuses the jitted
+            # runner — zero retrace, zero recompile (docs/COMPILE_CACHE.md)
+            gkey = (self.fingerprint(), sig)
+            fn = _exec_cache_get(gkey)
+            if fn is None:
+                from deeplearning4j_tpu.util.compile_watcher import note_trace
+
+                # snapshot, NOT self: the cached runner outlives this
+                # instance and must not pin its weights/device buffers
+                nodes = list(self._nodes)
+
+                def run(arrays, phs):
+                    note_trace("SameDiff.output", phs)  # trace-time only
+                    vals = dict(arrays)
+                    vals.update(phs)
+                    return _trace_nodes(nodes, vals, outputs)
+                fn = jax.jit(run)
+                _exec_cache_put(gkey, fn)
             self._jit_cache[sig] = fn
         res = fn(self._device_arrays(), feeds)
         return {name: np.asarray(r) for name, r in zip(outputs, res)}

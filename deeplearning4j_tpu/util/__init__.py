@@ -7,6 +7,19 @@ from deeplearning4j_tpu.util.checkpoint import (
     ShardedCheckpointListener,
 )
 from deeplearning4j_tpu.util import xla_tuning
+from deeplearning4j_tpu.util.aot_store import AotStore
+from deeplearning4j_tpu.util.compile_cache import (
+    cache_entries,
+    clear_persistent_cache,
+    disable_persistent_cache,
+    enable_persistent_cache,
+)
+from deeplearning4j_tpu.util.compile_watcher import (
+    CompileScope,
+    CompileWatcher,
+    get_watcher,
+    note_trace,
+)
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 from deeplearning4j_tpu.util.packed import PackedTrainer, StatePacker
 from deeplearning4j_tpu.util.profiler import (
@@ -31,4 +44,7 @@ __all__ = [
     "NaNPanicError", "check_numerics", "device_trace", "CrashReportingUtil",
     "FileStatsStorage", "InMemoryStatsStorage", "StatsListener", "to_csv",
     "PackedTrainer", "StatePacker", "xla_tuning",
+    "CompileWatcher", "CompileScope", "get_watcher", "note_trace",
+    "enable_persistent_cache", "disable_persistent_cache",
+    "clear_persistent_cache", "cache_entries", "AotStore",
 ]

@@ -1,0 +1,110 @@
+"""Persistent (on-disk) XLA compilation cache wiring.
+
+A fresh process pays a full trace+compile for every jitted program even when
+an identical binary was built seconds earlier by the previous run — the
+cold-start cost that dominates serving restart tail latency (ROADMAP north
+star). JAX ships a content-addressed on-disk executable cache
+(``jax_compilation_cache_dir``); this module wires it with
+deployment-friendly thresholds and one env knob:
+
+- ``DL4J_TPU_COMPILE_CACHE=<dir>`` — enable at import via config.py
+  (Environment), no code change needed (the reference's
+  ``cudnnAlgoMode``/workspace-reuse analogue, but across PROCESSES).
+- :func:`enable_persistent_cache` — programmatic form; returns the dir.
+
+Cache keys include the XLA/jaxlib version, backend, and the full HLO — a
+jaxlib upgrade or code change misses cleanly (stale entries are harmless;
+``clear_persistent_cache`` prunes). Thresholds default to cache-everything
+(min compile time 0s, no min entry size): on the CPU host even small
+programs are worth a disk hit, and on the real chip large programs dominate
+anyway. See docs/COMPILE_CACHE.md for layout/invalidation caveats.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "deeplearning4j_tpu", "xla_cache")
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_persistent_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_time_secs: float = 0.0,
+    min_entry_size_bytes: int = -1,
+) -> str:
+    """Point ``jax_compilation_cache_dir`` at ``cache_dir`` (created if
+    missing) so every XLA compile is persisted and a later process
+    deserializes instead of recompiling. Idempotent; returns the dir."""
+    global _enabled_dir
+    import jax
+
+    cache_dir = os.path.abspath(
+        cache_dir or os.environ.get("DL4J_TPU_COMPILE_CACHE") or _DEFAULT_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache-everything thresholds: the jax defaults (1s / small-entry skip)
+    # are tuned for TPU pods where only big programs matter; our cold-start
+    # metric counts EVERY program in the step dispatch chain
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs)
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes)
+    _reset_jax_cache()
+    _enabled_dir = cache_dir
+    return cache_dir
+
+
+def _reset_jax_cache() -> None:
+    """Re-initialize jax's cache object: the config updates alone do NOT
+    take effect once the first compile has latched a no-dir cache (enabling
+    mid-process — the Environment applies env config lazily)."""
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # older/newer jax: the config applies at first compile instead
+
+
+def disable_persistent_cache() -> None:
+    global _enabled_dir
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache()
+    _enabled_dir = None
+
+
+def cache_dir() -> Optional[str]:
+    """The active cache dir, or None when the persistent cache is off."""
+    return _enabled_dir
+
+
+def cache_entries(path: Optional[str] = None) -> int:
+    """Number of persisted executables in the cache dir (0 if absent)."""
+    path = path or _enabled_dir
+    if not path or not os.path.isdir(path):
+        return 0
+    return sum(1 for f in os.listdir(path) if f.endswith("-cache"))
+
+
+def clear_persistent_cache(path: Optional[str] = None) -> None:
+    """Remove every entry under the cache dir (the dir itself stays)."""
+    path = path or _enabled_dir
+    if not path or not os.path.isdir(path):
+        return
+    for name in os.listdir(path):
+        full = os.path.join(path, name)
+        if os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+        else:
+            try:
+                os.remove(full)
+            except OSError:
+                pass

@@ -161,6 +161,12 @@ class StatsListener:
             rec["activations"] = {
                 str(k): _summary(np.asarray(a), bins=bins)
                 for k, a in named}
+        # recompile observability (docs/COMPILE_CACHE.md): trace/compile
+        # counters ride every stats record so the UI/storage timeline shows
+        # WHEN a shape-triggered recompile hit the training loop
+        from deeplearning4j_tpu.util.compile_watcher import get_watcher
+
+        rec["compile"] = get_watcher().counts()
         self.storage.put(rec)
 
 

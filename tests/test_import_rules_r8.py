@@ -1,0 +1,151 @@
+"""Round-8 importer satellite fixes (ADVICE r5 #2/#4/#5):
+
+- ONNX Quantize/DequantizeLinear per-axis detection for NON-constant scales
+  (declared 1-D shape => per-axis; undecidable => loud NotImplementedError,
+  never a silent per-tensor broadcast along the wrong axis);
+- TF MatrixDiagV3 const-folds num_rows/num_cols/padding_value and refuses
+  non-default values instead of emitting a silently wrong square matrix;
+- TF sorted SegmentMax/SegmentMin fill EMPTY segments with TF's documented
+  0, not the unsorted kernels' dtype ±lowest/highest.
+"""
+
+import numpy as np
+import pytest
+import tensorflow as tf
+
+from deeplearning4j_tpu.imports import import_graph_def, import_onnx
+from deeplearning4j_tpu.imports.tf_import import UnsupportedOpError
+
+from test_imports import (  # noqa: E402
+    _freeze,
+    _golden_match,
+    _onnx_attr_i,
+    _onnx_input,
+    _onnx_model,
+    _onnx_node,
+    _onnx_tensor,
+)
+
+R = np.random.default_rng(8)
+
+
+class TestQdqNonConstScale:
+    def test_quantize_per_axis_runtime_scale(self):
+        """1-D size-3 scale as a GRAPH INPUT (not an initializer): the
+        declared shape must trigger per-axis reshaping along axis=1."""
+        model = _onnx_model(
+            nodes=[_onnx_node("QuantizeLinear", ["x", "scale", "zp"], ["y"],
+                              _onnx_attr_i("axis", 1))],
+            initializers=[_onnx_tensor("zp", np.zeros(3, np.uint8))],
+            inputs=[_onnx_input("x", (2, 3, 4)), _onnx_input("scale", (3,))],
+            outputs=["y"],
+        )
+        sd = import_onnx(model)
+        x = R.normal(size=(2, 3, 4)).astype(np.float32) * 5
+        scale = np.asarray([0.1, 0.5, 2.0], np.float32)
+        y = sd.output({"x": x, "scale": scale}, ["y"])["y"]
+        ref = np.clip(np.rint(x / scale.reshape(1, 3, 1)), 0, 255) \
+            .astype(np.uint8)
+        np.testing.assert_array_equal(y, ref)
+
+    def test_dequantize_per_axis_runtime_scale(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("DequantizeLinear", ["x", "scale"], ["y"],
+                              _onnx_attr_i("axis", 0))],
+            initializers=[_onnx_tensor(
+                "x", R.integers(-100, 100, (3, 4)).astype(np.int8))],
+            inputs=[_onnx_input("scale", (3,))],
+            outputs=["y"],
+        )
+        sd = import_onnx(model)
+        scale = np.asarray([0.5, 1.5, 3.0], np.float32)
+        xv = sd._arrays["x"]
+        y = sd.output({"scale": scale}, ["y"])["y"]
+        ref = xv.astype(np.float32) * scale.reshape(3, 1)
+        np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+    def test_scalar_runtime_scale_stays_per_tensor(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("QuantizeLinear", ["x", "scale"], ["y"])],
+            initializers=[],
+            inputs=[_onnx_input("x", (2, 5)), _onnx_input("scale", ())],
+            outputs=["y"],
+        )
+        sd = import_onnx(model)
+        x = R.normal(size=(2, 5)).astype(np.float32)
+        y = sd.output({"x": x, "scale": np.float32(0.3)}, ["y"])["y"]
+        ref = np.clip(np.rint(x / 0.3), 0, 255).astype(np.uint8)
+        np.testing.assert_array_equal(y, ref)
+
+    def test_rank2_runtime_scale_fails_loudly(self):
+        model = _onnx_model(
+            nodes=[_onnx_node("QuantizeLinear", ["x", "scale"], ["y"])],
+            initializers=[],
+            inputs=[_onnx_input("x", (2, 3, 4)),
+                    _onnx_input("scale", (3, 4))],
+            outputs=["y"],
+        )
+        with pytest.raises(NotImplementedError, match="rank-2"):
+            import_onnx(model)
+
+
+class TestMatrixDiagV3Defaults:
+    def test_default_form_still_imports(self):
+        v = R.normal(size=(5,)).astype(np.float32)
+        gd, golden, in_names, out_names = _freeze(
+            lambda x: tf.linalg.diag(x), [v])
+        _golden_match(gd, golden, in_names, out_names, [v])
+
+    def test_num_rows_rejected(self):
+        v = R.normal(size=(4,)).astype(np.float32)
+        gd, _, _, _ = _freeze(
+            lambda x: tf.linalg.diag(x, num_rows=6), [v])
+        with pytest.raises(UnsupportedOpError, match="num_rows"):
+            import_graph_def(gd)
+
+    def test_num_cols_rejected(self):
+        v = R.normal(size=(4,)).astype(np.float32)
+        gd, _, _, _ = _freeze(
+            lambda x: tf.linalg.diag(x, num_cols=7), [v])
+        with pytest.raises(UnsupportedOpError, match="num_cols"):
+            import_graph_def(gd)
+
+    def test_padding_value_rejected(self):
+        v = R.normal(size=(4,)).astype(np.float32)
+        gd, _, _, _ = _freeze(
+            lambda x: tf.linalg.diag(x, padding_value=9.0), [v])
+        with pytest.raises(UnsupportedOpError, match="padding_value"):
+            import_graph_def(gd)
+
+
+class TestSortedSegmentEmptyFill:
+    def test_segment_max_empty_segment_zero_fill(self):
+        # ids [0, 0, 2, 2]: segment 1 is EMPTY -> TF documents output 0
+        data = np.asarray([[1., -5.], [3., -2.], [7., -9.], [2., -1.]],
+                          np.float32)
+        ids = np.asarray([0, 0, 2, 2], np.int64)
+        gd, golden, in_names, out_names = _freeze(
+            lambda d: tf.math.segment_max(d, ids), [data])
+        _golden_match(gd, golden, in_names, out_names, [data])
+
+    def test_segment_min_empty_segment_zero_fill(self):
+        data = np.asarray([[4., 5.], [3., 2.], [7., 9.]], np.float32)
+        ids = np.asarray([0, 0, 3], np.int64)  # segments 1 and 2 empty
+        gd, golden, in_names, out_names = _freeze(
+            lambda d: tf.math.segment_min(d, ids), [data])
+        _golden_match(gd, golden, in_names, out_names, [data])
+        assert not np.isinf(golden[0]).any()  # the golden itself is 0-filled
+
+    def test_unsorted_semantics_unchanged(self):
+        """The registry's unsorted kernels keep their ±lowest/highest fill —
+        the 0 fill is opt-in for the SORTED TF ops only."""
+        from deeplearning4j_tpu.ops import registry
+
+        data = np.asarray([1., 2., 3.], np.float32)
+        ids = np.asarray([0, 0, 2], np.int32)
+        out = np.asarray(registry.exec_op(
+            "segment_max", data, ids, num_segments=3))
+        assert out[1] < -1e30  # dtype-lowest fill, untouched
+        filled = np.asarray(registry.exec_op(
+            "segment_max", data, ids, num_segments=3, empty_fill=0))
+        assert filled[1] == 0.0
