@@ -58,6 +58,14 @@ JAX_PLATFORMS=cpu DL4J_TPU_COMPILE_CACHE="$CC_DIR" \
 JAX_PLATFORMS=cpu python benchmarks/compile_cache_sweep.py --ci
 rm -rf "$CC_DIR"
 
+echo "== step: Telemetry smoke (2-step fit, /metrics + /healthz, trace schema) =="
+# ISSUE 4: full observability chain — a 2-step fit through mp-ETL + prefetch
+# + bucketed dispatch with the health monitor on, then the script curls the
+# live server's /metrics (Prometheus text incl. compile/step-time/queue-
+# depth gauges) and /healthz, and validates the merged Chrome trace loads
+# with spans from >= 3 distinct PIDs/threads (event schema check).
+JAX_PLATFORMS=cpu python benchmarks/telemetry_smoke.py
+
 echo "== step: Test (pytest, JAX_PLATFORMS=cpu, 8 virtual devices) =="
 JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

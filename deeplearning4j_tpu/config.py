@@ -37,6 +37,11 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
 - ``DL4J_TPU_COMPILE_CACHE`` — directory for the persistent on-disk XLA
   compilation cache (util/compile_cache.py): a restarted process
   deserializes executables instead of recompiling. Empty/unset = off.
+- ``DL4J_TPU_TELEMETRY`` — unified telemetry registry (util/telemetry.py,
+  docs/OBSERVABILITY.md): counters/gauges/histograms, cross-process trace
+  spans, /metrics + /healthz on the UI server. Default ON (span cost is
+  ~µs against ms-scale steps — bench.py ``telemetry_overhead``); set to
+  0/false to strip every recording hook.
 """
 
 from __future__ import annotations
@@ -86,6 +91,7 @@ class Environment:
         self.default_buckets = os.environ.get("DL4J_TPU_BUCKETS") or None
         self.compile_cache_dir = (
             os.environ.get("DL4J_TPU_COMPILE_CACHE") or None)
+        self.telemetry = _env_bool("DL4J_TPU_TELEMETRY", default=True)
         self._profiler = None
         self._compile_cache_applied = False
 
@@ -111,6 +117,10 @@ class Environment:
 
     def set_nan_panic(self, v: bool) -> "Environment":
         self.nan_panic = v
+        return self._apply()
+
+    def set_telemetry(self, v: bool) -> "Environment":
+        self.telemetry = v
         return self._apply()
 
     def _apply(self) -> "Environment":
@@ -141,6 +151,16 @@ class Environment:
 
             enable_persistent_cache(self.compile_cache_dir)
             self._compile_cache_applied = True
+
+        # unified telemetry switch: the module reads DL4J_TPU_TELEMETRY
+        # itself at singleton creation; the setter keeps them in sync at
+        # runtime. Only push when THIS flag changed — an unrelated setter
+        # (set_debug etc.) must not clobber a direct telemetry.set_enabled()
+        if self.telemetry != getattr(self, "_telemetry_applied", None):
+            from deeplearning4j_tpu.util import telemetry as _telemetry
+
+            _telemetry.set_enabled(self.telemetry)
+            self._telemetry_applied = self.telemetry
 
         want_hook = self.profiling or self.nan_panic or self.debug
         prof = OpProfiler.get_instance()

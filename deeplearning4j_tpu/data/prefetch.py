@@ -33,6 +33,7 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.util import telemetry as tm
 
 
 class PrefetchStalledError(RuntimeError):
@@ -58,6 +59,10 @@ class AsyncDataSetIterator(DataSetIterator):
     ``device``: optional explicit jax.Device / Sharding for the staged
     arrays (defaults to jax's current default device).
     """
+
+    #: consumer q.get waits longer than this count as a pipeline stall
+    #: (telemetry: ``prefetch.stalls_total`` + an instant trace event)
+    stall_threshold_s: float = 0.05
 
     def __init__(self, base, buffer_size: int = 2, device_put: bool = True,
                  device=None, timeout: float = 120.0):
@@ -141,10 +146,22 @@ class AsyncDataSetIterator(DataSetIterator):
         return False
 
     def _produce(self, q, stop):
+        # runs on the dl4j-tpu-prefetch thread: its ETL-wait and H2D-enqueue
+        # spans land on a distinct tid row of the merged telemetry trace
         try:
-            for ds in self.base:
-                if not self._put(q, stop, ("ok", self._stage(ds))):
+            it = iter(self.base)
+            while True:
+                with tm.span("prefetch.etl_wait"):
+                    try:
+                        ds = next(it)
+                    except StopIteration:
+                        break
+                with tm.span("prefetch.device_put"):
+                    staged = self._stage(ds)
+                if not self._put(q, stop, ("ok", staged)):
                     return
+                tm.gauge("prefetch.queue_depth", q.qsize())
+                tm.counter("prefetch.batches_total")
             self._put(q, stop, ("end", None))
         except BaseException as e:  # noqa: BLE001 — crosses the thread gap
             self._put(q, stop, ("error", e))
@@ -162,8 +179,12 @@ class AsyncDataSetIterator(DataSetIterator):
             name="dl4j-tpu-prefetch", daemon=True)
         self._queue, self._stop, self._worker = q, stop, worker
         worker.start()
-        try:
+        import time as _time
+
+        first = True  # the first get always absorbs worker startup + the
+        try:          # first batch's full ETL: that is warmup, not a stall
             while True:
+                t0 = _time.perf_counter()
                 try:
                     kind, payload = q.get(timeout=self.timeout)
                 except _queue.Empty:
@@ -171,6 +192,16 @@ class AsyncDataSetIterator(DataSetIterator):
                         f"prefetch worker produced no batch for "
                         f"{self.timeout}s (base iterator "
                         f"{type(self.base).__name__} wedged?)") from None
+                waited = _time.perf_counter() - t0
+                tm.gauge("prefetch.queue_depth", q.qsize())
+                if (kind == "ok" and not first
+                        and waited > self.stall_threshold_s):
+                    # the consumer outran the pipeline: the device would
+                    # have idled for `waited` seconds this batch
+                    tm.counter("prefetch.stalls_total")
+                    tm.observe("prefetch.stall_seconds", waited)
+                    tm.instant("prefetch.stall", waited_ms=round(waited * 1e3, 2))
+                first = False
                 if kind == "end":
                     return
                 if kind == "error":

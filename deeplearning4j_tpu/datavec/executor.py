@@ -41,6 +41,8 @@ import os
 import traceback
 from typing import Any, List, Optional, Sequence
 
+from deeplearning4j_tpu.util import telemetry as tm
+
 
 class TransformExecutionError(RuntimeError):
     """A transform worker process failed (or timed out). Carries the worker's
@@ -67,12 +69,21 @@ def _default_workers() -> int:
 
 
 def _worker_main(transform_process, chunk, chunk_idx, out_queue):
-    """Runs in the forked child: transform one contiguous chunk."""
+    """Runs in the forked child: transform one contiguous chunk. Telemetry
+    spans recorded here carry the CHILD's PID (the fork hook in
+    util/telemetry.py cleared inherited parent events) and ship back over
+    the result queue as plain dicts; the parent merges them so the single
+    Chrome trace shows every worker process as its own row."""
     try:
-        out_queue.put((chunk_idx, "ok", transform_process.execute(chunk)))
+        with tm.span("etl.transform_chunk", chunk=chunk_idx,
+                     records=len(chunk)):
+            out = transform_process.execute(chunk)
+        out_queue.put((chunk_idx, "ok", out,
+                       tm.get_telemetry().drain_events()))
     except BaseException as e:  # noqa: BLE001 — must cross the process gap
         out_queue.put((chunk_idx, "error",
-                       f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+                       f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                       None))
 
 
 class MultiProcessTransformExecutor:
@@ -106,14 +117,24 @@ class MultiProcessTransformExecutor:
         records = list(records)
         if (self.num_workers <= 1
                 or len(records) < 2 * self.min_records_per_worker):
-            return self.transform_process.execute(records)
+            with tm.span("etl.execute_serial", records=len(records)):
+                return self.transform_process.execute(records)
         try:
             ctx = mp.get_context("fork")
         except ValueError:  # no fork on this platform: serial fallback
             return self.transform_process.execute(records)
         chunks = self._chunks(records)
         if len(chunks) <= 1:
-            return self.transform_process.execute(records)
+            with tm.span("etl.execute_serial", records=len(records)):
+                return self.transform_process.execute(records)
+        with tm.span("etl.execute", records=len(records),
+                     workers=len(chunks)):
+            out = self._execute_chunks(ctx, chunks)
+        tm.counter("etl.chunks_total", len(chunks))
+        tm.counter("etl.records_total", len(records))
+        return out
+
+    def _execute_chunks(self, ctx, chunks) -> List[list]:
         out_queue = ctx.Queue()
         procs = [
             ctx.Process(target=_worker_main,
@@ -131,7 +152,8 @@ class MultiProcessTransformExecutor:
 
             for _ in range(len(chunks)):
                 try:
-                    idx, status, payload = out_queue.get(timeout=self.timeout)
+                    idx, status, payload, spans = out_queue.get(
+                        timeout=self.timeout)
                 except _q.Empty:
                     raise TransformExecutionError(
                         f"transform worker timed out after {self.timeout}s "
@@ -140,6 +162,8 @@ class MultiProcessTransformExecutor:
                 if status != "ok":
                     raise TransformExecutionError(
                         f"transform worker for chunk {idx} failed:\n{payload}")
+                if spans:  # worker-PID spans onto the merged trace timeline
+                    tm.get_telemetry().merge_events(spans)
                 results[idx] = payload
         finally:
             for p in procs:

@@ -39,6 +39,7 @@ from deeplearning4j_tpu.nn import vertices as V
 from deeplearning4j_tpu.nn.conf import (_buckets_from_json, _buckets_to_json,
                                         _detuple)
 from deeplearning4j_tpu.nn.multilayer import _dispatch_sig, _struct_of
+from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
 
@@ -351,6 +352,7 @@ class ComputationGraph:
         # device-resident 0/1 weights cache — fit always threads weights so
         # bucketed == unbucketed program (data/bucketing.py dev_weights)
         self._w_cache: dict = {}
+        self._last_fit_ns = None  # step-cadence stamp (telemetry histogram)
 
     def _dev_weights(self, size: int, real: int):
         from deeplearning4j_tpu.data.bucketing import dev_weights
@@ -869,10 +871,13 @@ class ComputationGraph:
                 seg_lab, _, _ = self._bucketing.pad_segment(
                     seg_lab, None, None, k)
             self._rng_key, sub = jax.random.split(self._rng_key)
-            (self.params, self.states, self.opt_states, carries, loss) = (
-                self._tbptt_step(self.params, self.states, self.opt_states,
-                                 carries, jnp.asarray(self.iteration),
-                                 seg_in, seg_lab, sub, ms, lms, weights))
+            with tm.step_span("cg.tbptt_step", iteration=self.iteration,
+                              segment_start=s):
+                (self.params, self.states, self.opt_states, carries, loss) = (
+                    self._tbptt_step(self.params, self.states,
+                                     self.opt_states, carries,
+                                     jnp.asarray(self.iteration),
+                                     seg_in, seg_lab, sub, ms, lms, weights))
             self.iteration += 1
             losses.append(loss)
         self._dispatcher.flush()  # keep cross-path dispatch ordering intact
@@ -1132,11 +1137,23 @@ class ComputationGraph:
         mk, lmk = _as_mask(mask), _as_mask(label_mask)
         step = self._aot_steps.get(
             _dispatch_sig(inputs, labs, weights, mk, lmk), self._train_step)
-        (self.params, self.states, self.opt_states, loss,
-         self._it_dev, self._rng_key) = step(
-            self.params, self.states, self.opt_states, self._it_dev,
-            self._rng_key, inputs, labs, weights, mk, lmk,
-        )
+        if tm.enabled():
+            import time as _time
+
+            now = _time.time_ns()
+            if self._last_fit_ns is not None:
+                tm.observe("train.step_seconds",
+                           (now - self._last_fit_ns) / 1e9, model="cg")
+            self._last_fit_ns = now
+            tm.counter("train.steps_total", model="cg")
+        # dispatch span with XLA trace/compile sub-spans when this shape
+        # retraced (CompileWatcher markers — docs/OBSERVABILITY.md)
+        with tm.step_span("cg.train_step", iteration=self.iteration):
+            (self.params, self.states, self.opt_states, loss,
+             self._it_dev, self._rng_key) = step(
+                self.params, self.states, self.opt_states, self._it_dev,
+                self._rng_key, inputs, labs, weights, mk, lmk,
+            )
         self.score_value = loss
         # activation-stats listeners must never see fabricated padding rows
         self.last_features = tuple(

@@ -60,10 +60,15 @@ class CoalescingListenerDispatcher:
         self._pending: list = []  # (iteration, epoch, device_loss, wall_ns)
 
     def iteration_done(self, loss, iteration: int, epoch: int) -> None:
+        from deeplearning4j_tpu.util import telemetry as tm
+
         model = self.model
         if self.sync_every <= 1:
-            for lst in model.listeners:
-                lst.iteration_done(model, iteration, epoch)
+            if not model.listeners:
+                return
+            with tm.span("listeners.dispatch", iteration=iteration):
+                for lst in model.listeners:
+                    lst.iteration_done(model, iteration, epoch)
             return
         if not model.listeners:
             return  # nobody observing: keep the step chain sync-free
@@ -79,18 +84,22 @@ class CoalescingListenerDispatcher:
         import jax.numpy as jnp
         import numpy as np
 
+        from deeplearning4j_tpu.util import telemetry as tm
+
         pending, self._pending = self._pending, []
-        vals = np.asarray(
-            jax.device_get(jnp.stack([jnp.asarray(p[2]) for p in pending])))
-        model = self.model
-        try:
-            for (it, ep, _, wall_ns), val in zip(pending, vals):
-                model.score_value = float(val)
-                model.last_iteration_wall_ns = wall_ns
-                for lst in model.listeners:
-                    lst.iteration_done(model, it, ep)
-        finally:
-            model.last_iteration_wall_ns = None
+        with tm.span("listeners.flush", window=len(pending)):
+            with tm.span("listeners.loss_fetch", window=len(pending)):
+                vals = np.asarray(jax.device_get(
+                    jnp.stack([jnp.asarray(p[2]) for p in pending])))
+            model = self.model
+            try:
+                for (it, ep, _, wall_ns), val in zip(pending, vals):
+                    model.score_value = float(val)
+                    model.last_iteration_wall_ns = wall_ns
+                    for lst in model.listeners:
+                        lst.iteration_done(model, it, ep)
+            finally:
+                model.last_iteration_wall_ns = None
 
 
 class RecompileListener(TrainingListener):

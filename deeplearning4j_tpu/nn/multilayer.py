@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -31,6 +32,7 @@ from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
 
@@ -93,6 +95,7 @@ class MultiLayerNetwork:
         # program — the bit-identity invariant (data/bucketing.py
         # dev_weights).
         self._w_cache: dict = {}
+        self._last_fit_ns = None  # step-cadence stamp (telemetry histogram)
 
     def _dev_weights(self, size: int, real: int):
         from deeplearning4j_tpu.data.bucketing import dev_weights
@@ -488,10 +491,13 @@ class MultiLayerNetwork:
                 (xs, ys), ms, lms = self._bucketing.pad_segment(
                     (xs, ys), ms, lms, k)
             self._rng_key, sub = jax.random.split(self._rng_key)
-            (self.params, self.states, self.opt_states, carries, loss) = (
-                self._tbptt_step(self.params, self.states, self.opt_states,
-                                 carries, jnp.asarray(self.iteration), xs, ys,
-                                 sub, ms, lms, weights))
+            with tm.step_span("mln.tbptt_step", iteration=self.iteration,
+                              segment_start=s):
+                (self.params, self.states, self.opt_states, carries, loss) = (
+                    self._tbptt_step(self.params, self.states,
+                                     self.opt_states, carries,
+                                     jnp.asarray(self.iteration), xs, ys,
+                                     sub, ms, lms, weights))
             self.iteration += 1
             losses.append(loss)
         self._dispatcher.flush()  # keep cross-path dispatch ordering intact
@@ -580,11 +586,21 @@ class MultiLayerNetwork:
         # (zero retrace/compile risk on the serving path), else the jit path
         step = self._aot_steps.get(
             _dispatch_sig(x, y, weights, mask, label_mask), self._train_step)
-        (self.params, self.states, self.opt_states, loss,
-         self._it_dev, self._rng_key) = step(
-            self.params, self.states, self.opt_states, self._it_dev,
-            self._rng_key, x, y, weights, mask, label_mask,
-        )
+        if tm.enabled():
+            now = time.time_ns()
+            if self._last_fit_ns is not None:
+                tm.observe("train.step_seconds",
+                           (now - self._last_fit_ns) / 1e9, model="mln")
+            self._last_fit_ns = now
+            tm.counter("train.steps_total", model="mln")
+        # dispatch span with XLA trace/compile sub-spans when this shape
+        # retraced (CompileWatcher markers — docs/OBSERVABILITY.md)
+        with tm.step_span("mln.train_step", iteration=self.iteration):
+            (self.params, self.states, self.opt_states, loss,
+             self._it_dev, self._rng_key) = step(
+                self.params, self.states, self.opt_states, self._it_dev,
+                self._rng_key, x, y, weights, mask, label_mask,
+            )
         self.score_value = loss  # fetched lazily; float() forces transfer
         # activation-stats listeners must never see fabricated padding rows
         self.last_features = x if real_n == x.shape[0] else x[:real_n]

@@ -37,6 +37,9 @@ from deeplearning4j_tpu.util.stats import (
     StatsListener,
     to_csv,
 )
+from deeplearning4j_tpu.util import telemetry
+from deeplearning4j_tpu.util.health import TrainingHealthMonitor
+from deeplearning4j_tpu.util.telemetry import Telemetry, get_telemetry
 
 __all__ = [
     "ModelSerializer", "ShardedCheckpointer", "ShardedCheckpointListener",
@@ -47,4 +50,5 @@ __all__ = [
     "CompileWatcher", "CompileScope", "get_watcher", "note_trace",
     "enable_persistent_cache", "disable_persistent_cache",
     "clear_persistent_cache", "cache_entries", "AotStore",
+    "telemetry", "Telemetry", "get_telemetry", "TrainingHealthMonitor",
 ]

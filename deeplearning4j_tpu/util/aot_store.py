@@ -51,6 +51,12 @@ def aot_build(store: Optional["AotStore"], tag: str, conf_json: str, sig,
     return fn
 
 
+def _tm():
+    from deeplearning4j_tpu.util import telemetry
+
+    return telemetry
+
+
 def package_digest() -> str:
     """Content digest of every .py file in the deeplearning4j_tpu package —
     part of the store key, so ANY code change invalidates (the traced
@@ -107,9 +113,12 @@ class AotStore:
     def load(self, key: str) -> Optional[Callable]:
         """Deserialize the lowered module for ``key`` -> callable, or None.
         The callable re-compiles the stored StableHLO on first use (a
-        persistent-cache hit when that is enabled) — no Python re-trace."""
+        persistent-cache hit when that is enabled) — no Python re-trace.
+        Hits/misses feed the telemetry registry (``aot_store.hits_total`` /
+        ``aot_store.misses_total`` on /metrics)."""
         path = self._path(key)
         if not os.path.exists(path):
+            _tm().counter("aot_store.misses_total")
             return None
         from jax import export as jexport
 
@@ -117,7 +126,9 @@ class AotStore:
             with open(path, "rb") as fh:
                 exported = jexport.deserialize(fh.read())
         except Exception:
+            _tm().counter("aot_store.misses_total")
             return None  # truncated/incompatible blob: treat as a miss
+        _tm().counter("aot_store.hits_total")
         return exported.call
 
     def save(self, key: str, exported) -> str:
@@ -126,6 +137,7 @@ class AotStore:
         with open(tmp, "wb") as fh:
             fh.write(exported.serialize())
         os.replace(tmp, path)  # atomic: concurrent processes race safely
+        _tm().counter("aot_store.saves_total")
         return path
 
     def entries(self) -> int:

@@ -48,6 +48,9 @@ def enable_persistent_cache(
         cache_dir or os.environ.get("DL4J_TPU_COMPILE_CACHE") or _DEFAULT_DIR)
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
+    from deeplearning4j_tpu.util import telemetry as tm
+
+    tm.counter("compile_cache.enables_total")
     # cache-everything thresholds: the jax defaults (1s / small-entry skip)
     # are tuned for TPU pods where only big programs matter; our cold-start
     # metric counts EVERY program in the step dispatch chain

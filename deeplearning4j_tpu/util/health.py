@@ -1,0 +1,290 @@
+"""Training-health monitors on top of the telemetry registry
+(docs/OBSERVABILITY.md).
+
+The reference's training-health surface was the DL4J UI's update:parameter
+ratio chart plus ``ProfilerConfig.nanPanic`` — both host-side and both
+per-op. Here the monitors run DEVICE-side and piggyback on the coalesced
+listener window (docs/HOST_PIPELINE.md): the per-step scores a
+:class:`TrainingHealthMonitor` consumes are the ones the
+``CoalescingListenerDispatcher`` already fetched in its one-per-window
+stacked transfer, and the monitor's own device work (NaN/Inf sentinel +
+norm probe) is ONE jitted reduction fetched once per window — no extra
+per-step host syncs.
+
+Signals:
+
+- **Loss EWMA bands** — per-step score tracked with an exponentially
+  weighted mean/variance; a score outside ``mean ± band_sigma·std`` after
+  warmup is a ``loss_anomaly``. Non-finite scores are ``loss_non_finite``.
+- **Divergence detection** — the loss EWMA rising past
+  ``divergence_factor ×`` its best (minimum) value flags ``divergence``
+  (the "loss blew up an order of magnitude" crash signature).
+- **Sync-free NaN/Inf sentinel + update-ratio probe** — every ``window``
+  iterations one jitted function reduces ``jnp.isfinite`` over every float
+  param leaf AND computes ‖params‖ / ‖params − params_prev_window‖; the
+  three scalars come back in a single fetch. The update:param ratio (the
+  reference chart's quantity, here over a window rather than a single
+  step) gets its own EWMA band — a collapsed ratio (vanishing updates) or
+  an exploding one both flag ``update_ratio_anomaly``. The previous-window
+  param snapshot is a device-side copy (one buffer-sized allocation per
+  window; disable with ``update_ratio=False`` on memory-tight chips).
+- **HBM gauges** — live/peak device memory from PJRT memory stats, served
+  by the registry's scrape-time collector (``/metrics``, StatsListener
+  snapshots, and the crash dump in util/stats.py always read the CURRENT
+  values — no per-window push needed).
+
+Every anomaly increments ``health.anomalies_total{type=...}``, records an
+instant event on the trace timeline, updates the ``/healthz`` registry, and
+invokes ``on_anomaly(type, detail)`` if given. ``panic=True`` escalates
+non-finite params/scores to :class:`NaNPanicError` (nanPanic parity).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.nn.listeners import TrainingListener
+from deeplearning4j_tpu.util import telemetry as tm
+from deeplearning4j_tpu.util.profiler import NaNPanicError
+
+
+def _finite_and_norms(params, prev):
+    """Device-side probe body: [all_finite, ‖params‖, ‖params−prev‖] as one
+    stacked float32 vector — three scalars, ONE fetch. ``prev=None`` skips
+    the delta term (first window)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(params)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        z = jnp.float32(0)
+        return jnp.stack([jnp.float32(1), z, z])
+    finite = jnp.array(True)
+    for l in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l)))
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    if prev is None:
+        dq = jnp.float32(0)
+    else:
+        prev_leaves = [l for l in jax.tree_util.tree_leaves(prev)
+                       if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+        dq = sum(jnp.sum(jnp.square((a - b).astype(jnp.float32)))
+                 for a, b in zip(leaves, prev_leaves))
+    return jnp.stack([finite.astype(jnp.float32), jnp.sqrt(sq), jnp.sqrt(dq)])
+
+
+class _Ewma:
+    """Exponentially weighted mean/std with sample counting."""
+
+    __slots__ = ("alpha", "mean", "var", "n", "best")
+
+    def __init__(self, alpha: float):
+        self.alpha = alpha
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.best = float("inf")
+
+    def update(self, v: float):
+        if self.n == 0:
+            self.mean, self.var = v, 0.0
+        else:
+            a = self.alpha
+            d = v - self.mean
+            self.mean += a * d
+            self.var = (1 - a) * (self.var + a * d * d)
+        self.n += 1
+        if self.mean < self.best:
+            self.best = self.mean
+
+    def std(self) -> float:
+        return math.sqrt(max(self.var, 0.0))
+
+    def outside_band(self, v: float, sigma: float) -> bool:
+        # the std floor is RELATIVE (1% of |mean|): the EWMA variance
+        # converges slowly, so an ultra-smooth loss would otherwise flag
+        # ordinary jitter as a multi-sigma breach right after warmup
+        floor = max(self.std(), 0.01 * abs(self.mean), 1e-12)
+        return abs(v - self.mean) > sigma * floor
+
+
+class TrainingHealthMonitor(TrainingListener):
+    """TrainingListener wrapping all the monitors above; install with
+    ``net.add_listener(TrainingHealthMonitor())`` (ideally together with
+    ``sync_every > 1`` so per-step scores arrive pre-fetched in coalesced
+    windows). ``window=None`` derives the probe cadence from the model
+    conf's ``sync_every`` (min 10)."""
+
+    def __init__(self, window: Optional[int] = None, alpha: float = 0.05,
+                 band_sigma: float = 6.0, divergence_factor: float = 100.0,
+                 warmup: int = 20, update_ratio: bool = True,
+                 panic: bool = False,
+                 on_anomaly: Optional[Callable[[str, str], None]] = None,
+                 log_fn=print):
+        self.window = window
+        self.alpha = alpha
+        self.band_sigma = band_sigma
+        self.divergence_factor = divergence_factor
+        self.warmup = warmup
+        self.update_ratio = update_ratio
+        self.panic = panic
+        self.on_anomaly = on_anomaly
+        self.log = log_fn
+        self.anomalies: list = []  # (iteration, type, detail)
+        self._loss = _Ewma(alpha)
+        self._ratio = _Ewma(alpha)
+        self._probe_fns: dict = {}
+        self._copy_fn = None
+        self._prev_params = None
+        self._last_probe = None  # (finite, param_norm, update_norm)
+
+    # ------------------------------------------------------------- anomalies
+    def _anomaly(self, iteration: int, kind: str, detail: str):
+        self.anomalies.append((iteration, kind, detail))
+        tm.counter("health.anomalies_total", type=kind)
+        tm.instant("health.anomaly", type=kind, iteration=iteration,
+                   detail=detail)
+        if self.log:
+            self.log(f"HEALTH anomaly at iteration {iteration}: {kind} "
+                     f"({detail})")
+        if self.on_anomaly is not None:
+            self.on_anomaly(kind, detail)
+        if self.panic and kind in ("loss_non_finite", "params_non_finite"):
+            raise NaNPanicError(
+                f"training health panic at iteration {iteration}: {kind} "
+                f"({detail})")
+
+    # ------------------------------------------------------------- listeners
+    def iteration_done(self, model, iteration, epoch):
+        score = float(model.score_value)
+        finite = math.isfinite(score)
+        if not finite:
+            tm.set_health("training.finite", False,
+                          f"non-finite loss at iteration {iteration}")
+            self._anomaly(iteration, "loss_non_finite", f"score={score}")
+        else:
+            ew = self._loss
+            if (ew.n > self.warmup
+                    and ew.outside_band(score, self.band_sigma)):
+                self._anomaly(
+                    iteration, "loss_anomaly",
+                    f"score={score:.6g} vs ewma={ew.mean:.6g}"
+                    f"±{self.band_sigma}·{ew.std():.3g}")
+            ew.update(score)
+            tm.gauge("health.loss_ewma", ew.mean)
+            if (ew.n > self.warmup and ew.best > 0
+                    and ew.mean > self.divergence_factor * ew.best):
+                tm.set_health(
+                    "training.converging", False,
+                    f"loss ewma {ew.mean:.6g} is "
+                    f">{self.divergence_factor}x its best {ew.best:.6g}")
+                self._anomaly(
+                    iteration, "divergence",
+                    f"ewma={ew.mean:.6g} best={ew.best:.6g}")
+            else:
+                tm.set_health("training.converging", True, "")
+        w = self._window_for(model)
+        if iteration % w == 0:
+            self._window_probe(model, iteration)
+
+    def _window_for(self, model) -> int:
+        if self.window:
+            return self.window
+        conf = getattr(model, "conf", None)
+        return max(10, int(getattr(conf, "sync_every", 1) or 1))
+
+    # ----------------------------------------------------- device-side probe
+    def _probe_fn(self, with_prev: bool):
+        fn = self._probe_fns.get(with_prev)
+        if fn is None:
+            import jax
+
+            if with_prev:
+                fn = jax.jit(_finite_and_norms)
+            else:
+                fn = jax.jit(lambda p: _finite_and_norms(p, None))
+            self._probe_fns[with_prev] = fn
+        return fn
+
+    def _copy(self, params):
+        if self._copy_fn is None:
+            import jax
+
+            # a*1 forces fresh output buffers (jit identity may alias);
+            # the copy is what survives the train step's donation of the
+            # live params — a bare reference would be deleted under it
+            self._copy_fn = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda a: a * 1, t))
+        return self._copy_fn(params)
+
+    def _window_probe(self, model, iteration: int):
+        import numpy as np
+
+        params = getattr(model, "params", None)
+        if not params:
+            return
+        with tm.span("health.window_probe", iteration=iteration):
+            prev = self._prev_params if self.update_ratio else None
+            try:
+                if prev is not None:
+                    vec = self._probe_fn(True)(params, prev)
+                else:
+                    vec = self._probe_fn(False)(params)
+                finite, pnorm, unorm = (float(v) for v in np.asarray(vec))
+            except Exception as e:
+                # structure changed mid-run (transfer learning): drop the
+                # stale snapshot and re-arm next window — but NEVER
+                # silently: a sentinel that died is itself a health event
+                self._prev_params = None
+                self._probe_fns.clear()
+                tm.counter("health.probe_errors_total")
+                tm.instant("health.probe_error", iteration=iteration,
+                           error=repr(e)[:200])
+                if self.log:
+                    self.log(f"HEALTH probe error at iteration {iteration}"
+                             f" (sentinel re-arming): {e!r}")
+                return
+            if self.update_ratio:
+                self._prev_params = self._copy(params)
+        self._last_probe = (bool(finite), pnorm, unorm)
+        tm.gauge("health.params_finite", finite)
+        tm.gauge("health.param_norm", pnorm)
+        if not finite:
+            tm.set_health("training.finite", False,
+                          f"non-finite params at iteration {iteration}")
+            self._anomaly(iteration, "params_non_finite",
+                          f"param_norm={pnorm}")
+        else:
+            tm.set_health("training.finite", True, "")
+        if prev is not None and pnorm > 0:
+            ratio = unorm / pnorm
+            tm.gauge("health.update_ratio", ratio)
+            ew = self._ratio
+            # ratio == 0 is NOT exempt: an exactly-collapsed window (zero
+            # updates — dead ReLUs, lr hit 0, frozen params) is the purest
+            # vanishing-update signature and must breach the band
+            if ew.n > 3 and ew.outside_band(ratio, self.band_sigma):
+                self._anomaly(
+                    iteration, "update_ratio_anomaly",
+                    f"window update:param ratio {ratio:.3g} vs "
+                    f"ewma {ew.mean:.3g}±{self.band_sigma}·{ew.std():.2g}")
+            ew.update(ratio)
+        # device HBM gauges are served by the registry's scrape-time
+        # collector (telemetry.install_default_collectors) — pushing them
+        # here too would emit duplicate Prometheus series
+        tm.install_default_collectors()
+
+    # ---------------------------------------------------------------- export
+    def state(self) -> dict:
+        """JSON-able monitor state (tests + crash dump)."""
+        return {
+            "loss_ewma": self._loss.mean, "loss_ewma_std": self._loss.std(),
+            "loss_best": self._loss.best, "iterations_seen": self._loss.n,
+            "update_ratio_ewma": self._ratio.mean,
+            "last_probe": self._last_probe,
+            "anomalies": [
+                {"iteration": i, "type": k, "detail": d}
+                for i, k, d in self.anomalies[-50:]],
+        }

@@ -150,12 +150,26 @@ def _panic_check(name, out, cfg):
 
 def check_numerics(tree, where: str = ""):
     """OpExecutionerUtil.checkForAny parity, usable on any pytree (params,
-    grads) from user code or listeners."""
+    grads) from user code or listeners. The error names the pytree KEY-PATH
+    of every offending leaf (``jax.tree_util.tree_flatten_with_path``) with
+    its shape and nan/inf counts — not just the enclosing ``where`` label —
+    so a single bad layer is identifiable without a debugger."""
+    bad = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         arr = np.asarray(leaf)
-        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-            key = jax.tree_util.keystr(path)
-            raise NaNPanicError(f"non-finite values at {where}{key}")
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        finite = np.isfinite(arr)
+        if finite.all():
+            continue
+        key = jax.tree_util.keystr(path)
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        bad.append(f"{where}{key} shape={tuple(arr.shape)} "
+                   f"nan={n_nan} inf={n_inf}")
+    if bad:
+        raise NaNPanicError(
+            "non-finite values at " + "; ".join(bad))
 
 
 @contextlib.contextmanager

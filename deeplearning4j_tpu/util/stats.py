@@ -28,6 +28,12 @@ import numpy as np
 
 def _summary(arr, bins: int = 0) -> Dict[str, float]:
     a = np.asarray(arr, np.float64)
+    if a.size == 0:
+        # size-0 leaves (empty embedding slices, 0-row batches) must not
+        # crash the listener: np.min/np.max on empty raise, np.mean warns
+        # and returns nan. NaN-safe summary; l2 of nothing is exactly 0.
+        return {"mean": float("nan"), "std": float("nan"),
+                "min": float("nan"), "max": float("nan"), "l2": 0.0}
     out = {
         "mean": float(a.mean()), "std": float(a.std()),
         "min": float(a.min()), "max": float(a.max()),
@@ -35,10 +41,14 @@ def _summary(arr, bins: int = 0) -> Dict[str, float]:
     }
     if bins:
         # histogram bins for the UI histogram pages (DL4J model-page
-        # parameter/update histograms)
-        counts, edges = np.histogram(a.ravel(), bins=bins)
-        out["hist"] = [int(c) for c in counts]
-        out["hist_range"] = [float(edges[0]), float(edges[-1])]
+        # parameter/update histograms); non-finite values would make
+        # np.histogram's range computation raise
+        flat = a.ravel()
+        finite = flat[np.isfinite(flat)]
+        if finite.size:
+            counts, edges = np.histogram(finite, bins=bins)
+            out["hist"] = [int(c) for c in counts]
+            out["hist_range"] = [float(edges[0]), float(edges[-1])]
     return out
 
 
@@ -167,6 +177,15 @@ class StatsListener:
         from deeplearning4j_tpu.util.compile_watcher import get_watcher
 
         rec["compile"] = get_watcher().counts()
+        # telemetry group (docs/OBSERVABILITY.md): the registry's counters/
+        # gauges ride along too, so one stats record correlates score,
+        # compile state, pipeline health, and device memory at this step
+        from deeplearning4j_tpu.util import telemetry as tele
+
+        if tele.enabled():
+            snap = tele.get_telemetry().snapshot()
+            rec["telemetry"] = {"counters": snap["counters"],
+                                "gauges": snap["gauges"]}
         self.storage.put(rec)
 
 
@@ -205,6 +224,24 @@ class CrashReportingUtil:
         layers = getattr(model, "layers", None)
         if layers is not None:
             info["config"] = [type(l).__name__ for l in layers]
+        # full model configuration JSON (the reference dumps the network
+        # conf too — the crash report must reproduce the topology)
+        conf = getattr(model, "conf", None)
+        if conf is not None and hasattr(conf, "to_json"):
+            try:
+                info["config_json"] = json.loads(conf.to_json())
+            except Exception:
+                info["config_json"] = None
+        # telemetry at the moment of death: every counter/gauge (incl. the
+        # live/peak HBM gauges from the health monitors), histogram
+        # summaries, health checks, and the last-50 trace events — what was
+        # in flight when it crashed (docs/OBSERVABILITY.md)
+        from deeplearning4j_tpu.util import telemetry as tele
+
+        info["telemetry"] = tele.get_telemetry().snapshot(events_tail=50)
+        info["hbm"] = [
+            {"metric": name, **labels, "value": value}
+            for name, labels, value in tele.device_memory_stats()]
         with open(path, "w") as f:
             json.dump(info, f, indent=2)
         return path

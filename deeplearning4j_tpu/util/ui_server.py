@@ -87,8 +87,8 @@ class UIServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, body: bytes, ctype: str):
-                self.send_response(200)
+            def _send(self, body: bytes, ctype: str, status: int = 200):
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -100,7 +100,17 @@ class UIServer:
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 session = q.get("session", [None])[0]
-                if u.path == "/train/sessions":
+                if u.path == "/metrics":
+                    # Prometheus exposition (docs/OBSERVABILITY.md): the
+                    # process telemetry registry + scrape-time collectors
+                    # (compile counters, HBM stats, cache entries)
+                    self._send(server._metrics_text().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif u.path == "/healthz":
+                    body, ok = server._healthz()
+                    self._send(body.encode(), "application/json",
+                               status=200 if ok else 503)
+                elif u.path == "/train/sessions":
                     self._send(json.dumps(server._sessions()).encode(),
                                "application/json")
                 elif u.path.startswith("/train/data"):
@@ -135,6 +145,37 @@ class UIServer:
             self._httpd = None
         if UIServer._instance is self:
             UIServer._instance = None
+
+    # ------------------------------------------------- telemetry endpoints
+    @staticmethod
+    def _metrics_text() -> str:
+        """Prometheus text for /metrics: install the default collectors on
+        first scrape so compile/HBM/cache gauges appear without any caller
+        wiring (docs/OBSERVABILITY.md lists the metric names)."""
+        from deeplearning4j_tpu.util import telemetry as tm
+
+        return tm.install_default_collectors().prometheus_text()
+
+    @staticmethod
+    def _healthz() -> "tuple[str, bool]":
+        """(JSON body, healthy?) for /healthz: aggregates every health
+        check published by util/health.py monitors, plus device liveness
+        (PJRT still answers). Unhealthy serves HTTP 503 so a k8s probe or
+        LB drains the task without parsing the body."""
+        from deeplearning4j_tpu.util import telemetry as tm
+
+        ok, checks = tm.get_telemetry().health_report()
+        try:
+            import jax
+
+            n_dev = len(jax.devices())
+            checks["devices"] = {"ok": n_dev > 0, "detail": f"{n_dev} visible"}
+            ok = ok and n_dev > 0
+        except Exception as e:
+            checks["devices"] = {"ok": False, "detail": repr(e)}
+            ok = False
+        return json.dumps({"status": "ok" if ok else "unhealthy",
+                           "checks": checks}), ok
 
     # ------------------------------------------------------------- rendering
     def _render(self, session: "Optional[str]" = None) -> str:

@@ -73,6 +73,61 @@ class TestOpProfiler:
         with pytest.raises(NaNPanicError, match="w"):
             check_numerics({"w": jnp.asarray([1.0, np.nan])})
 
+    def test_check_numerics_reports_nested_keypath(self):
+        """ISSUE 4 satellite: the error names the offending LEAF's pytree
+        key-path (tree_flatten_with_path), not just the enclosing label."""
+        tree = {"layer0": {"W": jnp.ones((2, 2)), "b": jnp.zeros(2)},
+                "layer1": [jnp.ones(3),
+                           jnp.asarray([np.inf, 1.0, np.nan])]}
+        with pytest.raises(NaNPanicError) as exc:
+            check_numerics(tree, where="grads")
+        msg = str(exc.value)
+        assert "grads['layer1'][1]" in msg  # the exact leaf, not 'layer1'
+        assert "nan=1" in msg and "inf=1" in msg
+        assert "shape=(3,)" in msg
+        assert "layer0" not in msg  # healthy leaves are not blamed
+
+    def test_check_numerics_reports_every_bad_leaf(self):
+        with pytest.raises(NaNPanicError) as exc:
+            check_numerics({"a": jnp.asarray([np.nan]),
+                            "z": jnp.asarray([np.inf])})
+        assert "['a']" in str(exc.value) and "['z']" in str(exc.value)
+
+
+class TestSummary:
+    """ISSUE 4 satellite: _summary must be NaN-safe on degenerate arrays."""
+
+    def test_empty_array_returns_nan_safe_summary(self):
+        from deeplearning4j_tpu.util.stats import _summary
+
+        s = _summary(np.zeros((0, 4), np.float32), bins=10)
+        assert np.isnan(s["mean"]) and np.isnan(s["std"])
+        assert np.isnan(s["min"]) and np.isnan(s["max"])
+        assert s["l2"] == 0.0
+        assert "hist" not in s  # no fabricated histogram for no data
+
+    def test_nonfinite_values_do_not_break_histogram(self):
+        from deeplearning4j_tpu.util.stats import _summary
+
+        s = _summary(np.asarray([1.0, np.nan, 2.0, np.inf]), bins=4)
+        assert sum(s["hist"]) == 2  # only the finite values binned
+        assert s["hist_range"] == [1.0, 2.0]
+
+    def test_all_nonfinite_skips_histogram(self):
+        from deeplearning4j_tpu.util.stats import _summary
+
+        s = _summary(np.asarray([np.nan, np.inf]), bins=4)
+        assert "hist" not in s  # nothing finite to bin, and no crash
+
+    def test_stats_listener_survives_empty_param_leaf(self, rng):
+        """The regression that motivated the fix: a 0-sized leaf in the
+        param tree must not crash iteration_done."""
+        from deeplearning4j_tpu.util.stats import _summary
+
+        flat = {"layer0.W": np.zeros((0,), np.float32)}
+        out = {k: _summary(v, bins=8) for k, v in flat.items()}
+        assert np.isnan(out["layer0.W"]["mean"])
+
 
 class TestStats:
     def _train(self, listener, rng):
@@ -136,3 +191,42 @@ class TestStats:
         assert info["exception"] == "MemoryError('boom')"
         assert info["param_bytes"]["layer0.W"] > 0
         assert info["config"] == ["DenseLayer", "OutputLayer"]
+
+    def test_crash_dump_config_memory_telemetry(self, rng, tmp_path):
+        """ISSUE 4 satellite: a simulated training failure's dump carries
+        the full config JSON, memory stats, and the last-N telemetry
+        counters/events that were in flight when it died."""
+        from deeplearning4j_tpu.util import telemetry as tm
+
+        tele = tm.get_telemetry()
+        tele.reset()
+        was = tele.enabled
+        tele.enabled = True
+        try:
+            net = self._train(StepTimer(), rng)
+            p = tmp_path / "crash2.json"
+            try:  # simulate a mid-fit failure
+                net._fit_batch(np.full((16, 4), np.nan, np.float32),
+                               np.eye(2, dtype=np.float32)[[0] * 16])
+                raise FloatingPointError("loss went non-finite")
+            except FloatingPointError as e:
+                CrashReportingUtil.write_crash_dump(net, str(p), e)
+            info = json.loads(p.read_text())
+            # config JSON reproduces the topology
+            cfg = info["config_json"]
+            assert cfg and "layers" in json.dumps(cfg)
+            # memory stats: host view of param buffers always present;
+            # device stats when the backend reports them (None on CPU)
+            assert info["param_bytes"]["layer0.W"] > 0
+            assert "device_memory_stats" in info and "hbm" in info
+            # telemetry: the training counters + the last-N trace events
+            tl = info["telemetry"]
+            assert tl["counters"]["train.steps_total{model=mln}"] >= 6
+            assert tl["histograms"]["train.step_seconds{model=mln}"][
+                "count"] >= 1
+            assert tl["recent_events"], "last-N trace events missing"
+            assert any(e["name"] == "mln.train_step"
+                       for e in tl["recent_events"])
+        finally:
+            tele.enabled = was
+            tele.reset()
