@@ -66,6 +66,12 @@ echo "== step: Telemetry smoke (2-step fit, /metrics + /healthz, trace schema) =
 # with spans from >= 3 distinct PIDs/threads (event schema check).
 JAX_PLATFORMS=cpu python benchmarks/telemetry_smoke.py
 
+echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
+# ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
+# bands (noise-aware, direction-aware); the latest record must pass, and
+# the self-test must prove the gate FAILS on a synthetic regression.
+python benchmarks/regression_gate.py --ci
+
 echo "== step: Test (pytest, JAX_PLATFORMS=cpu, 8 virtual devices) =="
 JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

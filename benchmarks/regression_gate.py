@@ -1,0 +1,288 @@
+"""Perf-regression gate over the committed BENCH trajectory.
+
+Every round the driver commits a ``BENCH_r<NN>.json`` record (one
+``bench.py`` run: headline metric + ``extra_metrics``, each with a
+median-of-3 ``value`` and an explicit ``noise`` spread). This gate turns
+that trajectory from prose into a machine check: it computes a
+**noise-aware best-known band** per metric and fails loudly when a
+candidate run regresses beyond it — so a future PR's perf claims are
+verified the same way its correctness claims are (the CI leg in
+``.github/workflows/ci.yml`` / ``.github/ci_local.sh``).
+
+Band rule, per metric::
+
+    best      = best value over the trajectory (direction-aware)
+    tol       = noise(best record) + noise(candidate) + slack
+    bound     = best * (1 - tol)      # higher-is-better metrics
+                best * (1 + tol)      # lower-is-better (overhead ratios)
+    regressed = candidate beyond bound
+
+Noise fractions come from each record's own ``noise`` field ("±7.2%
+(3-sample spread/2)"); records predating the noise field get
+``--default-noise`` (5%). The additive ``--slack`` (2%) absorbs
+host-to-host drift. The bound is intentionally one-sided: a new best is a
+pass (and tightens the band once committed), only a regression fails.
+
+Modes:
+
+- ``--ci``: gate the LATEST committed record against the band of the whole
+  trajectory (must pass on a healthy repo), then run the built-in
+  self-test — re-gate with a synthetically regressed copy of the headline
+  metric and require the gate to FAIL. A gate that cannot fail is not a
+  gate; CI proves both directions every run.
+- ``--check FILE|-``: gate a fresh ``bench.py`` output (its single JSON
+  line, or a committed-record wrapper) — the local pre-commit workflow.
+- default (no mode): report the bands.
+
+Exit status: 0 = pass, 1 = regression (or a self-test that failed to
+fail), 2 = usage/data errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_NOISE = 0.05   # records predating the explicit noise field
+DEFAULT_SLACK = 0.02   # additive cross-session drift allowance
+
+# Metrics where SMALLER is better (overhead/ratio style); everything else
+# (throughput, efficiency) is higher-is-better.
+LOWER_BETTER = {
+    "host_pipeline_overlap",
+    "telemetry_overhead",
+    "recompile_overhead",
+    "cost_attribution_overhead",
+}
+
+_NOISE_RE = re.compile(r"[+±]?\s*([0-9.]+)\s*%")
+
+
+def parse_noise(noise_field) -> Optional[float]:
+    """'±7.2% (3-sample spread/2)' -> 0.072; None/garbage -> None."""
+    if not noise_field:
+        return None
+    m = _NOISE_RE.search(str(noise_field))
+    if not m:
+        return None
+    try:
+        return float(m.group(1)) / 100.0
+    except ValueError:
+        return None
+
+
+def _metric_rows(parsed: dict) -> List[dict]:
+    rows = [parsed] + list(parsed.get("extra_metrics") or [])
+    return [r for r in rows
+            if isinstance(r, dict) and "metric" in r
+            and isinstance(r.get("value"), (int, float))]
+
+
+def load_record(obj: dict, label: str) -> Dict[str, Tuple[float, Optional[float]]]:
+    """One committed BENCH wrapper ({"n":.., "parsed": {...}}) or raw
+    bench.py result dict -> {metric: (value, noise_frac)}."""
+    parsed = obj.get("parsed", obj)
+    if not isinstance(parsed, dict) or "metric" not in parsed:
+        raise ValueError(f"{label}: no bench metrics found")
+    return {r["metric"]: (float(r["value"]), parse_noise(r.get("noise")))
+            for r in _metric_rows(parsed)}
+
+
+def load_trajectory(paths: List[str]):
+    """[(label, {metric: (value, noise)})] in round order."""
+    out = []
+    for p in sorted(paths):
+        with open(p) as f:
+            obj = json.load(f)
+        try:
+            out.append((os.path.basename(p), load_record(obj, p)))
+        except ValueError as e:
+            print(f"regression_gate: skipping {p}: {e}", file=sys.stderr)
+    return out
+
+
+def best_known(trajectory, metric: str):
+    """(best_value, best_noise, best_label) direction-aware, or None."""
+    lower = metric in LOWER_BETTER
+    best = None
+    for label, metrics in trajectory:
+        if metric not in metrics:
+            continue
+        value, noise = metrics[metric]
+        if best is None \
+                or (value < best[0] if lower else value > best[0]):
+            best = (value, noise, label)
+    return best
+
+
+def gate(trajectory, candidate: Dict[str, Tuple[float, Optional[float]]],
+         default_noise: float = DEFAULT_NOISE,
+         slack: float = DEFAULT_SLACK) -> List[dict]:
+    """Evaluate every trajectory metric against the candidate. Returns one
+    result dict per metric: status in {ok, regressed, missing, new}."""
+    results = []
+    seen = set()
+    for metric in {m for _, ms in trajectory for m in ms}:
+        seen.add(metric)
+        best = best_known(trajectory, metric)
+        if best is None:
+            continue
+        best_value, best_noise, best_label = best
+        lower = metric in LOWER_BETTER
+        if metric not in candidate:
+            results.append({"metric": metric, "status": "missing",
+                            "best": best_value, "best_round": best_label})
+            continue
+        value, noise = candidate[metric]
+        tol = ((best_noise if best_noise is not None else default_noise)
+               + (noise if noise is not None else default_noise) + slack)
+        bound = best_value * (1 + tol) if lower else best_value * (1 - tol)
+        regressed = value > bound if lower else value < bound
+        results.append({
+            "metric": metric,
+            "status": "regressed" if regressed else "ok",
+            "value": value,
+            "best": best_value,
+            "best_round": best_label,
+            "bound": bound,
+            "tolerance_frac": round(tol, 4),
+            "direction": "lower" if lower else "higher",
+        })
+    for metric, (value, _noise) in candidate.items():
+        if metric not in seen:
+            results.append({"metric": metric, "status": "new",
+                            "value": value})
+    return sorted(results, key=lambda r: r["metric"])
+
+
+def render(results: List[dict]) -> str:
+    lines = []
+    for r in results:
+        if r["status"] == "ok":
+            lines.append(
+                f"  OK        {r['metric']}: {r['value']:g} within band "
+                f"(best {r['best']:g} @ {r['best_round']}, bound "
+                f"{r['bound']:g}, {r['direction']}-is-better)")
+        elif r["status"] == "regressed":
+            lines.append(
+                f"  REGRESSED {r['metric']}: {r['value']:g} beyond bound "
+                f"{r['bound']:g} (best {r['best']:g} @ {r['best_round']}, "
+                f"tol {100 * r['tolerance_frac']:.1f}%, "
+                f"{r['direction']}-is-better)")
+        elif r["status"] == "missing":
+            lines.append(
+                f"  MISSING   {r['metric']}: not in candidate run "
+                f"(best {r['best']:g} @ {r['best_round']})")
+        else:
+            lines.append(
+                f"  NEW       {r['metric']}: {r['value']:g} "
+                "(no trajectory yet)")
+    return "\n".join(lines)
+
+
+def _passed(results: List[dict], strict: bool) -> bool:
+    bad = {"regressed"} | ({"missing"} if strict else set())
+    return not any(r["status"] in bad for r in results)
+
+
+def _load_candidate_file(path: str) -> Dict[str, Tuple[float, Optional[float]]]:
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    # accept either a full JSON document or bench.py's stdout (JSON line
+    # surrounded by logging noise)
+    try:
+        return load_record(json.loads(text), path)
+    except (json.JSONDecodeError, ValueError):
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return load_record(json.loads(line), path)
+        raise ValueError(f"{path}: no JSON bench record found")
+
+
+def self_test(trajectory, default_noise: float, slack: float) -> bool:
+    """Prove the gate FAILS on an injected regression: take the latest
+    record, push its headline (first) metric far beyond the band, and
+    require a 'regressed' verdict. Returns True when the gate behaves."""
+    label, latest = trajectory[-1]
+    metric = next(iter(latest))
+    value, noise = latest[metric]
+    lower = metric in LOWER_BETTER
+    corrupted = dict(latest)
+    corrupted[metric] = (value * (3.0 if lower else 1.0 / 3.0), noise)
+    results = gate(trajectory, corrupted,
+                   default_noise=default_noise, slack=slack)
+    verdicts = {r["metric"]: r["status"] for r in results}
+    ok = verdicts.get(metric) == "regressed"
+    print(f"self-test: injected {metric} = {corrupted[metric][0]:g} "
+          f"(was {value:g}) -> {verdicts.get(metric)} "
+          f"[{'ok' if ok else 'GATE DID NOT FIRE'}]")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench-glob", default=os.path.join(
+        REPO_ROOT, "BENCH_r*.json"),
+        help="committed trajectory records (default: repo BENCH_r*.json)")
+    ap.add_argument("--ci", action="store_true",
+                    help="gate the latest committed record + run the "
+                         "injected-regression self-test")
+    ap.add_argument("--check", metavar="FILE",
+                    help="gate a fresh bench.py output file ('-' = stdin)")
+    ap.add_argument("--strict", action="store_true",
+                    help="metrics missing from the candidate fail the gate")
+    ap.add_argument("--default-noise", type=float, default=DEFAULT_NOISE)
+    ap.add_argument("--slack", type=float, default=DEFAULT_SLACK)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable results on stdout")
+    args = ap.parse_args(argv)
+
+    paths = glob.glob(args.bench_glob)
+    trajectory = load_trajectory(paths)
+    if not trajectory:
+        print(f"regression_gate: no BENCH records match {args.bench_glob}",
+              file=sys.stderr)
+        return 2
+
+    if args.check:
+        try:
+            candidate = _load_candidate_file(args.check)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"regression_gate: {e}", file=sys.stderr)
+            return 2
+        label = args.check
+    else:
+        label, candidate = trajectory[-1]
+
+    results = gate(trajectory, candidate,
+                   default_noise=args.default_noise, slack=args.slack)
+    if args.json:
+        print(json.dumps({"candidate": label, "results": results}))
+    else:
+        print(f"regression gate: candidate = {label}, trajectory = "
+              f"{len(trajectory)} records")
+        print(render(results))
+    ok = _passed(results, args.strict)
+    if not ok:
+        print("regression gate: FAIL", file=sys.stderr)
+        return 1
+    if args.ci:
+        if not self_test(trajectory, args.default_noise, args.slack):
+            print("regression gate: self-test FAIL — the gate did not "
+                  "flag an injected regression", file=sys.stderr)
+            return 1
+    # keep stdout pure JSON under --json (machine consumers parse it whole)
+    print("regression gate: PASS",
+          file=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,12 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   spans, /metrics + /healthz on the UI server. Default ON (span cost is
   ~µs against ms-scale steps — bench.py ``telemetry_overhead``); set to
   0/false to strip every recording hook.
+- ``DL4J_TPU_PEAK_FLOPS`` — the accelerator's peak FLOP/s for the compute
+  dtype in use (e.g. ``1.97e14`` for a TPU v5e chip in bf16). Enables MFU
+  (model FLOPs utilization) in ``net.cost_report()``, the ``/costs`` route,
+  and the ``train.model_flops_utilization`` telemetry gauge
+  (util/cost_model.py, docs/OBSERVABILITY.md). Unset = throughput is still
+  reported, utilization is not (no silent guesses about the hardware).
 """
 
 from __future__ import annotations
@@ -94,6 +100,16 @@ class Environment:
         self.telemetry = _env_bool("DL4J_TPU_TELEMETRY", default=True)
         self._profiler = None
         self._compile_cache_applied = False
+
+    @property
+    def peak_flops(self):
+        """DL4J_TPU_PEAK_FLOPS as FLOP/s (None when unset/unparsable).
+        Read live — ONE parser, in util/cost_model.py, serves this property,
+        cost_report(), and the MFU gauges; a typo degrades to "no MFU", it
+        never crashes training startup for an observability-only knob."""
+        from deeplearning4j_tpu.util.cost_model import peak_flops_from_env
+
+        return peak_flops_from_env()
 
     @classmethod
     def get_instance(cls) -> "Environment":

@@ -39,6 +39,7 @@ from deeplearning4j_tpu.nn import vertices as V
 from deeplearning4j_tpu.nn.conf import (_buckets_from_json, _buckets_to_json,
                                         _detuple)
 from deeplearning4j_tpu.nn.multilayer import _dispatch_sig, _struct_of
+from deeplearning4j_tpu.util import cost_model as cmod
 from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
@@ -345,6 +346,14 @@ class ComputationGraph:
                     f"SharedLayer {n.name!r} references unknown source "
                     f"{n.node.source!r}")
         self._segments = self._build_segments()
+        # Cost attribution (util/cost_model.py): one scope tag per node,
+        # threaded through every trace as named_scope("layer:<tag>"). A
+        # SharedLayer node computes under its OWN tag with the source's
+        # params — weight-shared layers legitimately appear in two rows.
+        self._node_tags = {n.name: cmod.sanitize_tag(n.name)
+                           for n in self.topo}
+        self._cost_flops_per_example = None  # set by cost_report()
+        self._peak_flops = None
         # Shape bucketing (data/bucketing.py) + AOT-warmed executables
         self._bucketing = BucketingPolicy.from_conf(conf)
         self._aot_steps: dict = {}
@@ -553,10 +562,12 @@ class ComputationGraph:
                 k = keys[n.name] if keys is not None else None
                 x = self._gather_input(acts, n)
                 lyr, pkey = self._resolve_shared(n.node, n.name)
-                h, ns = lyr.apply(
-                    cparams[pkey], states[pkey], x,
-                    training=training, key=k, **self._mask_kw(lyr, mask, x),
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    h, ns = lyr.apply(
+                        cparams[pkey], states[pkey], x,
+                        training=training, key=k,
+                        **self._mask_kw(lyr, mask, x),
+                    )
                 acts[n.name] = h
                 new_states[pkey] = ns
             else:
@@ -595,21 +606,23 @@ class ComputationGraph:
                     )
                 lm = (label_mask.get(n.name)
                       if isinstance(label_mask, dict) else label_mask)
-                out_loss = n.node.compute_loss(
-                    cparams[n.name], states[n.name], x, labels[n.name],
-                    training=True, key=keys[n.name], weights=weights,
-                    **self._loss_mask_kw(n.node, mk, lm, x),
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    out_loss = n.node.compute_loss(
+                        cparams[n.name], states[n.name], x, labels[n.name],
+                        training=True, key=keys[n.name], weights=weights,
+                        **self._loss_mask_kw(n.node, mk, lm, x),
+                    )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32)
                 )
                 acts[n.name] = x  # terminal; activation unused downstream
             else:
                 lyr, pkey = self._resolve_shared(n.node, n.name)
-                h, ns = lyr.apply(
-                    cparams[pkey], states[pkey], x, training=True,
-                    key=keys[n.name], **self._mask_kw(lyr, mk, x),
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    h, ns = lyr.apply(
+                        cparams[pkey], states[pkey], x, training=True,
+                        key=keys[n.name], **self._mask_kw(lyr, mk, x),
+                    )
                 acts[n.name] = h
                 new_states[pkey] = ns
         reg = sum(
@@ -646,10 +659,11 @@ class ComputationGraph:
                     if n.is_layer:
                         x = self._gather_input(a, n)
                         lyr, pkey = self._resolve_shared(n.node, n.name)
-                        h, ns = lyr.apply(
-                            seg_params[pkey], seg_states[pkey], x,
-                            training=True, key=seg_keys[n.name],
-                        )
+                        with cmod.layer_scope(self._node_tags[n.name]):
+                            h, ns = lyr.apply(
+                                seg_params[pkey], seg_states[pkey], x,
+                                training=True, key=seg_keys[n.name],
+                            )
                         a[n.name] = h
                         st[pkey] = ns
                     else:
@@ -688,20 +702,22 @@ class ComputationGraph:
                     raise ValueError(
                         f"output {n.name!r} must be an OutputLayer/LossLayer"
                     )
-                out_loss = n.node.compute_loss(
-                    cparams[n.name], states[n.name], x, labels[n.name],
-                    training=True, key=keys[n.name], weights=weights,
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    out_loss = n.node.compute_loss(
+                        cparams[n.name], states[n.name], x, labels[n.name],
+                        training=True, key=keys[n.name], weights=weights,
+                    )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32)
                 )
                 acts[n.name] = x
             else:
                 lyr, pkey = self._resolve_shared(n.node, n.name)
-                h, ns = lyr.apply(
-                    cparams[pkey], states[pkey], x, training=True,
-                    key=keys[n.name],
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    h, ns = lyr.apply(
+                        cparams[pkey], states[pkey], x, training=True,
+                        key=keys[n.name],
+                    )
                 acts[n.name] = h
                 new_states[pkey] = ns
         reg = sum(
@@ -751,30 +767,33 @@ class ComputationGraph:
             if n.name in out_names:
                 lm = (label_mask.get(n.name)
                       if isinstance(label_mask, dict) else label_mask)
-                out_loss = n.node.compute_loss(
-                    cparams[n.name], states[n.name], x, labels[n.name],
-                    training=True, key=keys[n.name], weights=weights,
-                    **self._loss_mask_kw(n.node, mk, lm, x),
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    out_loss = n.node.compute_loss(
+                        cparams[n.name], states[n.name], x, labels[n.name],
+                        training=True, key=keys[n.name], weights=weights,
+                        **self._loss_mask_kw(n.node, mk, lm, x),
+                    )
                 loss = loss + out_loss.astype(
                     jnp.promote_types(out_loss.dtype, jnp.float32))
                 acts[n.name] = x
             elif n.name in carries:
-                xx = n.node._maybe_dropout(x, True, keys[n.name])
                 seg_mask = (mk if (mk is not None and x.ndim == 3
                                    and mk.shape[:2] == x.shape[:2])
                             else None)
-                h, c = n.node.apply_seq(
-                    cparams[n.name], xx, carries[n.name], mask=seg_mask,
-                    training=True, key=keys[n.name])
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    xx = n.node._maybe_dropout(x, True, keys[n.name])
+                    h, c = n.node.apply_seq(
+                        cparams[n.name], xx, carries[n.name], mask=seg_mask,
+                        training=True, key=keys[n.name])
                 acts[n.name] = h
                 new_carries[n.name] = c
             else:
                 lyr, pkey = self._resolve_shared(n.node, n.name)
-                h, ns = lyr.apply(
-                    cparams[pkey], states[pkey], x, training=True,
-                    key=keys[n.name], **self._mask_kw(lyr, mk, x),
-                )
+                with cmod.layer_scope(self._node_tags[n.name]):
+                    h, ns = lyr.apply(
+                        cparams[pkey], states[pkey], x, training=True,
+                        key=keys[n.name], **self._mask_kw(lyr, mk, x),
+                    )
                 acts[n.name] = h
                 new_states[pkey] = ns
         reg = sum((n.node.regularization(params[n.name])
@@ -799,14 +818,15 @@ class ComputationGraph:
             )(params, states, carries, inputs, labels, keys, mask, label_mask,
               weights)
             new_params, new_opts = dict(params), dict(opts)
-            for name in layer_names:
-                if not grads[name]:
-                    continue
-                p, s = upd.apply_updater(
-                    updaters[name], params[name], grads[name], opts[name],
-                    iteration)
-                new_params[name] = p
-                new_opts[name] = s
+            with cmod.optimizer_scope():  # cost attribution: (optimizer) row
+                for name in layer_names:
+                    if not grads[name]:
+                        continue
+                    p, s = upd.apply_updater(
+                        updaters[name], params[name], grads[name], opts[name],
+                        iteration)
+                    new_params[name] = p
+                    new_opts[name] = s
             return new_params, new_states, new_opts, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -1044,15 +1064,16 @@ class ComputationGraph:
                 params, states, inputs, labels, keys, weights, mask, label_mask
             )
             new_params, new_opts = dict(params), dict(opt_states)
-            for name in layer_names:
-                if not grads[name]:
-                    continue
-                p, s = upd.apply_updater(
-                    updaters[name], params[name], grads[name], opt_states[name],
-                    iteration,
-                )
-                new_params[name] = p
-                new_opts[name] = s
+            with cmod.optimizer_scope():  # cost attribution: (optimizer) row
+                for name in layer_names:
+                    if not grads[name]:
+                        continue
+                    p, s = upd.apply_updater(
+                        updaters[name], params[name], grads[name],
+                        opt_states[name], iteration,
+                    )
+                    new_params[name] = p
+                    new_opts[name] = s
             return new_params, new_states, new_opts, loss
 
         if weighted:
@@ -1142,8 +1163,18 @@ class ComputationGraph:
 
             now = _time.time_ns()
             if self._last_fit_ns is not None:
-                tm.observe("train.step_seconds",
-                           (now - self._last_fit_ns) / 1e9, model="cg")
+                dt = (now - self._last_fit_ns) / 1e9
+                tm.observe("train.step_seconds", dt, model="cg")
+                if dt > 0:
+                    # cost attribution gauges (docs/OBSERVABILITY.md)
+                    tm.gauge("train.examples_per_sec", real_n / dt,
+                             model="cg")
+                    if self._cost_flops_per_example and self._peak_flops:
+                        tm.gauge(
+                            "train.model_flops_utilization",
+                            self._cost_flops_per_example
+                            * np.shape(features[0])[0] / dt
+                            / self._peak_flops, model="cg")
             self._last_fit_ns = now
             tm.counter("train.steps_total", model="cg")
         # dispatch span with XLA trace/compile sub-spans when this shape
@@ -1248,6 +1279,116 @@ class ComputationGraph:
         return aot_build(store, tag, self.conf.to_json(), sig, jit_fn,
                          args, kwargs)
 
+    # -------------------------------------------------------- cost reporting
+    def cost_report(self, batch_size=None, *, shapes=None,
+                    dtype=jnp.float32, profile: bool = False, steps: int = 3,
+                    peak_flops=None, name: str = "cg",
+                    publish: bool = True):
+        """Per-node FLOPs / bytes / device-time cost table for ONE train
+        step — the ComputationGraph twin of
+        :meth:`MultiLayerNetwork.cost_report` (same artifact-extraction
+        pipeline: lower().compile() -> cost_analysis() totals + HLO
+        op-metadata attribution over the ``layer:<node>`` scopes; analytic
+        conf-keyed fallback tagged ``source: analytic``). A SharedLayer node
+        shows up as its OWN row (zero params — the source row owns them):
+        weight sharing means one layer legitimately appears in two scopes.
+
+        ``shapes``: one full input shape per graph input (incl. batch dim);
+        defaults to ``batch_size`` x ``conf.input_shapes``."""
+        from deeplearning4j_tpu.util import cost_model as _cm
+
+        if not self.params:
+            raise ValueError("init() the graph before cost_report()")
+        if shapes is None:
+            if self.conf.input_shapes is None:
+                raise ValueError(
+                    "cost_report() needs shapes= or conf.input_shapes")
+            b = int(batch_size or 8)
+            shapes = [(b,) + tuple(s) for s in self.conf.input_shapes]
+        if shapes and not isinstance(shapes[0], (list, tuple)):
+            shapes = [shapes]  # single-input graph, bare shape
+        shapes = [tuple(int(d) for d in s) for s in shapes]
+        if len(shapes) != len(self.conf.inputs):
+            raise ValueError(
+                f"cost_report got {len(shapes)} shapes for "
+                f"{len(self.conf.inputs)} graph inputs")
+        b = shapes[0][0]
+        params_by_tag = {
+            self._node_tags[n.name]: int(sum(
+                int(np.prod(x.shape))
+                for x in jax.tree_util.tree_leaves(self.params[n.name])))
+            for n in self.topo if n.is_layer}
+        if self._train_step is None:
+            self._train_step = self._jit_train_step()
+        p_s, s_s, o_s = (_struct_of(self.params), _struct_of(self.states),
+                         _struct_of(self.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        key_s = _struct_of(self._rng_key)
+        ins_s = {nm: jax.ShapeDtypeStruct(s, dtype)
+                 for nm, s in zip(self.conf.inputs, shapes)}
+        labs_s = {nm: jax.ShapeDtypeStruct((b,) + tuple(self._shape_of[nm]),
+                                           jnp.float32)
+                  for nm in self.conf.outputs}
+        w_s = jax.ShapeDtypeStruct((b,), jnp.float32)
+        compiled = self._train_step.lower(
+            p_s, s_s, o_s, it_s, key_s, ins_s, labs_s, w_s, None,
+            None).compile()
+        totals: dict = {}
+        attrib = None
+        source = "analytic"
+        try:
+            totals = _cm.compiled_totals(compiled)
+            attrib = _cm.attribute_hlo(_cm.compiled_text(compiled))
+            source = "xla"
+        except _cm.CostAnalysisUnavailable:
+            pass
+        step_time = layer_times = device_time = None
+        if profile:
+            rng = np.random.default_rng(0)
+            ins = {}
+            for nm, s in zip(self.conf.inputs, shapes):
+                if jnp.issubdtype(dtype, jnp.floating):
+                    ins[nm] = jnp.asarray(rng.normal(size=s), dtype=dtype)
+                else:
+                    ins[nm] = jnp.zeros(s, dtype)
+            labs = {nm: jnp.zeros((b,) + tuple(self._shape_of[nm]),
+                                  jnp.float32)
+                    for nm in self.conf.outputs}
+            w = jnp.ones((b,), jnp.float32)
+            step_time, layer_times, device_time = _cm.profile_compiled_step(
+                compiled,
+                (self.params, self.states, self.opt_states,
+                 jnp.asarray(0, jnp.int32), self._rng_key),
+                (ins, labs, w, None, None), steps=steps,
+                inst_map=attrib.inst_map if attrib else None)
+        if attrib is not None:
+            rows = _cm.rows_from_attribution(attrib, params_by_tag,
+                                             layer_times)
+        else:
+            entries = []
+            for n in self.topo:
+                if not n.is_layer:
+                    continue
+                in_shape = self._merged_shape(
+                    [tuple(self._shape_of[i]) for i in n.inputs])
+                lyr, _pkey = self._resolve_shared(n.node, n.name)
+                entries.append((self._node_tags[n.name], lyr, in_shape,
+                                params_by_tag.get(
+                                    self._node_tags[n.name], 0)))
+            rows = _cm.analytic_rows(entries, b)
+            totals = {"flops": sum(r.flops for r in rows)}
+        report = _cm.CostReport(
+            rows=rows, totals=totals, batch=b,
+            params_total=self.num_params(), source=source, model=str(name),
+            step_time_s=step_time, device_time_s=device_time,
+            peak_flops=(peak_flops if peak_flops is not None
+                        else _cm.peak_flops_from_env()))
+        self._cost_flops_per_example = report.flops_per_step / b
+        self._peak_flops = report.peak_flops
+        if publish:
+            _cm.publish_report(str(name), report)
+        return report
+
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):
         """fn(params, states, x) -> first-output activations, for serving
@@ -1339,17 +1480,19 @@ class ComputationGraph:
                 if n.name in out_names:
                     lm = (label_mask.get(n.name)
                           if isinstance(label_mask, dict) else label_mask)
-                    loss = loss + n.node.compute_loss(
-                        cparams[n.name], states[n.name], x, labels[n.name],
-                        training=False, weights=weights,
-                        **self._loss_mask_kw(n.node, mk, lm, x),
-                    )
+                    with cmod.layer_scope(self._node_tags[n.name]):
+                        loss = loss + n.node.compute_loss(
+                            cparams[n.name], states[n.name], x,
+                            labels[n.name], training=False, weights=weights,
+                            **self._loss_mask_kw(n.node, mk, lm, x),
+                        )
                     acts[n.name] = x
                 else:
-                    h, _ = n.node.apply(
-                        cparams[n.name], states[n.name], x, training=False,
-                        **self._mask_kw(n.node, mk, x)
-                    )
+                    with cmod.layer_scope(self._node_tags[n.name]):
+                        h, _ = n.node.apply(
+                            cparams[n.name], states[n.name], x,
+                            training=False, **self._mask_kw(n.node, mk, x)
+                        )
                     acts[n.name] = h
             return loss
 

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.util import cost_model as cmod
 from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
@@ -89,6 +90,16 @@ class MultiLayerNetwork:
         # AOT-warmed executables (warmup()): dispatch signature → compiled.
         self._aot_steps: dict = {}
         self._aot_forward: dict = {}
+        # Cost attribution (util/cost_model.py): one stable scope tag per
+        # layer, threaded through every trace as named_scope("layer:<tag>")
+        # so the compiled HLO (and the profiler's device events) attribute
+        # per layer. Index prefix keeps tags unique under repeated names.
+        self._layer_tags = [
+            cmod.sanitize_tag(f"{i}_{lyr.name or type(lyr).__name__}")
+            for i, lyr in enumerate(self.layers)
+        ]
+        self._cost_flops_per_example = None  # set by cost_report()
+        self._peak_flops = None
         # Device-resident 0/1 weight vectors keyed by (size, real-count):
         # fit ALWAYS threads per-example weights (ones when unbucketed), so
         # bucketed and unbucketed batches execute the SAME weighted-loss
@@ -184,7 +195,9 @@ class MultiLayerNetwork:
                 and mask.shape[:2] == h.shape[:2]
             ):
                 kw["mask"] = mask
-            h, ns = lyr.apply(cparams[i], states[i], h, training=training, key=k, **kw)
+            with cmod.layer_scope(self._layer_tags[i]):
+                h, ns = lyr.apply(cparams[i], states[i], h,
+                                  training=training, key=k, **kw)
             new_states.append(ns)
             if h.ndim < 3:
                 mask = None  # time axis consumed (LastTimeStep/GlobalPooling)
@@ -210,17 +223,20 @@ class MultiLayerNetwork:
                 else None
             )
             if carries is not None and self._is_recurrent(lyr):
-                h = lyr._maybe_dropout(h, training, keys[i])
-                h, c = lyr.apply_seq(cparams[i], h, carries[i], mask=seg_mask,
-                                     training=training, key=keys[i])
+                with cmod.layer_scope(self._layer_tags[i]):
+                    h = lyr._maybe_dropout(h, training, keys[i])
+                    h, c = lyr.apply_seq(cparams[i], h, carries[i],
+                                         mask=seg_mask, training=training,
+                                         key=keys[i])
                 new_carries.append(c)
                 new_states.append(states[i])
             else:
                 kw = {}
                 if seg_mask is not None and self._mask_aware[i]:
                     kw["mask"] = seg_mask
-                h, ns = lyr.apply(cparams[i], states[i], h, training=training,
-                                  key=keys[i], **kw)
+                with cmod.layer_scope(self._layer_tags[i]):
+                    h, ns = lyr.apply(cparams[i], states[i], h,
+                                      training=training, key=keys[i], **kw)
                 new_states.append(ns)
                 new_carries.append(None if carries is None else carries[i])
             if h.ndim < 3:
@@ -234,10 +250,11 @@ class MultiLayerNetwork:
             loss_kw["mask"] = lm
         if weights is not None:
             loss_kw["weights"] = weights
-        loss = out.compute_loss(
-            cparams[-1], states[-1], h, y, training=training, key=keys[-1],
-            **loss_kw,
-        )
+        with cmod.layer_scope(self._layer_tags[-1]):
+            loss = out.compute_loss(
+                cparams[-1], states[-1], h, y, training=training,
+                key=keys[-1], **loss_kw,
+            )
         new_states.append(states[-1])
         new_carries.append(None if carries is None else carries[-1])
         reg = sum(
@@ -274,9 +291,10 @@ class MultiLayerNetwork:
             def run(seg_params, seg_states, seg_keys, h):
                 st = []
                 for j, i in enumerate(range(a, b)):
-                    h, ns = self.layers[i].apply(
-                        seg_params[j], seg_states[j], h, training=True,
-                        key=seg_keys[j])
+                    with cmod.layer_scope(self._layer_tags[i]):
+                        h, ns = self.layers[i].apply(
+                            seg_params[j], seg_states[j], h, training=True,
+                            key=seg_keys[j])
                     st.append(ns)
                 return h, st
             return run
@@ -292,17 +310,19 @@ class MultiLayerNetwork:
             if self.conf.stage_barriers:
                 h = xla_tuning.barrier(h)
         for i in range(tail_start, len(self.layers) - 1):
-            h, ns = self.layers[i].apply(cparams[i], states[i], h,
-                                         training=True, key=keys[i])
+            with cmod.layer_scope(self._layer_tags[i]):
+                h, ns = self.layers[i].apply(cparams[i], states[i], h,
+                                             training=True, key=keys[i])
             new_states[i] = ns
         out = self.layers[-1]
         if not hasattr(out, "compute_loss"):
             raise ValueError("last layer must be an OutputLayer/LossLayer")
         loss_kw = {} if weights is None else {"weights": weights}
-        loss = out.compute_loss(
-            cparams[-1], states[-1], h, y, training=True, key=keys[-1],
-            **loss_kw,
-        )
+        with cmod.layer_scope(self._layer_tags[-1]):
+            loss = out.compute_loss(
+                cparams[-1], states[-1], h, y, training=True, key=keys[-1],
+                **loss_kw,
+            )
         new_states[-1] = states[-1]
         reg = sum(
             (lyr.regularization(params[i]) for i, lyr in enumerate(self.layers)),
@@ -325,16 +345,18 @@ class MultiLayerNetwork:
                 self._loss, has_aux=True
             )(params, states, x, y, keys, weights, mask, label_mask)
             new_params, new_opts = [], []
-            for i in range(n_layers):
-                if not grads[i]:
-                    new_params.append(params[i])
-                    new_opts.append(opt_states[i])
-                    continue
-                p, s = upd.apply_updater(
-                    updaters[i], params[i], grads[i], opt_states[i], iteration
-                )
-                new_params.append(p)
-                new_opts.append(s)
+            with cmod.optimizer_scope():  # cost attribution: (optimizer) row
+                for i in range(n_layers):
+                    if not grads[i]:
+                        new_params.append(params[i])
+                        new_opts.append(opt_states[i])
+                        continue
+                    p, s = upd.apply_updater(
+                        updaters[i], params[i], grads[i], opt_states[i],
+                        iteration
+                    )
+                    new_params.append(p)
+                    new_opts.append(s)
             return new_params, new_states, new_opts, loss
 
         if weighted:
@@ -426,15 +448,17 @@ class MultiLayerNetwork:
                 seg_loss, has_aux=True
             )(params, states, carries, x, y, keys, weights, mask, label_mask)
             new_params, new_opts = [], []
-            for i in range(n_layers):
-                if not grads[i]:
-                    new_params.append(params[i])
-                    new_opts.append(opt_states[i])
-                    continue
-                p, s = upd.apply_updater(
-                    updaters[i], params[i], grads[i], opt_states[i], iteration)
-                new_params.append(p)
-                new_opts.append(s)
+            with cmod.optimizer_scope():  # cost attribution: (optimizer) row
+                for i in range(n_layers):
+                    if not grads[i]:
+                        new_params.append(params[i])
+                        new_opts.append(opt_states[i])
+                        continue
+                    p, s = upd.apply_updater(
+                        updaters[i], params[i], grads[i], opt_states[i],
+                        iteration)
+                    new_params.append(p)
+                    new_opts.append(s)
             return new_params, new_states, new_opts, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -589,8 +613,19 @@ class MultiLayerNetwork:
         if tm.enabled():
             now = time.time_ns()
             if self._last_fit_ns is not None:
-                tm.observe("train.step_seconds",
-                           (now - self._last_fit_ns) / 1e9, model="mln")
+                dt = (now - self._last_fit_ns) / 1e9
+                tm.observe("train.step_seconds", dt, model="mln")
+                if dt > 0:
+                    # cost attribution gauges (docs/OBSERVABILITY.md): real
+                    # throughput each step; MFU once cost_report() measured
+                    # the program's FLOPs and a peak is configured
+                    tm.gauge("train.examples_per_sec", real_n / dt,
+                             model="mln")
+                    if self._cost_flops_per_example and self._peak_flops:
+                        tm.gauge(
+                            "train.model_flops_utilization",
+                            self._cost_flops_per_example * x.shape[0]
+                            / dt / self._peak_flops, model="mln")
             self._last_fit_ns = now
             tm.counter("train.steps_total", model="mln")
         # dispatch span with XLA trace/compile sub-spans when this shape
@@ -745,6 +780,98 @@ class MultiLayerNetwork:
 
         return aot_build(store, tag, self.conf.to_json(), sig, jit_fn,
                          args, kwargs)
+
+    # -------------------------------------------------------- cost reporting
+    def cost_report(self, batch_size=None, *, shape=None, dtype=jnp.float32,
+                    profile: bool = False, steps: int = 3, peak_flops=None,
+                    name: str = "mln", publish: bool = True):
+        """Per-layer FLOPs / bytes / device-time cost table for ONE train
+        step (docs/OBSERVABILITY.md#cost-attribution--mfu). Static costs
+        come from the compiled executable itself — ``lower().compile()``
+        then ``cost_analysis()`` totals + HLO op-metadata attribution over
+        the ``layer:`` named scopes (util/cost_model.py); backends without
+        XLA cost analysis fall back to analytic conf-keyed formulas, tagged
+        ``source: analytic``.
+
+        ``profile=True`` additionally executes the compiled step on COPIES
+        of the live state (donation-safe — the model does not advance),
+        measuring wall step time and a per-layer fwd/bwd device-time table
+        from the JAX profiler's XPlane events. MFU is reported against
+        ``peak_flops`` (default: the ``DL4J_TPU_PEAK_FLOPS`` env knob).
+        The report publishes to the UI server's ``/costs`` route and primes
+        the ``train.model_flops_utilization`` gauge for subsequent fits."""
+        from deeplearning4j_tpu.util import cost_model as _cm
+
+        if not self.params:
+            raise ValueError("init() the network before cost_report()")
+        if shape is None:
+            if self.conf.input_shape is None:
+                raise ValueError(
+                    "cost_report() needs shape= or conf.input_shape")
+            shape = (int(batch_size or 8),) + tuple(self.conf.input_shape)
+        shape = tuple(int(d) for d in shape)
+        b = shape[0]
+        params_by_tag = {
+            t: int(sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(p)))
+            for t, p in zip(self._layer_tags, self.params)}
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        p_s, s_s, o_s = (_struct_of(self.params), _struct_of(self.states),
+                         _struct_of(self.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        key_s = _struct_of(self._rng_key)
+        x_s = jax.ShapeDtypeStruct(shape, dtype)
+        y_s = jax.ShapeDtypeStruct((b,) + tuple(self._output_shape),
+                                   jnp.float32)
+        w_s = jax.ShapeDtypeStruct((b,), jnp.float32)
+        compiled = self._train_step.lower(
+            p_s, s_s, o_s, it_s, key_s, x_s, y_s, w_s, None, None).compile()
+        totals: dict = {}
+        attrib = None
+        source = "analytic"
+        try:
+            totals = _cm.compiled_totals(compiled)
+            attrib = _cm.attribute_hlo(_cm.compiled_text(compiled))
+            source = "xla"
+        except _cm.CostAnalysisUnavailable:
+            pass
+        step_time = layer_times = device_time = None
+        if profile:
+            rng = np.random.default_rng(0)
+            if jnp.issubdtype(dtype, jnp.floating):
+                x = jnp.asarray(rng.normal(size=shape), dtype=dtype)
+            else:
+                x = jnp.zeros(shape, dtype)
+            y = jnp.zeros((b,) + tuple(self._output_shape), jnp.float32)
+            w = jnp.ones((b,), jnp.float32)
+            step_time, layer_times, device_time = _cm.profile_compiled_step(
+                compiled,
+                (self.params, self.states, self.opt_states,
+                 jnp.asarray(0, jnp.int32), self._rng_key),
+                (x, y, w, None, None), steps=steps,
+                inst_map=attrib.inst_map if attrib else None)
+        if attrib is not None:
+            rows = _cm.rows_from_attribution(attrib, params_by_tag,
+                                             layer_times)
+        else:
+            entries, cur = [], tuple(self.conf.input_shape or shape[1:])
+            for tag, lyr in zip(self._layer_tags, self.layers):
+                entries.append((tag, lyr, cur, params_by_tag.get(tag, 0)))
+                cur = tuple(lyr.output_shape(cur))
+            rows = _cm.analytic_rows(entries, b)
+            totals = {"flops": sum(r.flops for r in rows)}
+        report = _cm.CostReport(
+            rows=rows, totals=totals, batch=b,
+            params_total=self.num_params(), source=source, model=str(name),
+            step_time_s=step_time, device_time_s=device_time,
+            peak_flops=(peak_flops if peak_flops is not None
+                        else _cm.peak_flops_from_env()))
+        self._cost_flops_per_example = report.flops_per_step / b
+        self._peak_flops = report.peak_flops
+        if publish:
+            _cm.publish_report(str(name), report)
+        return report
 
     # ---------------------------------------------------------------- output
     def make_forward_fn(self):

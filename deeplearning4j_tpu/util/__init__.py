@@ -37,7 +37,9 @@ from deeplearning4j_tpu.util.stats import (
     StatsListener,
     to_csv,
 )
+from deeplearning4j_tpu.util import cost_model
 from deeplearning4j_tpu.util import telemetry
+from deeplearning4j_tpu.util.cost_model import CostReport, CostRow
 from deeplearning4j_tpu.util.health import TrainingHealthMonitor
 from deeplearning4j_tpu.util.telemetry import Telemetry, get_telemetry
 
@@ -51,4 +53,5 @@ __all__ = [
     "enable_persistent_cache", "disable_persistent_cache",
     "clear_persistent_cache", "cache_entries", "AotStore",
     "telemetry", "Telemetry", "get_telemetry", "TrainingHealthMonitor",
+    "cost_model", "CostReport", "CostRow",
 ]

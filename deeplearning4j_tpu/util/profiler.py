@@ -25,7 +25,7 @@ import json
 import os
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -64,8 +64,10 @@ class OpProfiler:
     def reset(self):
         self.invocations: Dict[str, int] = defaultdict(int)
         self.total_ns: Dict[str, int] = defaultdict(int)
-        self.events: List[dict] = []  # chrome trace events
-        self._t0 = time.perf_counter_ns()
+        # chrome trace events; ts/dur in WALL ns (time.time_ns) so this
+        # trace and the telemetry trace share one timebase and load into
+        # one Perfetto view (export subtracts telemetry.trace_epoch_ns())
+        self.events: List[dict] = []
 
     # -- hook ---------------------------------------------------------------
     def start(self):
@@ -79,16 +81,16 @@ class OpProfiler:
         prof = self
 
         def wrapped(name, *args, **kwargs):
-            t0 = time.perf_counter_ns()
+            t0 = time.time_ns()
             out = orig(name, *args, **kwargs)
             out = jax.block_until_ready(out)
-            t1 = time.perf_counter_ns()
+            t1 = time.time_ns()
             if cfg.profile_ops:
                 prof.invocations[name] += 1
                 prof.total_ns[name] += t1 - t0
                 prof.events.append({
                     "name": name, "ph": "X", "pid": 0, "tid": 0,
-                    "ts": (t0 - prof._t0) / 1e3, "dur": (t1 - t0) / 1e3,
+                    "ts": t0, "dur": t1 - t0,  # wall ns; export converts
                 })
             if cfg.check_for_nan or cfg.check_for_inf:
                 _panic_check(name, out, cfg)
@@ -126,10 +128,20 @@ class OpProfiler:
         return "\n".join(lines)
 
     def write_chrome_trace(self, path: str):
-        """ProfilingListener parity: chrome://tracing JSON."""
+        """ProfilingListener parity: chrome://tracing JSON. Timestamps are
+        exported relative to the process-shared trace epoch
+        (telemetry.trace_epoch_ns()), so this file and a
+        ``Telemetry.write_chrome_trace`` file from the same run load into
+        ONE Perfetto view on the same wall-clock timeline."""
+        from deeplearning4j_tpu.util.telemetry import trace_epoch_ns
+
+        t0 = trace_epoch_ns()
+        if self.events:
+            t0 = min(t0, min(e["ts"] for e in self.events))
+        out = [dict(e, ts=(e["ts"] - t0) / 1e3, dur=e["dur"] / 1e3)
+               for e in self.events]
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.events,
-                       "displayTimeUnit": "ms"}, f)
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
 
 
 class NaNPanicError(FloatingPointError):
@@ -243,9 +255,11 @@ def _wire_iter(buf: bytes):
 
 
 def parse_xplane(path: str) -> List[dict]:
-    """Parse one .xplane.pb into
-    [{'name': plane, 'lines': [{'name': line, 'events': [(name, dur_ps)]}]}].
-    Event names resolve through the plane's event_metadata table."""
+    """Parse one .xplane.pb into ``[{'name': plane, 'lines': [{'name': line,
+    'events': [(name, dur_ps, offset_ps)]}]}]``. Event names resolve through
+    the plane's event_metadata table; the offset (XEvent.offset_ps, line-
+    relative) lets consumers dedupe NESTED events on one thread line —
+    the cost-attribution grouper only counts outermost matches."""
     with open(path, "rb") as f:
         space = f.read()
     planes = []
@@ -278,13 +292,15 @@ def parse_xplane(path: str) -> List[dict]:
                 if lf == 2 and lwt == 2:
                     lname = lv.decode("utf-8", "replace")
                 elif lf == 4 and lwt == 2:
-                    mid, dur = 0, 0
+                    mid, dur, off = 0, 0, 0
                     for ef, ewt, ev in _wire_iter(lv):
                         if ef == 1 and ewt == 0:
                             mid = ev
+                        elif ef == 2 and ewt == 0:
+                            off = ev
                         elif ef == 3 and ewt == 0:
                             dur = ev
-                    events.append((meta.get(mid, f"#{mid}"), dur))
+                    events.append((meta.get(mid, f"#{mid}"), dur, off))
             parsed_lines.append({"name": lname, "events": events})
         planes.append({"name": name, "lines": parsed_lines})
     return planes
@@ -312,11 +328,11 @@ def xplane_device_ms(logdir: str, plane_substr: str = "/device:",
             best = 0
             best_events: list = []
             for line in plane["lines"]:
-                s = sum(d for _, d in line["events"])
+                s = sum(e[1] for e in line["events"])
                 if s > best:
                     best, best_events = s, line["events"]
             total_ps += best
-            for n, d in best_events:
+            for n, d, _off in best_events:
                 names[n] += d
     ms = total_ps / 1e9
     if by_name:
@@ -343,32 +359,73 @@ def xplane_event_ms(logdir: str, event_name: str,
             if plane_substr not in plane["name"]:
                 continue
             for line in plane["lines"]:
-                total_ps += sum(d for n, d in line["events"]
-                                if n == event_name)
+                total_ps += sum(e[1] for e in line["events"]
+                                if e[0] == event_name)
     return total_ps / 1e9
+
+
+def xplane_mapped_ms(logdir: str, resolve) -> Dict[Any, float]:
+    """Group device/host-thread event time by ``resolve(event_name) -> key``
+    (None = not counted) over every plane/line under ``logdir``, returning
+    {key: total ms}. Used by util/cost_model.py with the compiled module's
+    instruction→(layer, direction) map, so each HLO-named profiler event
+    lands on its layer row.
+
+    Dedup: on one thread line the CPU backend nests spans (a ``call`` thunk
+    wraps the fused kernel's own span); only the OUTERMOST *mapped* event of
+    any overlap chain is counted, so wrapped kernels are never billed twice.
+    The interval walk uses XEvent offsets, which are line-relative — lines
+    are independent, which is exactly the granularity needed."""
+    import glob as _glob
+
+    totals: Dict[Any, float] = defaultdict(float)
+    for p in _glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                        recursive=True):
+        for plane in parse_xplane(p):
+            for line in plane["lines"]:
+                mapped = []
+                for name, dur, off in line["events"]:
+                    key = resolve(name)
+                    if key is not None:
+                        # sort key: by start, LONGEST first on ties, so the
+                        # outermost event of an equal-offset chain wins
+                        mapped.append((off, -dur, key))
+                mapped.sort()
+                covered_end = -1
+                for off, neg_dur, key in mapped:
+                    if off >= covered_end:  # outermost of this overlap chain
+                        totals[key] += -neg_dur / 1e9
+                        covered_end = off - neg_dur
+    return dict(totals)
 
 
 class StepTimer:
     """Whole-train-step Chrome-trace recorder: use as a TrainingListener.
     Produces one 'X' event per iteration (the reference ProfilingListener's
     per-op rows collapse into one fused-step row under XLA — that is the
-    point of whole-graph compilation)."""
+    point of whole-graph compilation). Wall-clock timebase, shared with the
+    OpProfiler and Telemetry exporters (one Perfetto timeline)."""
 
     def __init__(self):
         self.events: List[dict] = []
-        self._t0 = time.perf_counter_ns()
         self._last = None
 
     def iteration_done(self, model, iteration, epoch):
-        now = time.perf_counter_ns()
+        now = time.time_ns()
         if self._last is not None:
             self.events.append({
                 "name": f"train_step[{iteration}]", "ph": "X", "pid": 0,
-                "tid": 0, "ts": (self._last - self._t0) / 1e3,
-                "dur": (now - self._last) / 1e3,
+                "tid": 0, "ts": self._last, "dur": now - self._last,
             })
         self._last = now
 
     def write_chrome_trace(self, path: str):
+        from deeplearning4j_tpu.util.telemetry import trace_epoch_ns
+
+        t0 = trace_epoch_ns()
+        if self.events:
+            t0 = min(t0, min(e["ts"] for e in self.events))
+        out = [dict(e, ts=(e["ts"] - t0) / 1e3, dur=e["dur"] / 1e3)
+               for e in self.events]
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.events, "displayTimeUnit": "ms"}, f)
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)

@@ -186,6 +186,15 @@ class StatsListener:
             snap = tele.get_telemetry().snapshot()
             rec["telemetry"] = {"counters": snap["counters"],
                                 "gauges": snap["gauges"]}
+        # cost group (docs/OBSERVABILITY.md#cost-attribution--mfu): compact
+        # totals/utilization of every published CostReport ride along, so a
+        # stats record correlates score with FLOPs throughput and MFU; the
+        # full per-layer table stays on the /costs route
+        from deeplearning4j_tpu.util import cost_model
+
+        cost = cost_model.cost_stats_group()
+        if cost is not None:
+            rec["cost"] = cost
         self.storage.put(rec)
 
 

@@ -48,6 +48,18 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 # Default span-ring capacity: ~200 bytes/event -> tens of MB worst case.
 _DEFAULT_MAX_EVENTS = 100_000
 
+# Shared trace timebase: EVERY Chrome-trace exporter in the package
+# (Telemetry.write_chrome_trace, OpProfiler/StepTimer in util/profiler.py)
+# subtracts this one wall-clock origin, so independently written trace files
+# load into one Perfetto view on one consistent timeline. Captured at import
+# — telemetry is imported before any recording hook can run.
+_TRACE_EPOCH_NS = time.time_ns()
+
+
+def trace_epoch_ns() -> int:
+    """The process's shared Chrome-trace time origin (wall ns)."""
+    return _TRACE_EPOCH_NS
+
 # Histogram bucket bounds in SECONDS (most observed values are durations);
 # exponential-ish ladder from 0.5 ms to 60 s, +Inf implicit.
 _DEFAULT_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -264,7 +276,11 @@ class Telemetry:
             events = [dict(e) for e in self._events]
         if not events:
             return {"traceEvents": [], "displayTimeUnit": "ms"}
-        t0 = min(e["ts"] for e in events)
+        # shared timebase with the OpProfiler/StepTimer exporters
+        # (util/profiler.py): every trace file subtracts the same origin,
+        # so separate files merge onto one Perfetto timeline. Synthetic
+        # events older than the epoch (tests) still export consistently.
+        t0 = min(trace_epoch_ns(), min(e["ts"] for e in events))
         out: List[dict] = []
         named: set = set()
         mypid = os.getpid()
@@ -524,7 +540,12 @@ def _prom_labels(labels: dict) -> str:
     parts = []
     for k, v in sorted(labels.items()):
         key = re.sub(r"[^a-zA-Z0-9_]", "_", str(k))
-        val = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        # Prometheus exposition format (text/plain 0.0.4): label values
+        # escape backslash, double quote, AND line feed — a raw newline in
+        # a value (e.g. a model description) would split the sample line
+        # and make the whole scrape unparsable
+        val = (str(v).replace("\\", "\\\\").replace('"', '\\"')
+               .replace("\n", "\\n"))
         parts.append(f'{key}="{val}"')
     return "{" + ",".join(parts) + "}"
 

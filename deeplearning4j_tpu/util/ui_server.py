@@ -110,6 +110,12 @@ class UIServer:
                     body, ok = server._healthz()
                     self._send(body.encode(), "application/json",
                                status=200 if ok else 503)
+                elif u.path == "/costs":
+                    # cost attribution (docs/OBSERVABILITY.md): every
+                    # published CostReport — per-layer FLOPs/bytes/time
+                    # table, totals, achieved FLOP/s, MFU — as JSON
+                    self._send(server._costs_json().encode(),
+                               "application/json")
                 elif u.path == "/train/sessions":
                     self._send(json.dumps(server._sessions()).encode(),
                                "application/json")
@@ -155,6 +161,16 @@ class UIServer:
         from deeplearning4j_tpu.util import telemetry as tm
 
         return tm.install_default_collectors().prometheus_text()
+
+    @staticmethod
+    def _costs_json() -> str:
+        """JSON body for /costs: the reports published by
+        ``net.cost_report()`` (util/cost_model.py), keyed by model name.
+        Empty object until a report has been computed — the route never
+        errors, so dashboards can poll it unconditionally."""
+        from deeplearning4j_tpu.util import cost_model
+
+        return json.dumps({"reports": cost_model.published_reports()})
 
     @staticmethod
     def _healthz() -> "tuple[str, bool]":

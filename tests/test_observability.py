@@ -180,6 +180,45 @@ class TestStats:
         assert len(ev) == 4  # N-1 intervals
         assert all(e["dur"] > 0 for e in ev)
 
+    def test_profiler_and_telemetry_traces_share_timebase(self, tmp_path):
+        """ISSUE 5 satellite: OpProfiler.write_chrome_trace and
+        Telemetry.write_chrome_trace subtract the SAME wall-clock origin
+        (telemetry.trace_epoch_ns), so the two files load into one Perfetto
+        view on one timeline — an op profiled INSIDE a telemetry span must
+        land within that span's exported [ts, ts+dur] interval."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops import registry
+        from deeplearning4j_tpu.util import telemetry as tm
+        from deeplearning4j_tpu.util.profiler import (OpProfiler,
+                                                      ProfilerConfig)
+
+        tele = tm.get_telemetry()
+        tele.reset()
+        was = tele.enabled
+        tele.enabled = True
+        prof = OpProfiler(ProfilerConfig())
+        try:
+            with prof.profile():
+                with tm.span("outer.window"):
+                    registry.exec_op("add", jnp.ones(128), jnp.ones(128))
+        finally:
+            tele.enabled = was
+        p1 = tmp_path / "ops.json"
+        p2 = tmp_path / "spans.json"
+        prof.write_chrome_trace(str(p1))
+        tele.write_chrome_trace(str(p2))
+        tele.reset()
+        op = json.loads(p1.read_text())["traceEvents"][0]
+        spans = [e for e in json.loads(p2.read_text())["traceEvents"]
+                 if e.get("name") == "outer.window"]
+        assert spans, "telemetry span missing from its own trace"
+        span = spans[0]
+        # same timebase: the op interval nests inside the span interval
+        # (small slack for the ns->µs rounding at export)
+        assert span["ts"] - 1 <= op["ts"]
+        assert op["ts"] + op["dur"] <= span["ts"] + span["dur"] + 1
+
     def test_crash_dump(self, rng, tmp_path):
         net = self._train(StepTimer(), rng)
         p = tmp_path / "crash.json"

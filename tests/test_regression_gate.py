@@ -1,0 +1,134 @@
+"""benchmarks/regression_gate.py (ISSUE 5): noise-aware best-known bands
+over the committed BENCH trajectory, machine-checking every future run's
+perf claims — and proving the gate actually fires on a regression."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import regression_gate as rg  # noqa: E402
+
+
+def _record(n, metrics):
+    """Committed-BENCH wrapper for {metric: (value, noise_str|None)}."""
+    rows = [{"metric": m, "value": v, **({"noise": nz} if nz else {})}
+            for m, (v, nz) in metrics.items()]
+    head, extra = rows[0], rows[1:]
+    head = dict(head)
+    if extra:
+        head["extra_metrics"] = extra
+    return {"n": n, "parsed": head}
+
+
+def _write_trajectory(tmp_path, records):
+    for i, rec in enumerate(records, 1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(rec))
+    return str(tmp_path / "BENCH_r*.json")
+
+
+class TestNoiseParsing:
+    def test_parse_noise(self):
+        assert rg.parse_noise("±7.2% (3-sample spread/2)") == \
+            pytest.approx(0.072)
+        assert rg.parse_noise("±10.9% (x)") == pytest.approx(0.109)
+        assert rg.parse_noise(None) is None
+        assert rg.parse_noise("fast") is None
+
+
+class TestBands:
+    def test_within_band_passes(self):
+        traj = [("r1", {"tput": (100.0, 0.05)}),
+                ("r2", {"tput": (110.0, 0.05)})]
+        res = rg.gate(traj, {"tput": (104.0, 0.05)})
+        assert res[0]["status"] == "ok"
+
+    def test_regression_beyond_band_fails(self):
+        traj = [("r1", {"tput": (100.0, 0.02)}),
+                ("r2", {"tput": (110.0, 0.02)})]
+        res = rg.gate(traj, {"tput": (70.0, 0.02)})
+        assert res[0]["status"] == "regressed"
+        assert res[0]["best"] == 110.0
+
+    def test_noise_widens_band(self):
+        traj = [("r1", {"tput": (110.0, 0.30)})]
+        # 25% below best but the best record itself is ±30% noisy
+        res = rg.gate(traj, {"tput": (82.0, 0.05)})
+        assert res[0]["status"] == "ok"
+
+    def test_lower_is_better_direction(self):
+        traj = [("r1", {"telemetry_overhead": (1.10, 0.02)}),
+                ("r2", {"telemetry_overhead": (0.98, 0.02)})]
+        assert rg.gate(traj, {"telemetry_overhead": (1.00, 0.02)})[0][
+            "status"] == "ok"
+        assert rg.gate(traj, {"telemetry_overhead": (1.50, 0.02)})[0][
+            "status"] == "regressed"
+
+    def test_new_and_missing_metrics(self):
+        traj = [("r1", {"tput": (100.0, None)})]
+        res = {r["metric"]: r["status"]
+               for r in rg.gate(traj, {"brand_new": (5.0, None)})}
+        assert res == {"tput": "missing", "brand_new": "new"}
+        # missing is warn-only by default, fatal under strict
+        results = rg.gate(traj, {"brand_new": (5.0, None)})
+        assert rg._passed(results, strict=False)
+        assert not rg._passed(results, strict=True)
+
+    def test_default_noise_applies_to_legacy_records(self):
+        traj = [("r1", {"tput": (100.0, None)})]  # pre-noise-field record
+        # tol = 0.05 + 0.05 + 0.02 -> bound 88
+        assert rg.gate(traj, {"tput": (89.0, None)})[0]["status"] == "ok"
+        assert rg.gate(traj, {"tput": (87.0, None)})[0]["status"] == \
+            "regressed"
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "regression_gate.py"), *args],
+            capture_output=True, text=True, timeout=120)
+
+    def test_ci_mode_passes_on_committed_trajectory(self):
+        """Acceptance: the gate passes against the repo's own BENCH files
+        AND its self-test proves it fails on an injected regression."""
+        out = self._run("--ci")
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "self-test" in out.stdout and "PASS" in out.stdout
+
+    def test_check_mode_flags_fresh_regression(self, tmp_path):
+        pattern = _write_trajectory(tmp_path, [
+            _record(1, {"resnet": (2000.0, "±2%")}),
+            _record(2, {"resnet": (2400.0, "±2%")}),
+        ])
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(
+            "some log line\n" + json.dumps(
+                {"metric": "resnet", "value": 1000.0, "noise": "±2%"}))
+        out = self._run("--bench-glob", pattern, "--check", str(fresh))
+        assert out.returncode == 1
+        assert "REGRESSED" in out.stdout
+
+    def test_check_mode_passes_fresh_improvement(self, tmp_path):
+        pattern = _write_trajectory(tmp_path, [
+            _record(1, {"resnet": (2000.0, "±2%")}),
+        ])
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(
+            {"metric": "resnet", "value": 2600.0, "noise": "±2%"}))
+        out = self._run("--bench-glob", pattern, "--check", str(fresh))
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_json_output(self, tmp_path):
+        pattern = _write_trajectory(tmp_path, [
+            _record(1, {"resnet": (2000.0, "±2%")}),
+        ])
+        out = self._run("--bench-glob", pattern, "--json")
+        doc = json.loads(out.stdout)
+        assert doc["results"][0]["metric"] == "resnet"
+        assert doc["results"][0]["status"] == "ok"

@@ -142,6 +142,23 @@ class TestRegistry:
         assert "dl4j_h_seconds_count 1" in text
         assert 'dl4j_health_check{check="training.finite"} 1' in text
 
+    def test_prometheus_label_values_escaped(self, _clean_registry):
+        """ISSUE 5 satellite: label values escape backslash, double quote,
+        and newline per the exposition format — a raw newline in a value
+        (e.g. a model description) would split the sample line and make
+        the whole scrape unparsable."""
+        tm.counter("esc.total", 1, path="C:\\tmp", note='say "hi"',
+                   multi="line one\nline two")
+        text = _clean_registry.prometheus_text()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("dl4j_esc_total"))
+        assert 'path="C:\\\\tmp"' in line
+        assert 'note="say \\"hi\\""' in line
+        assert 'multi="line one\\nline two"' in line
+        # the sample stayed ONE line ending in its value
+        assert line.endswith(" 1")
+        assert "line two" not in [ln.strip() for ln in text.splitlines()]
+
     def test_collectors_feed_scrapes(self, _clean_registry):
         tele = _clean_registry
         tele.register_collector(lambda: [("my.metric", {"k": "v"}, 42)])
