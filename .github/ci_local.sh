@@ -66,6 +66,14 @@ echo "== step: Telemetry smoke (2-step fit, /metrics + /healthz, trace schema) =
 # with spans from >= 3 distinct PIDs/threads (event schema check).
 JAX_PLATFORMS=cpu python benchmarks/telemetry_smoke.py
 
+echo "== step: Fault-tolerance smoke (ETL kill + NaN rollback + host SIGKILL) =="
+# ISSUE 6: every injected fault takes its recovery path on the REAL
+# mechanism — SIGKILLed ETL worker's chunk restarts (bit-identical output),
+# NaN batch rolls back to the last good checkpoint and completes, and a
+# 2-process elastic pod survives one host SIGKILLed mid-epoch (survivor
+# regroups + re-shards); recoveries visible on /healthz + /metrics.
+JAX_PLATFORMS=cpu python benchmarks/fault_smoke.py
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

@@ -1,0 +1,195 @@
+"""Fault-tolerance smoke check (the ISSUE 6 CI leg, wired in
+ci.yml/ci_local.sh).
+
+End-to-end proof that the elastic runtime's recovery paths fire on REAL
+fault mechanisms, with the recoveries visible on the live observability
+surfaces:
+
+1. **In-process recovery leg** — a supervised ElasticTrainer fit through a
+   2-worker multiprocess ETL pipeline with TWO injected faults: a SIGKILLed
+   ETL worker (its chunk restarts on a fresh process, output bit-identical)
+   and a NaN-poisoned batch (the health monitor flags it, the supervisor
+   restores the last good checkpoint and completes). The run must COMPLETE,
+   and the live ``/healthz`` must carry the elastic membership section with
+   the rollback recorded while ``/metrics`` shows the recovery counters
+   (``dl4j_elastic_rollbacks_total``, ``dl4j_etl_worker_restarts_total``).
+2. **2-process elastic leg** — two OS processes train under shared-directory
+   membership; one SIGKILLs itself mid-epoch. The survivor must miss its
+   heartbeats, regroup to world 1, re-shard the batches, and finish all
+   epochs.
+
+Exit 0 on success, 1 with a FAIL line on any violated check.
+
+    JAX_PLATFORMS=cpu python benchmarks/fault_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILED = []
+
+
+def check(name: str, ok: bool, detail: str = ""):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def http_get(url: str):
+    try:
+        r = urllib.request.urlopen(url, timeout=30)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:  # 503 still carries a body
+        return e.code, e.read().decode()
+
+
+def in_process_recovery_leg(work_dir: str):
+    print("== leg 1: ETL-worker kill + NaN rollback under one supervised fit")
+    import numpy as np
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.datavec.executor import (
+        MultiProcessTransformExecutor)
+    from deeplearning4j_tpu.datavec.transform import Schema, TransformProcess
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel import ElasticTrainer
+    from deeplearning4j_tpu.util import telemetry as tm
+    from deeplearning4j_tpu.util.faults import (INJECT_NAN, KILL_ETL_WORKER,
+                                                get_injector)
+    from deeplearning4j_tpu.util.ui_server import UIServer
+
+    # --- injected fault #1: a SIGKILLed ETL worker mid-transform ---------
+    schema = Schema.builder().add_column_double("x").build()
+    tp = (TransformProcess.builder(schema)
+          .double_column_transform("x", _slow_double).build())
+    records = [[float(i)] for i in range(512)]
+    serial = tp.execute(records)
+    get_injector().inject(KILL_ETL_WORKER)
+    ex = MultiProcessTransformExecutor(tp, num_workers=2,
+                                       min_records_per_worker=64, timeout=60)
+    transformed = ex.execute(records)
+    snap = tm.get_telemetry().snapshot()
+    check("ETL output bit-identical after worker SIGKILL",
+          transformed == serial)
+    check("worker-restart recovery fired",
+          snap["counters"].get("etl.worker_restarts_total", 0) >= 1,
+          f"etl.worker_restarts_total="
+          f"{snap['counters'].get('etl.worker_restarts_total', 0)}")
+
+    # --- injected fault #2: a NaN batch under the supervised loop --------
+    feats = np.asarray([r for r in transformed], np.float32)
+    rng = np.random.default_rng(0)
+    x = np.concatenate([feats, rng.normal(size=(512, 3))], axis=1).astype(
+        np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 512)]
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    get_injector().inject(INJECT_NAN, at_step=5)
+    trainer = ElasticTrainer(net, os.path.join(work_dir, "ckpt"),
+                             checkpoint_every=3, log_fn=None)
+    trainer.fit(ArrayDataSetIterator(x, y, batch=64), epochs=2)
+    check("supervised fit completed through the NaN",
+          trainer.state == "completed", f"state={trainer.state}")
+    check("rollback recovery fired", trainer.rollbacks == 1,
+          f"rollbacks={trainer.rollbacks}")
+    check("post-rollback params finite",
+          all(bool(np.isfinite(np.asarray(l)).all())
+              for lyr in net.params for l in lyr.values()))
+
+    # --- the recoveries must be visible on the live server ---------------
+    from deeplearning4j_tpu.util.stats import InMemoryStatsStorage
+
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())  # attach starts the HTTP server
+    try:
+        code, body = http_get(f"http://127.0.0.1:{server.port}/healthz")
+        check("/healthz answers 200", code == 200, f"HTTP {code}")
+        payload = json.loads(body)
+        section = payload.get("elastic") or {}
+        st = list(section.values())[-1] if section else {}
+        check("/healthz has the elastic membership section",
+              bool(st), str(sorted(section)))
+        check("/healthz reports the completed supervised run",
+              st.get("state") == "completed"
+              and st.get("membership", {}).get("world") == 1)
+        check("/healthz reports the rollback", st.get("rollbacks") == 1)
+        code, text = http_get(f"http://127.0.0.1:{server.port}/metrics")
+        check("/metrics shows recovery counters",
+              "dl4j_elastic_rollbacks_total" in text
+              and "dl4j_etl_worker_restarts_total" in text
+              and "dl4j_elastic_checkpoints_total" in text)
+        check("/metrics shows elastic scrape-time gauges",
+              "dl4j_elastic_world_size" in text)
+    finally:
+        server.stop()
+    get_injector().clear()
+
+
+def _slow_double(v):
+    import time
+
+    time.sleep(0.005)  # keep workers alive long enough to be killed
+    return v * 2.0
+
+
+def two_process_elastic_leg(work_dir: str):
+    print("== leg 2: 2-process elastic run, one host SIGKILLed mid-epoch")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "_dist_worker.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    d = os.path.join(work_dir, "pod")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, "--elastic", d, str(pid), "2"]
+        + (["2"] if pid == 1 else []),  # pid 1 SIGKILLs itself at step 2
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in (0, 1)]
+    out0, err0 = procs[0].communicate(timeout=300)
+    procs[1].communicate(timeout=300)
+    check("victim died by SIGKILL (no graceful exit)",
+          procs[1].returncode == -signal.SIGKILL,
+          f"rc={procs[1].returncode}")
+    check("survivor exited 0", procs[0].returncode == 0, err0[-400:])
+    lines = [l for l in out0.splitlines() if l.startswith("{")]
+    r = json.loads(lines[-1]) if lines else {}
+    check("survivor completed all epochs",
+          r.get("state") == "completed" and r.get("epoch") == 3, str(r))
+    check("survivor regrouped to world 1",
+          r.get("world_final") == 1 and r.get("regroups", 0) >= 1)
+    check("survivor re-sharded the data pipeline",
+          r.get("iteration") == 4 + 8 + 8,
+          f"iteration={r.get('iteration')} (4 sharded + 8 + 8 re-sharded)")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="dl4j-fault-smoke-") as work:
+        in_process_recovery_leg(work)
+        two_process_elastic_leg(work)
+    if _FAILED:
+        print(f"FAIL: {len(_FAILED)} check(s): {_FAILED}")
+        return 1
+    print("fault smoke OK: every injected fault recovered and was visible "
+          "on /healthz + /metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

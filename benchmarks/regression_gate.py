@@ -60,6 +60,7 @@ LOWER_BETTER = {
     "telemetry_overhead",
     "recompile_overhead",
     "cost_attribution_overhead",
+    "elastic_overhead",
 }
 
 _NOISE_RE = re.compile(r"[+±]?\s*([0-9.]+)\s*%")

@@ -42,6 +42,15 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   spans, /metrics + /healthz on the UI server. Default ON (span cost is
   ~µs against ms-scale steps — bench.py ``telemetry_overhead``); set to
   0/false to strip every recording hook.
+- ``DL4J_TPU_FAULTS`` — chaos knob for the elastic runtime
+  (util/faults.py, docs/FAULT_TOLERANCE.md): arm injectable faults as
+  ``"kind[@step][:arg]"`` pairs, e.g.
+  ``"kill_etl_worker,inject_nan@5,stall_prefetch:3.0"``. Kinds:
+  ``kill_etl_worker`` (SIGKILL a transform worker), ``stall_prefetch``
+  (wedge the producer thread), ``drop_heartbeat`` (membership sees this
+  host die), ``inject_nan`` (poison one batch), ``sigkill_host`` (kill
+  this process). Read once at first injector access; unknown kinds raise.
+  Unset = no faults (the injector costs one dict lookup per seam).
 - ``DL4J_TPU_PEAK_FLOPS`` — the accelerator's peak FLOP/s for the compute
   dtype in use (e.g. ``1.97e14`` for a TPU v5e chip in bf16). Enables MFU
   (model FLOPs utilization) in ``net.cost_report()``, the ``/costs`` route,
@@ -98,6 +107,9 @@ class Environment:
         self.compile_cache_dir = (
             os.environ.get("DL4J_TPU_COMPILE_CACHE") or None)
         self.telemetry = _env_bool("DL4J_TPU_TELEMETRY", default=True)
+        # armed-faults spec (authoritative parse lives in util/faults.py's
+        # injector; surfaced here so crash dumps show the chaos config)
+        self.fault_spec = os.environ.get("DL4J_TPU_FAULTS") or None
         self._profiler = None
         self._compile_cache_applied = False
 

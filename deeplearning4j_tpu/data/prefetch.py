@@ -33,11 +33,18 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.util import faults as fl
 from deeplearning4j_tpu.util import telemetry as tm
 
 
 class PrefetchStalledError(RuntimeError):
-    """The prefetch worker produced nothing within ``timeout`` seconds."""
+    """The prefetch worker produced nothing within ``timeout`` seconds.
+
+    The message carries the post-mortem a stalled pipeline needs (queue
+    depth, last batch that made it through, whether the producer thread is
+    even alive), and ``prefetch.stalls_total`` is incremented BEFORE the
+    raise — the stall is visible on /metrics even when the exception is
+    swallowed upstream (docs/FAULT_TOLERANCE.md)."""
 
 
 def _stage_tree(x, put):
@@ -151,6 +158,11 @@ class AsyncDataSetIterator(DataSetIterator):
         try:
             it = iter(self.base)
             while True:
+                fault = fl.get_injector().fire(fl.STALL_PREFETCH)
+                if fault is not None:
+                    # wedge the REAL producer (stop-aware, so shutdown of a
+                    # deliberately-stalled pipeline doesn't hang the test)
+                    stop.wait(fault.arg if fault.arg else 2 * self.timeout)
                 with tm.span("prefetch.etl_wait"):
                     try:
                         ds = next(it)
@@ -182,16 +194,29 @@ class AsyncDataSetIterator(DataSetIterator):
         import time as _time
 
         first = True  # the first get always absorbs worker startup + the
+        last_ok = -1  # index of the last batch that made it through
         try:          # first batch's full ETL: that is warmup, not a stall
             while True:
                 t0 = _time.perf_counter()
                 try:
                     kind, payload = q.get(timeout=self.timeout)
                 except _queue.Empty:
+                    alive = worker.is_alive()
+                    # counted BEFORE the raise: the stall stays visible on
+                    # /metrics even if fit() swallows the exception
+                    tm.counter("prefetch.stalls_total")
+                    tm.counter("prefetch.stall_timeouts_total")
+                    tm.instant("prefetch.stall_timeout",
+                               queue_depth=q.qsize(), last_batch=last_ok,
+                               producer_alive=alive)
                     raise PrefetchStalledError(
                         f"prefetch worker produced no batch for "
                         f"{self.timeout}s (base iterator "
-                        f"{type(self.base).__name__} wedged?)") from None
+                        f"{type(self.base).__name__} wedged?): "
+                        f"queue depth {q.qsize()}/{self.buffer_size}, "
+                        f"last successful batch index {last_ok}, "
+                        f"producer thread "
+                        f"{'alive' if alive else 'DEAD'}") from None
                 waited = _time.perf_counter() - t0
                 tm.gauge("prefetch.queue_depth", q.qsize())
                 if (kind == "ok" and not first
@@ -207,6 +232,7 @@ class AsyncDataSetIterator(DataSetIterator):
                 if kind == "error":
                     # the exception object carries its worker-side traceback
                     raise payload
+                last_ok += 1
                 yield payload
         finally:
             stop.set()

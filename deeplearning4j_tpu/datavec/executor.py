@@ -38,16 +38,25 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import time
 import traceback
 from typing import Any, List, Optional, Sequence
 
+from deeplearning4j_tpu.util import faults as fl
 from deeplearning4j_tpu.util import telemetry as tm
+from deeplearning4j_tpu.util.faults import RetryPolicy
+
+#: per-chunk restart policy: a dead/failed worker's CHUNK is retried on a
+#: fresh process this many times before the whole execute fails loudly —
+#: Spark's task-retry semantics on OS processes (docs/FAULT_TOLERANCE.md)
+DEFAULT_CHUNK_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05,
+                                  max_delay=1.0)
 
 
 class TransformExecutionError(RuntimeError):
-    """A transform worker process failed (or timed out). Carries the worker's
-    formatted traceback so the failing record/step is debuggable from the
-    parent."""
+    """A transform worker process failed (or timed out) beyond its retry
+    budget. Carries the worker's formatted traceback so the failing
+    record/step is debuggable from the parent."""
 
 
 class LocalTransformExecutor:
@@ -98,11 +107,14 @@ class MultiProcessTransformExecutor:
     """
 
     def __init__(self, transform_process, num_workers: Optional[int] = None,
-                 timeout: float = 300.0, min_records_per_worker: int = 64):
+                 timeout: float = 300.0, min_records_per_worker: int = 64,
+                 retry: Optional[RetryPolicy] = DEFAULT_CHUNK_RETRY):
         self.transform_process = transform_process
         self.num_workers = num_workers if num_workers else _default_workers()
         self.timeout = timeout
         self.min_records_per_worker = min_records_per_worker
+        # retry=None -> one attempt per chunk (the pre-elastic behavior)
+        self.retry = retry if retry is not None else RetryPolicy(max_attempts=1)
 
     def final_schema(self):
         return self.transform_process.final_schema()
@@ -135,41 +147,123 @@ class MultiProcessTransformExecutor:
         return out
 
     def _execute_chunks(self, ctx, chunks) -> List[list]:
+        """Supervised chunk execution: a dead or failing worker no longer
+        fails the epoch — its CHUNK is restarted on a fresh process (bounded
+        by ``self.retry``), and the in-order merge keeps the output
+        bit-identical to serial. Exhausting the retry budget raises the
+        same loud :class:`TransformExecutionError` as before, with the last
+        child traceback attached."""
+        import queue as _q
+
         out_queue = ctx.Queue()
-        procs = [
-            ctx.Process(target=_worker_main,
-                        args=(self.transform_process, chunk, i, out_queue),
-                        daemon=True)
-            for i, chunk in enumerate(chunks)
-        ]
-        for p in procs:
-            p.start()
+        procs: dict = {}        # chunk idx -> live/most-recent Process
+        attempts: dict = {}     # chunk idx -> processes launched so far
         results: dict = {}
+        suspects: set = set()   # dead-without-result, seen by ONE scan
+
+        def launch(idx):
+            attempts[idx] = attempts.get(idx, 0) + 1
+            p = ctx.Process(
+                target=_worker_main,
+                args=(self.transform_process, chunks[idx], idx, out_queue),
+                daemon=True)
+            p.start()
+            procs[idx] = p
+
+        def retry_or_fail(idx, why):
+            nonlocal deadline
+            if attempts[idx] >= self.retry.max_attempts:
+                raise TransformExecutionError(
+                    f"transform worker for chunk {idx} failed after "
+                    f"{attempts[idx]} attempt(s):\n{why}")
+            tm.counter("etl.worker_restarts_total")
+            tm.instant("etl.worker_restart", chunk=idx,
+                       attempt=attempts[idx], why=str(why)[:200])
+            # the policy's jittered backoff; a restart is progress, so the
+            # no-progress window re-arms (bounded: attempts are capped)
+            self.retry.sleep_before_retry(attempts[idx])
+            launch(idx)
+            deadline = time.monotonic() + budget
+
+        for i in range(len(chunks)):
+            launch(i)
+        # fault seam (util/faults.py): SIGKILL one REAL worker so the
+        # restart path below is exercised by the exact mechanism a host
+        # OOM-killer / preemption would use
+        fault = fl.get_injector().fire(fl.KILL_ETL_WORKER)
+        if fault is not None:
+            victim = procs[int(fault.arg or 0) % len(chunks)]
+            if victim.pid is not None:
+                try:
+                    os.kill(victim.pid, 9)
+                except ProcessLookupError:
+                    pass  # won the race and exited already
+        # ``timeout`` bounds the wait WITHOUT PROGRESS (the pre-elastic
+        # semantics: each chunk result had its own get(timeout)); every
+        # arriving result or launched restart re-arms it, so a long
+        # many-chunk job that keeps delivering never trips it, while a
+        # wedged pipeline still dies after one quiet timeout window. A
+        # caller-supplied RetryPolicy(deadline=...) tightens the window.
+        budget = self.timeout
+        if self.retry.deadline is not None:
+            budget = min(budget, self.retry.deadline)
+        deadline = time.monotonic() + budget
         try:
             # drain BEFORE join: a child cannot exit until its queue payload
             # is consumed (the classic mp.Queue/join deadlock)
-            import queue as _q
-
-            for _ in range(len(chunks)):
+            while len(results) < len(chunks):
+                if time.monotonic() > deadline:
+                    pending = sorted(set(range(len(chunks))) - set(results))
+                    raise TransformExecutionError(
+                        f"transform execute timed out: no progress for "
+                        f"{budget}s ({len(results)}/{len(chunks)} chunks "
+                        f"done, pending {pending})")
                 try:
-                    idx, status, payload, spans = out_queue.get(
-                        timeout=self.timeout)
+                    idx, status, payload, spans = out_queue.get(timeout=0.2)
                 except _q.Empty:
-                    raise TransformExecutionError(
-                        f"transform worker timed out after {self.timeout}s "
-                        f"({len(results)}/{len(chunks)} chunks done)"
-                    ) from None
+                    # liveness scan: a SIGKILLed worker posts nothing — its
+                    # death is only visible through the process table. A
+                    # restart is charged only on the SECOND consecutive
+                    # dead sighting: a worker that exited right after
+                    # flushing its result gets one more drain pass (0.2s)
+                    # for that result to surface, so success is never
+                    # misread as death at the retry-budget boundary
+                    for idx, p in list(procs.items()):
+                        if idx in results or p.is_alive():
+                            suspects.discard(idx)
+                        elif idx in suspects:
+                            suspects.discard(idx)
+                            retry_or_fail(
+                                idx, f"worker pid={p.pid} died with exit "
+                                     f"code {p.exitcode} before returning "
+                                     f"its chunk")
+                        else:
+                            suspects.add(idx)
+                    continue
+                except (EOFError, OSError) as e:
+                    # a decode/read error on the result pipe: count it and
+                    # let the liveness scan restart the dead sender. (A
+                    # worker SIGKILLed exactly mid-frame on a >PIPE_BUF
+                    # payload can in principle stall recv past this —
+                    # inherent to mp.Queue and present before the retry
+                    # rewrite; the fault tests kill between frames.)
+                    tm.counter("etl.result_pipe_errors_total")
+                    tm.instant("etl.result_pipe_error", error=repr(e)[:200])
+                    continue
+                deadline = time.monotonic() + budget  # progress: re-arm
+                if idx in results:
+                    continue  # stale duplicate from a raced restart
                 if status != "ok":
-                    raise TransformExecutionError(
-                        f"transform worker for chunk {idx} failed:\n{payload}")
+                    retry_or_fail(idx, payload)
+                    continue
                 if spans:  # worker-PID spans onto the merged trace timeline
                     tm.get_telemetry().merge_events(spans)
                 results[idx] = payload
         finally:
-            for p in procs:
+            for p in procs.values():
                 if p.is_alive():
                     p.terminate()
-            for p in procs:
+            for p in procs.values():
                 p.join(timeout=5.0)
         out: List[list] = []
         for i in range(len(chunks)):

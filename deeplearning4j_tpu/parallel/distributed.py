@@ -33,28 +33,55 @@ from typing import Optional
 import jax
 
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.util.faults import RetryPolicy
 
 _initialized = False
 
+#: default handshake policy: workers racing the coordinator's gRPC service
+#: coming up (the normal elastic-restart case) back off and retry instead of
+#: dying on the first connection refusal; jittered so N restarted workers
+#: don't re-dial in lockstep (docs/FAULT_TOLERANCE.md)
+BOOTSTRAP_RETRY = RetryPolicy(max_attempts=5, base_delay=1.0, max_delay=10.0,
+                              deadline=120.0)
+
 
 def initialize(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
-               process_id: Optional[int] = None, local_device_ids=None) -> None:
+               process_id: Optional[int] = None, local_device_ids=None,
+               retry: Optional[RetryPolicy] = BOOTSTRAP_RETRY) -> None:
     """ModelParameterServer-bootstrap parity over jax.distributed.
 
     ``coordinator``: "host:port" of process 0 (the reference's master/driver
     address). No-op when already initialized or when running single-process
-    with no coordinator given."""
+    with no coordinator given. The handshake runs under ``retry``
+    (util/faults.py): a worker restarted by the elastic supervisor while
+    the coordinator is still coming up backs off instead of crash-looping;
+    ``retry=None`` restores the old one-shot behavior."""
     global _initialized
     if _initialized:
         return
     if coordinator is None and (num_processes is None or num_processes <= 1):
         return  # single-process: nothing to bootstrap
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+
+    def handshake():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+        except RuntimeError as e:
+            # a retried attempt after a partially-successful first one:
+            # the runtime IS up — that's success, not a handshake failure
+            if "already initialized" in str(e).lower():
+                return
+            raise
+
+    if retry is not None:
+        retry.run(handshake, name="dcn_bootstrap",
+                  retry_on=(RuntimeError, ConnectionError, OSError))
+    else:
+        handshake()
     _initialized = True
 
 

@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.parallel.accumulator import EncodedGradientsAccumulator
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.util import telemetry as tm
 
 
 def _stack_tree(tree, n):
@@ -143,6 +144,12 @@ class ParameterAveragingTrainingMaster:
                 for lst in model.listeners:
                     lst.iteration_done(model, model.iteration, model.epoch)
             model.epoch += 1
+            # NO on_epoch_end dispatch here (unlike SharedTrainingMaster
+            # below): the per-replica param stacks live in this loop's
+            # locals until fit() returns, so an epoch-end checkpoint
+            # listener would silently save pre-fit state. Supervise
+            # SharedTrainingMaster (which syncs back per epoch) or wrap
+            # ElasticTrainer around ParallelWrapper instead.
         if since_avg:
             params, opts, states = self._avg(params, opts, states)
         model.params = jax.tree_util.tree_map(np.asarray, _unstack_first(params))
@@ -258,9 +265,26 @@ class SharedTrainingMaster:
                     jnp.asarray(model.iteration), x, y, keys, w, fm, lm)
                 model.iteration += 1
                 model.score_value = float(loss)
+                tm.counter("train.steps_total", model="shared_master")
                 for lst in model.listeners:
                     lst.iteration_done(model, model.iteration, model.epoch)
+            # epoch-boundary state sync-back: params here are complete
+            # replicated arrays, so handing the references to the model
+            # costs nothing and makes a mid-run checkpoint (ElasticTrainer /
+            # ShardedCheckpointListener riding on_epoch_end) save REAL
+            # state — before this, a SIGKILL mid-fit lost every epoch.
+            # NOTE: the next epoch's first step DONATES these buffers, so
+            # the window to read model.params is the epoch boundary itself
+            # (exactly where on_epoch_end fires); mid-epoch readers like
+            # the health monitor's probe already tolerate deleted buffers
+            model.params, model.states, model.opt_states = params, states, opts
             model.epoch += 1
+            for lst in model.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(model)
+        # after >=1 epoch this re-binds the refs the loop's sync-back just
+        # set (intentional no-op); it exists for epochs=0, where the loop —
+        # and its sync-back — never runs
         model.params, model.states, model.opt_states = params, states, opts
         return model
 

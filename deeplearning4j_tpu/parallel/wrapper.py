@@ -81,40 +81,55 @@ class ParallelWrapper:
         self.model.states = self.mesh.replicate(self.model.states)
         self.model.opt_states = self.mesh.replicate(self.model.opt_states)
 
-    def fit(self, iterator, epochs: int = 1):
+    def step_batch(self, ds):
+        """Run ONE sharded train step on a DataSet (listeners included) —
+        the unit the elastic supervisor (parallel/elastic.py) wraps with
+        checkpoint/drain/rollback handling. Returns the device loss."""
         import time as _time
 
         if self._sharded_step is None:
             self._build()
         model = self.model
+        x, y, w = self._shard(ds.features, ds.labels)
+        model._rng_key, sub = jax.random.split(model._rng_key)
+        t0 = _time.time_ns()
+        with tm.span("parallel.step", iteration=model.iteration,
+                     replicas=self.mesh.data):
+            model.params, model.states, model.opt_states, loss = (
+                self._sharded_step(
+                    model.params, model.states, model.opt_states,
+                    jnp.asarray(model.iteration), x, y, sub, w,
+                )
+            )
+        model.score_value = loss
+        model.iteration += 1
+        tm.counter("train.steps_total", model="parallel")
+        if (self.skew_every and tm.enabled()
+                and model.iteration % self.skew_every == 0):
+            self._probe_replica_skew(loss, t0)
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration, model.epoch)
+        return loss
+
+    def end_epoch(self):
+        """Advance the epoch counter + epoch-end callbacks (the tail of one
+        fit() epoch, split out for the elastic supervisor)."""
+        model = self.model
+        model.epoch += 1
+        for lst in model.listeners:
+            if hasattr(lst, "on_epoch_end"):
+                lst.on_epoch_end(model)
+
+    def fit(self, iterator, epochs: int = 1):
+        if self._sharded_step is None:
+            self._build()
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x, y, w = self._shard(ds.features, ds.labels)
-                model._rng_key, sub = jax.random.split(model._rng_key)
-                t0 = _time.time_ns()
-                with tm.span("parallel.step", iteration=model.iteration,
-                             replicas=self.mesh.data):
-                    model.params, model.states, model.opt_states, loss = (
-                        self._sharded_step(
-                            model.params, model.states, model.opt_states,
-                            jnp.asarray(model.iteration), x, y, sub, w,
-                        )
-                    )
-                model.score_value = loss
-                model.iteration += 1
-                tm.counter("train.steps_total", model="parallel")
-                if (self.skew_every and tm.enabled()
-                        and model.iteration % self.skew_every == 0):
-                    self._probe_replica_skew(loss, t0)
-                for lst in model.listeners:
-                    lst.iteration_done(model, model.iteration, model.epoch)
-            model.epoch += 1
-            for lst in model.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(model)
-        return model
+                self.step_batch(ds)
+            self.end_epoch()
+        return self.model
 
     def _shard(self, x, y):
         return self.mesh.pad_shard_batch(x, y)

@@ -40,7 +40,16 @@ from deeplearning4j_tpu.util.stats import (
 from deeplearning4j_tpu.util import cost_model
 from deeplearning4j_tpu.util import telemetry
 from deeplearning4j_tpu.util.cost_model import CostReport, CostRow
-from deeplearning4j_tpu.util.health import TrainingHealthMonitor
+from deeplearning4j_tpu.util.faults import (
+    FaultInjector,
+    RetryExhaustedError,
+    RetryPolicy,
+    get_injector,
+)
+from deeplearning4j_tpu.util.health import (
+    RollbackSignal,
+    TrainingHealthMonitor,
+)
 from deeplearning4j_tpu.util.telemetry import Telemetry, get_telemetry
 
 __all__ = [
@@ -54,4 +63,6 @@ __all__ = [
     "clear_persistent_cache", "cache_entries", "AotStore",
     "telemetry", "Telemetry", "get_telemetry", "TrainingHealthMonitor",
     "cost_model", "CostReport", "CostRow",
+    "RetryPolicy", "RetryExhaustedError", "FaultInjector", "get_injector",
+    "RollbackSignal",
 ]

@@ -4,10 +4,31 @@ Reference parity: SURVEY.md §5.4 — the reference checkpoints via
 ModelSerializer (zip of config JSON + flattened params + updater state;
 implemented here in util/model_serializer.py) and CheckpointListener keep-N
 rotation. The TPU-native counterpart is a SHARDED checkpoint: each host
-writes its own param shards (no gather through one host), which is what
-multi-host meshes need. This module wraps Orbax (baked into the image) with
-the framework's state layout; the zip format remains for single-host
-portability.
+writes its own param shards (no gather through one host). The zip format
+remains for single-host portability.
+
+Fault-tolerance contract (docs/FAULT_TOLERANCE.md):
+
+- **Atomic commit** — every save writes to ``<dir>/.tmp-<step>`` and
+  ``os.replace``-renames to ``<dir>/<step>`` only once the full tree (and
+  the sidecar meta JSON) is on disk. A crash mid-save leaves a ``.tmp-*``
+  orphan that listing ignores and the next save sweeps; it can never be
+  mistaken for a restorable checkpoint.
+- **Corruption-tolerant restore** — :meth:`restore_latest_good` walks the
+  committed steps newest-first; a checkpoint that fails to load is skipped
+  with a loud warning (``checkpoint.corrupt_skipped_total``), never a
+  crash — the run resumes from the newest GOOD state.
+- **Full resume state** — alongside params/opt state, the checkpoint
+  carries the model's RNG key and iteration/epoch, plus caller-supplied
+  sidecar metadata (the elastic runtime stores the batch-in-epoch cursor),
+  so a resumed fit() is bit-identical to an uninterrupted one.
+- **Retried I/O** — saves/restores run under a :class:`RetryPolicy`
+  (util/faults.py): a flaky filesystem backs off and retries instead of
+  killing the step loop on the first EIO.
+- **Async save** — ``save(..., block=False)`` snapshots the state to host
+  memory (fast) and commits in a background thread, so the step loop keeps
+  the accelerator busy during checkpoint I/O; ``wait_until_finished()``
+  joins (the elastic runtime drains it before exiting).
 
     ckpt = ShardedCheckpointer("/ckpts/run1", keep=3)
     ckpt.save(step, net)                  # params + opt state + iteration
@@ -16,60 +37,235 @@ portability.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.util import telemetry as tm
+from deeplearning4j_tpu.util.faults import RetryPolicy
+
+_META_FILE = "elastic_meta.json"
+_TMP_PREFIX = ".tmp-"
+
+#: checkpoint I/O default: a couple of quick retries, bounded overall
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.2, max_delay=2.0,
+                        deadline=60.0)
 
 
 class ShardedCheckpointer:
     """Keep-N sharded checkpoints of a network's training state."""
 
-    def __init__(self, directory: str, keep: int = 3):
-        import orbax.checkpoint as ocp
-
+    def __init__(self, directory: str, keep: int = 3,
+                 retry: Optional[RetryPolicy] = _IO_RETRY, log_fn=print):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
-        )
+        self.keep = keep
+        self.retry = retry
+        self.log = log_fn
+        self._pending: Optional[threading.Thread] = None
+        self._pending_error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        #: steps THIS instance committed — gates the same-step fast path
+        self._committed_steps: set = set()
 
     # ------------------------------------------------------------------ save
     def _state(self, model) -> dict:
+        meta = {
+            "iteration": np.asarray(model.iteration),
+            "epoch": np.asarray(model.epoch),
+        }
+        if getattr(model, "_rng_key", None) is not None:
+            # the key makes resume bit-identical: the restored fit() draws
+            # the SAME dropout/shuffle streams the uninterrupted run would
+            meta["rng_key"] = model._rng_key
         return {
             "params": model.params,
             "states": model.states,
             "opt_states": model.opt_states,
-            "meta": {
-                "iteration": np.asarray(model.iteration),
-                "epoch": np.asarray(model.epoch),
-            },
+            "meta": meta,
         }
 
-    def save(self, step: int, model) -> None:
+    @staticmethod
+    def _host_snapshot(state: dict) -> dict:
+        """Device -> host copy of the whole state tree. Decouples the saved
+        bytes from the live buffers the NEXT train step will donate (a
+        background save holding device references would read freed
+        buffers)."""
+        return jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)),
+                                      state)
+
+    def _commit(self, step: int, state: dict, extra_meta: Optional[dict]):
+        """Write to .tmp-<step>, fsync-equivalent via orbax, then atomically
+        rename into place and rotate keep-N. Runs under the retry policy."""
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(self._state(model)))
-        self._mgr.wait_until_finished()
+        # pid-qualified tmp: concurrent writers (two elastic members
+        # misconfigured onto one directory, or a not-yet-reaped previous
+        # incarnation) can never rmtree each other's in-flight write. The
+        # supported layout is still ONE writer per directory — multi-host
+        # pods give each process its own subdir (tests/_dist_worker.py) —
+        # this is defense, not a coordination protocol.
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{step}-{os.getpid()}")
+        final = os.path.join(self.directory, str(step))
+
+        def write_meta(directory):
+            # epoch rides the SIDECAR authoritatively: at an epoch boundary
+            # two saves share one iteration but differ in epoch, and the
+            # same-step fast path below refreshes only this file
+            meta_tmp = os.path.join(directory, f"{_META_FILE}.tmp")
+            with open(meta_tmp, "w") as f:
+                json.dump({"step": step,
+                           "epoch": int(state["meta"]["epoch"]),
+                           **(extra_meta or {})}, f)
+            os.replace(meta_tmp, os.path.join(directory, _META_FILE))
+
+        def attempt():
+            if os.path.isdir(final) and step in self._committed_steps:
+                # same-step re-save by THIS run (drain right after a
+                # cadence save): training state at a given iteration is
+                # deterministic, so the committed arrays are already
+                # right — refresh only the meta sidecar (atomic
+                # single-file replace). NEVER delete a committed checkpoint
+                # to rewrite identical bytes: the rmtree->rename window
+                # would lose the step entirely on a crash between the two.
+                write_meta(final)
+                return
+            if os.path.isdir(final):
+                # a FOREIGN checkpoint at this step (directory reused by a
+                # new run): keeping its arrays would silently persist the
+                # OLD run's weights under the new run's save — move it
+                # aside (swept once stale, like foreign tmps) and write
+                # ours in full
+                os.replace(final, os.path.join(
+                    self.directory,
+                    f".replaced-{step}-{os.getpid()}-{int(time.time())}"))
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)  # crashed/failed prior attempt
+            ckptr = ocp.StandardCheckpointer()
+            try:
+                ckptr.save(tmp, state)
+                if hasattr(ckptr, "wait_until_finished"):
+                    ckptr.wait_until_finished()
+            finally:
+                close = getattr(ckptr, "close", None)
+                if close:
+                    close()
+            write_meta(tmp)
+            os.replace(tmp, final)  # THE commit point
+
+        with tm.span("elastic.checkpoint_commit", step=step):
+            if self.retry is not None:
+                self.retry.run(attempt, name="checkpoint_save",
+                               retry_on=(OSError, ValueError))
+            else:
+                attempt()
+        self._committed_steps.add(step)
+        tm.counter("elastic.checkpoints_total")
+        tm.gauge("elastic.last_checkpoint_step", step)
+        self._rotate()
+
+    def save(self, step: int, model, extra_meta: Optional[dict] = None,
+             block: bool = True) -> None:
+        """Checkpoint ``model`` at ``step``. ``extra_meta`` lands in a JSON
+        sidecar (:meth:`load_meta`). ``block=False`` snapshots to host
+        memory synchronously (cheap) and commits in a background thread —
+        the caller's next train step overlaps the checkpoint I/O."""
+        self.wait_until_finished()  # one in-flight save at a time
+        state = self._host_snapshot(self._state(model))
+        if block:
+            self._commit(step, state, extra_meta)
+            return
+
+        def run():
+            try:
+                self._commit(step, state, extra_meta)
+            except BaseException as e:  # noqa: BLE001 — crosses the thread
+                with self._lock:
+                    self._pending_error = e
+                tm.counter("elastic.checkpoint_errors_total")
+
+        t = threading.Thread(target=run, name="dl4j-tpu-ckpt", daemon=True)
+        self._pending = t
+        t.start()
+
+    def wait_until_finished(self) -> None:
+        """Join any in-flight async save; re-raise its failure (once)."""
+        t = self._pending
+        if t is not None:
+            t.join()
+            self._pending = None
+        with self._lock:
+            err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
+
+    def _rotate(self):
+        steps = self.all_steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)
+        # sweep crashed-run tmp orphans: _rotate runs inside _commit AFTER
+        # its own tmp was renamed, and saves are serialized (save() joins
+        # the pending one), so this process's .tmp-*-<pid> are dead weight.
+        # Foreign-pid tmps are swept only once stale (an hour old) — if a
+        # second writer IS racing on this directory despite the one-writer
+        # contract, its live in-flight write survives.
+        for name in os.listdir(self.directory):
+            if not name.startswith((_TMP_PREFIX, ".replaced-")):
+                continue
+            path = os.path.join(self.directory, name)
+            if (name.startswith(_TMP_PREFIX)
+                    and name.endswith(f"-{os.getpid()}")):
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            try:
+                if time.time() - os.stat(path).st_mtime > 3600:
+                    shutil.rmtree(path, ignore_errors=True)
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- listing
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.isdigit() and os.path.isdir(
+                    os.path.join(self.directory, name)):
+                out.append(int(name))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def load_meta(self, step: int) -> Dict[str, Any]:
+        """The JSON sidecar saved with ``extra_meta`` ({} when absent)."""
+        path = os.path.join(self.directory, str(step), _META_FILE)
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
 
     # --------------------------------------------------------------- restore
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def all_steps(self):
-        return list(self._mgr.all_steps())
-
     def restore(self, model, step: Optional[int] = None):
         """Restore into an init()'d model of the same configuration (the
         abstract pytree comes from the model's current state, so shardings
         and dtypes round-trip)."""
         import orbax.checkpoint as ocp
 
-        step = step if step is not None else self._mgr.latest_step()
+        step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        path = os.path.join(self.directory, str(step))
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint for step {step} in "
+                                    f"{self.directory}")
 
         def _abstract(x):
             # ShapeDtypeStruct leaves carry each param's sharding so device-
@@ -83,17 +279,74 @@ class ShardedCheckpointer:
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
         abstract = jax.tree_util.tree_map(_abstract, self._state(model))
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+
+        def attempt():
+            ckptr = ocp.StandardCheckpointer()
+            try:
+                return ckptr.restore(path, abstract)
+            finally:
+                close = getattr(ckptr, "close", None)
+                if close:
+                    close()
+
+        with tm.span("elastic.checkpoint_restore", step=step):
+            if self.retry is not None:
+                restored = self.retry.run(attempt, name="checkpoint_restore",
+                                          retry_on=(OSError,))
+            else:
+                restored = attempt()
         model.params = restored["params"]
         model.states = restored["states"]
         model.opt_states = restored["opt_states"]
         model.iteration = int(restored["meta"]["iteration"])
-        model.epoch = int(restored["meta"]["epoch"])
+        # the sidecar's epoch wins when present: a same-step re-save at an
+        # epoch boundary refreshes only the sidecar (atomic file replace),
+        # so the array-tree copy of the counter can be one epoch stale
+        side = self.load_meta(step)
+        model.epoch = int(side.get("epoch", restored["meta"]["epoch"]))
+        if "rng_key" in restored["meta"] and hasattr(model, "_rng_key"):
+            import jax.numpy as jnp
+
+            model._rng_key = jnp.asarray(restored["meta"]["rng_key"])
         return model
 
+    def restore_latest_good(self, model) -> Optional[int]:
+        """Walk committed checkpoints newest-first; skip (warn + count) any
+        that fail to load — a partial/corrupt newest checkpoint must not
+        kill the resume. Returns the restored step, or None when no
+        checkpoint exists / none loads."""
+        for step in reversed(self.all_steps()):
+            try:
+                self.restore(model, step=step)
+                return step
+            except Exception as e:  # noqa: BLE001 — skip bad, keep walking
+                tm.counter("checkpoint.corrupt_skipped_total")
+                tm.instant("checkpoint.corrupt_skipped", step=step,
+                           error=f"{type(e).__name__}: {e}"[:200])
+                if self.log:
+                    self.log(f"WARNING: checkpoint step {step} in "
+                             f"{self.directory} failed to load "
+                             f"({type(e).__name__}: {e}); trying older")
+                # quarantine the corpse (rename, NEVER delete): it must not
+                # shadow a future save at the same step (same-step re-saves
+                # keep existing arrays) nor be re-probed on every resume —
+                # but the failure may be a config mismatch or a transient
+                # FS error, not corruption, and erasing possibly-good user
+                # checkpoints on a load error is how runs become
+                # unrecoverable. The renamed dir is invisible to listing
+                # (non-digit name) and left for forensics.
+                src = os.path.join(self.directory, str(step))
+                dst = os.path.join(self.directory,
+                                   f".unloadable-{step}-{os.getpid()}")
+                try:
+                    if not os.path.exists(dst):
+                        os.replace(src, dst)
+                except OSError:
+                    pass  # can't even rename: leave it; listing still works
+        return None
+
     def close(self):
-        self._mgr.close()
+        self.wait_until_finished()
 
 
 class ShardedCheckpointListener:
@@ -116,6 +369,9 @@ class FaultTolerantTrainer:
     story is Spark partition retry + CrashReportingUtil; the TPU-native story
     is restore-from-sharded-checkpoint and resume — slice preemptions and
     device OOMs surface as RuntimeError/XlaRuntimeError through jax).
+
+    The supervised loop with membership/regroup/drain on top of this lives
+    in parallel/elastic.py (ElasticTrainer).
 
         trainer = FaultTolerantTrainer(net, "/ckpts/run1",
                                        checkpoint_every=500, max_restarts=3)
@@ -152,7 +408,10 @@ class FaultTolerantTrainer:
                     if (restarts > self.max_restarts
                             or self.ckpt.latest_step() is None):
                         raise
-                    self.ckpt.restore(self.model)  # roll back to last good step
+                    # roll back to the newest checkpoint that LOADS — the
+                    # crash may have corrupted the newest one mid-write
+                    if self.ckpt.restore_latest_good(self.model) is None:
+                        raise
         finally:
             if self.listener in self.model.listeners:
                 self.model.listeners.remove(self.listener)

@@ -49,6 +49,25 @@ from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.profiler import NaNPanicError
 
 
+class RollbackSignal(RuntimeError):
+    """Raised by a monitor with ``action="rollback"`` on a critical anomaly:
+    the supervising loop (parallel/elastic.py ElasticTrainer) catches it,
+    restores the last good checkpoint, and re-enters training instead of
+    letting the run die. Carries the anomaly for the supervisor's log."""
+
+    def __init__(self, kind: str, detail: str, iteration: int):
+        super().__init__(f"{kind} at iteration {iteration}: {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.iteration = iteration
+
+
+#: anomaly kinds worth restoring a checkpoint over — the run's state is
+#: poisoned (NaN/Inf) or demonstrably worse than its past self (divergence);
+#: band breaches (loss_anomaly, update_ratio_anomaly) only warn
+ROLLBACK_KINDS = ("loss_non_finite", "params_non_finite", "divergence")
+
+
 def _finite_and_norms(params, prev):
     """Device-side probe body: [all_finite, ‖params‖, ‖params−prev‖] as one
     stacked float32 vector — three scalars, ONE fetch. ``prev=None`` skips
@@ -122,7 +141,10 @@ class TrainingHealthMonitor(TrainingListener):
                  warmup: int = 20, update_ratio: bool = True,
                  panic: bool = False,
                  on_anomaly: Optional[Callable[[str, str], None]] = None,
+                 action: Optional[str] = None,
                  log_fn=print):
+        if action not in (None, "rollback"):
+            raise ValueError(f"action must be None or 'rollback', got {action!r}")
         self.window = window
         self.alpha = alpha
         self.band_sigma = band_sigma
@@ -131,6 +153,7 @@ class TrainingHealthMonitor(TrainingListener):
         self.update_ratio = update_ratio
         self.panic = panic
         self.on_anomaly = on_anomaly
+        self.action = action
         self.log = log_fn
         self.anomalies: list = []  # (iteration, type, detail)
         self._loss = _Ewma(alpha)
@@ -151,10 +174,23 @@ class TrainingHealthMonitor(TrainingListener):
                      f"({detail})")
         if self.on_anomaly is not None:
             self.on_anomaly(kind, detail)
+        if self.action == "rollback" and kind in ROLLBACK_KINDS:
+            # the graceful alternative to panic: the supervising loop
+            # restores the last good checkpoint and re-enters training
+            raise RollbackSignal(kind, detail, iteration)
         if self.panic and kind in ("loss_non_finite", "params_non_finite"):
             raise NaNPanicError(
                 f"training health panic at iteration {iteration}: {kind} "
                 f"({detail})")
+
+    def reset(self):
+        """Re-arm after an external state change (checkpoint rollback,
+        transfer surgery): the EWMA bands and the previous-window param
+        snapshot describe a run that no longer exists."""
+        self._loss = _Ewma(self.alpha)
+        self._ratio = _Ewma(self.alpha)
+        self._prev_params = None
+        self._last_probe = None
 
     # ------------------------------------------------------------- listeners
     def iteration_done(self, model, iteration, epoch):

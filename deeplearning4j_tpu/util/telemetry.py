@@ -579,6 +579,7 @@ def install_default_collectors() -> Telemetry:
         tele.register_collector(_collect_compile)
         tele.register_collector(_collect_device_memory)
         tele.register_collector(_collect_compile_cache)
+        tele.register_collector(_collect_elastic)
         _defaults_installed = True
     return tele
 
@@ -639,6 +640,18 @@ def _collect_compile_cache() -> list:
     return [("compile_cache.enabled", {}, 1 if d else 0),
             ("compile_cache.entries", {},
              compile_cache.cache_entries() if d else 0)]
+
+
+def _collect_elastic() -> list:
+    """Elastic-runtime membership gauges (world size, live members,
+    rollbacks) at scrape time — import-guarded so a process that never
+    touched parallel/ pays nothing."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.parallel.elastic")
+    if mod is None:
+        return []
+    return mod.collect_elastic_gauges()
 
 
 def _after_fork_child():

@@ -176,8 +176,10 @@ class UIServer:
     def _healthz() -> "tuple[str, bool]":
         """(JSON body, healthy?) for /healthz: aggregates every health
         check published by util/health.py monitors, plus device liveness
-        (PJRT still answers). Unhealthy serves HTTP 503 so a k8s probe or
-        LB drains the task without parsing the body."""
+        (PJRT still answers), plus the elastic runtime's membership section
+        (world/rank/alive members, rollback/resume/drain history —
+        docs/FAULT_TOLERANCE.md). Unhealthy serves HTTP 503 so a k8s probe
+        or LB drains the task without parsing the body."""
         from deeplearning4j_tpu.util import telemetry as tm
 
         ok, checks = tm.get_telemetry().health_report()
@@ -190,8 +192,20 @@ class UIServer:
         except Exception as e:
             checks["devices"] = {"ok": False, "detail": repr(e)}
             ok = False
-        return json.dumps({"status": "ok" if ok else "unhealthy",
-                           "checks": checks}), ok
+        body = {"status": "ok" if ok else "unhealthy", "checks": checks}
+        try:
+            import sys
+
+            # sys.modules guard (same as telemetry._collect_elastic): the
+            # section is only ever non-empty once elastic.py is imported,
+            # and a liveness probe must not pay a jax-heavy package import
+            _elastic = sys.modules.get("deeplearning4j_tpu.parallel.elastic")
+            status = _elastic.current_status() if _elastic else {}
+            if status:
+                body["elastic"] = status
+        except Exception:
+            pass  # a broken status provider must never break the probe
+        return json.dumps(body), ok
 
     # ------------------------------------------------------------- rendering
     def _render(self, session: "Optional[str]" = None) -> str:

@@ -1,11 +1,19 @@
-"""Worker process for the multi-process DCN-bootstrap test.
+"""Worker process for the multi-process DCN-bootstrap and elastic tests.
 
-Usage: python _dist_worker.py <coordinator> <num_processes> <process_id>
+Usage:
+    python _dist_worker.py <coordinator> <num_processes> <process_id>
+        [--local-dp]
+    python _dist_worker.py --elastic <shared_dir> <process_id> <world>
+        [sigkill_at_step]
 
-Each process runs the SAME SPMD program over the GLOBAL mesh (the TPU-native
-shape of SharedTrainingMaster workers — SURVEY.md §3.4): the gradient
-all-reduce is emitted by the partitioner and rides the cross-process
-collective channel the coordinator bootstrapped."""
+``--elastic`` runs the supervised elastic runtime (parallel/elastic.py):
+membership over a shared directory (NOT jax.distributed — a SIGKILLed peer
+must not take the PJRT control plane down with it; the data plane per
+process is local DP, the r7 CPU-backend stance), checkpoint-auto-resume,
+epoch-boundary regroup. With ``sigkill_at_step`` the process arms the
+``sigkill_host`` fault against itself — the surviving process must notice
+the missed heartbeats, regroup to a smaller world, re-shard the batches,
+and finish."""
 
 import json
 import sys
@@ -75,7 +83,58 @@ def _local_dp(nproc, pid):
     distributed.shutdown()
 
 
+def _elastic(shared_dir, pid, world, sigkill_at=None):
+    """``--elastic`` mode: one member of a supervised elastic pod."""
+    import os
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel import ElasticTrainer, FileMembership
+    from deeplearning4j_tpu.util.faults import SIGKILL_HOST, get_injector
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.05))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)  # same data recipe on every member
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    it = ArrayDataSetIterator(xs, ys, batch=8)  # 8 batches / epoch
+
+    if sigkill_at is not None:
+        get_injector().inject(SIGKILL_HOST, at_step=sigkill_at)
+    membership = FileMembership(
+        os.path.join(shared_dir, "membership"), process_id=pid,
+        world_size=world, heartbeat_interval=0.3, miss_threshold=8,
+        barrier_timeout=90.0, log_fn=None)
+    trainer = ElasticTrainer(
+        net, os.path.join(shared_dir, f"ckpt-{pid}"), checkpoint_every=4,
+        membership=membership, log_fn=None)
+    trainer.fit(it, epochs=3)
+    view = membership.view
+    print(json.dumps({
+        "pid": pid,
+        "state": trainer.state,
+        "iteration": net.iteration,
+        "epoch": net.epoch,
+        "world_final": view.world if view else None,
+        "members_final": list(view.members) if view else None,
+        "regroups": membership.regroups,
+        "score_finite": bool(np.isfinite(float(net.score_value))),
+    }), flush=True)
+
+
 def main():
+    if sys.argv[1] == "--elastic":
+        _elastic(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                 int(sys.argv[5]) if len(sys.argv) > 5 else None)
+        return
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     distributed.initialize(coordinator=coordinator, num_processes=nproc,
                            process_id=pid)

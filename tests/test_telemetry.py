@@ -17,13 +17,26 @@ from deeplearning4j_tpu.util.health import TrainingHealthMonitor
 
 @pytest.fixture(autouse=True)
 def _clean_registry():
-    """Each test sees a fresh, enabled registry and leaves it enabled."""
+    """Each test sees a fresh, enabled registry and leaves it enabled.
+
+    Collector wiring is saved/cleared/restored too: collectors survive
+    ``reset()`` by design, so any EARLIER test file that installed the
+    default collectors (e.g. the elastic suite asserting /metrics gauges)
+    would otherwise leak scrape-time series into this file's snapshot
+    assertions. Tests here that need the defaults re-install them (the
+    module flag is reset alongside)."""
     tele = tm.get_telemetry()
     tele.reset()
     was = tele.enabled
+    saved_collectors = list(tele._collectors)
+    saved_flag = tm._defaults_installed
+    tele._collectors.clear()
+    tm._defaults_installed = False
     tele.enabled = True
     yield tele
     tele.enabled = was
+    tele._collectors[:] = saved_collectors
+    tm._defaults_installed = saved_flag
     tele.reset()
 
 
