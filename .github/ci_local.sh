@@ -74,6 +74,17 @@ echo "== step: Fault-tolerance smoke (ETL kill + NaN rollback + host SIGKILL) ==
 # regroups + re-shards); recoveries visible on /healthz + /metrics.
 JAX_PLATFORMS=cpu python benchmarks/fault_smoke.py
 
+echo "== step: GSPMD sharded-fit bit-identity + ZeRO memory =="
+# ISSUE 7: the deterministic lane mode must make an 8-virtual-device
+# sharded fit BIT-identical to the single-device fit (params, Adam
+# moments, RNG key) on dense MLN / multi-io CG / TBPTT-LSTM topologies,
+# ZeRO must cut optimizer-state bytes/device ~8x, elastic reshard must
+# recompile onto the shrunken mesh, and the sharded cost report must
+# expose honest per-device + global totals.
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/test_gspmd_identity.py -q
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

@@ -61,6 +61,15 @@ LOWER_BETTER = {
     "recompile_overhead",
     "cost_attribution_overhead",
     "elastic_overhead",
+    "zero_optimizer_memory_bytes_per_device",
+}
+
+# Metrics a candidate run may NEVER drop (missing == fail even without
+# --strict): the scaling-efficiency number is the r12 GSPMD rewrite's
+# contract — a run that silently stops reporting it would let efficiency
+# regress unobserved (ISSUE 7 satellite).
+CRITICAL = {
+    "dp_sharding_efficiency_8dev_virtual_cpu",
 }
 
 _NOISE_RE = re.compile(r"[+±]?\s*([0-9.]+)\s*%")
@@ -190,7 +199,12 @@ def render(results: List[dict]) -> str:
 
 def _passed(results: List[dict], strict: bool) -> bool:
     bad = {"regressed"} | ({"missing"} if strict else set())
-    return not any(r["status"] in bad for r in results)
+    for r in results:
+        if r["status"] in bad:
+            return False
+        if r["status"] == "missing" and r["metric"] in CRITICAL:
+            return False
+    return True
 
 
 def _load_candidate_file(path: str) -> Dict[str, Tuple[float, Optional[float]]]:

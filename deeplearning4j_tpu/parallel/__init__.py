@@ -7,6 +7,7 @@ one SPMD program: XLA emits the collectives over ICI/DCN.
 """
 
 from deeplearning4j_tpu.parallel import distributed  # noqa: F401
+from deeplearning4j_tpu.parallel import gspmd  # noqa: F401
 from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
     ElasticTrainer,
     FileMembership,

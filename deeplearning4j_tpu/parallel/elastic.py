@@ -601,7 +601,24 @@ class ElasticTrainer:
                     self._checkpoint(block=True)
             while net.epoch < epochs:
                 if self.membership is not None:
+                    prev_view = self._view
                     self._view = self.membership.regroup(net.epoch)
+                    if (self.wrapper is not None and prev_view is not None
+                            and self._view is not None
+                            and self._view.world != prev_view.world):
+                        # world changed at the barrier: re-place model state
+                        # and recompile the GSPMD step onto the CURRENT
+                        # device view (reshard() with no mesh re-derives it
+                        # from jax.devices(), which on a real pod reflects
+                        # the survivors) — the sharding layout is part of
+                        # the compile key, so the shrunken mesh gets its
+                        # own executable (docs/DISTRIBUTED.md). On one host
+                        # the local device set is unchanged and this is a
+                        # cheap re-placement; on a real pod it is the
+                        # data-plane half of the regroup.
+                        self.wrapper.reshard()
+                        tm.instant("elastic.reshard", epoch=net.epoch,
+                                   world=self._view.world)
                 try:
                     done = self._run_epoch(iterator, injector)
                     if done:

@@ -1,0 +1,303 @@
+"""GSPMD building blocks: lane decomposition, deterministic combines, ZeRO.
+
+This module is the shared machinery of the mesh + ``NamedSharding`` + ``jit``
+rewrite (ROADMAP item 1): every distributed-training entry point —
+``ParallelWrapper``, both Spark-style training masters, MoE expert
+parallelism, ring attention, the GPipe pipeline — is ONE ``jit``-compiled
+SPMD program whose parallelism is expressed as sharding annotations, with
+XLA's partitioner inserting the collectives (SNIPPETS.md [2]/[3];
+whole-program compilation per arXiv:1810.09868). No per-device mapped
+functions, no pmap,
+no per-device Python.
+
+Three ideas live here:
+
+**Lanes.** Data parallelism is expressed as a leading ``replicas`` axis
+("lanes"): the global batch reshapes to ``(R, b, ...)`` and the per-lane
+step runs under ``vmap`` with the lane axis sharded over the mesh ``data``
+axis. With one lane per device the per-device tensor shapes equal the lane
+shapes, which is what makes determinism provable (below).
+
+**Deterministic combines.** XLA rewrites a reduce over a sharded dimension
+into partial-reduce + AllReduce, whose accumulation order depends on the
+topology — the reason naive DP training is not reproducible across device
+counts. ``pairwise_sum`` instead writes the cross-lane combine as an
+explicit balanced binary tree of adds over lane slices: GSPMD only moves
+data, never re-associates explicit adds, so the combined value is
+bit-identical on 8 devices and on 1 — PROVIDED no multiply shares a fused
+kernel with the tree adds (LLVM FMA contraction is fusion-context
+dependent; the wrapper therefore stages lane-compute / combine / update as
+three jit programs — see the determinism note in parallel/wrapper.py).
+The single-device reference is the SAME vmapped jit executed
+unpartitioned, giving the proven invariant: an 8-virtual-device sharded
+fit equals the single-device fit BIT-FOR-BIT (params, Adam moments, RNG
+key) for gemm/recurrent topologies. (Known backend limits, pinned by
+tests: XLA:CPU lowers the vmapped conv *filter gradient* to a
+batch-grouped convolution whose accumulation grouping depends on the lane
+fold, and gemm k-blocking becomes shape-dependent for contraction dims
+>= ~1024 — such topologies reproduce to ~1e-6 instead of exactly.)
+
+**ZeRO optimizer-state sharding** (arXiv:2004.13336, "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"):
+optimizer moments (Adam m/v, momentum buffers) are placed with each leaf
+sharded over the ``data`` axis and the layout is re-asserted inside the
+step with ``with_sharding_constraint``; the partitioner then emits
+reduce-scatter(grads) -> sharded elementwise update -> all-gather(params),
+so per-chip optimizer memory and update compute both drop ~Nx. Elementwise
+updates are association-free, so ZeRO composes with the deterministic mode
+without losing bit-identity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.util import cost_model as cmod
+
+# ---------------------------------------------------------------------------
+# deterministic cross-lane combines
+# ---------------------------------------------------------------------------
+
+
+def pairwise_sum(t):
+    """Sum over axis 0 as an explicit balanced tree of adds.
+
+    The association is fixed by the op graph — ((x0+x1)+(x2+x3))... — so the
+    result is bit-identical whether the lane axis lives on one device or is
+    sharded across the mesh (GSPMD moves slices, it cannot re-associate
+    explicit adds the way it re-associates a ``reduce``). Odd remainders
+    fold in at the end of each level, so any R works.
+    """
+    while t.shape[0] > 1:
+        half = t.shape[0] // 2
+        even = t[0 : 2 * half : 2] + t[1 : 2 * half : 2]
+        t = even if t.shape[0] % 2 == 0 else jnp.concatenate(
+            [even, t[-1:]], axis=0)
+    return t[0]
+
+
+def pairwise_mean(t):
+    return pairwise_sum(t) * (1.0 / t.shape[0])
+
+
+def tree_pairwise_sum(tree):
+    return jax.tree_util.tree_map(pairwise_sum, tree)
+
+
+def tree_pairwise_mean(tree):
+    return jax.tree_util.tree_map(pairwise_mean, tree)
+
+
+def combine_states(stacked_states):
+    """Cross-lane combine for non-trainable state (batchnorm statistics):
+    floating leaves average (the pmean the legacy per-device path applied),
+    everything else takes lane 0's copy."""
+    return jax.tree_util.tree_map(
+        lambda v: pairwise_mean(v)
+        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact) else v[0],
+        stacked_states)
+
+
+# ---------------------------------------------------------------------------
+# per-lane loss/grad for MLN and ComputationGraph
+# ---------------------------------------------------------------------------
+
+
+def make_lane_value_and_grad(model) -> Callable:
+    """fn(params, states, x, y, key, weights, fm, lm) ->
+    ((loss, weight_sum), (new_states, grads)) for ONE lane.
+
+    Works for MultiLayerNetwork (list-keyed params, single input) and
+    ComputationGraph (dict-keyed params, multi input/output — raw arrays or
+    lists zip with the graph's declared input/output order, exactly like
+    ``make_step_fn``). ``weight_sum`` is the lane's loss-weight mass — the
+    wrapper's combine stage recombines lane means into the global weighted
+    mean with it."""
+    is_graph = isinstance(model._updaters, dict)
+    if is_graph:
+        layer_names = [n.name for n in model.topo if n.is_layer]
+        in_names = list(model.conf.inputs)
+        out_names = list(model.conf.outputs)
+
+        def lane(params, states, x, y, key, weights, fm, lm):
+            subkeys = jax.random.split(key, len(layer_names))
+            keys = dict(zip(layer_names, subkeys))
+            feed = (dict(zip(in_names, x)) if isinstance(x, (list, tuple))
+                    else {in_names[0]: x})
+            labs = (dict(zip(out_names, y)) if isinstance(y, (list, tuple))
+                    else {out_names[0]: y})
+            (loss, new_states), grads = jax.value_and_grad(
+                model._loss, has_aux=True)(
+                params, states, feed, labs, keys, weights, fm, lm)
+            wsum = jnp.sum(weights) if weights is not None \
+                else jnp.asarray(1.0, jnp.float32)
+            return (loss, wsum), (new_states, grads)
+
+        return lane
+
+    n_layers = len(model.layers)
+
+    def lane(params, states, x, y, key, weights, fm, lm):
+        keys = list(jax.random.split(key, n_layers))
+        (loss, new_states), grads = jax.value_and_grad(
+            model._loss, has_aux=True)(
+            params, states, x, y, keys, weights, fm, lm)
+        wsum = jnp.sum(weights) if weights is not None \
+            else jnp.asarray(1.0, jnp.float32)
+        return (loss, wsum), (new_states, grads)
+
+    return lane
+
+
+def make_lane_tbptt_value_and_grad(model) -> Callable:
+    """TBPTT-segment variant (MultiLayerNetwork only): carries in/out, one
+    update per segment — the lane body of the wrapper's sharded
+    ``doTruncatedBPTT``."""
+    if isinstance(model._updaters, dict):
+        raise NotImplementedError(
+            "sharded TBPTT is implemented for MultiLayerNetwork; fit the "
+            "ComputationGraph through its own fit() or without tbptt_length")
+    n_layers = len(model.layers)
+
+    def seg_loss(params, states, carries, x, y, keys, weights, fm, lm):
+        loss, (new_states, new_carries) = model._loss_body(
+            params, states, carries, x, y, keys, weights, fm, lm)
+        return loss, (new_states, new_carries)
+
+    def lane(params, states, carries, x, y, key, weights, fm, lm):
+        keys = list(jax.random.split(key, n_layers))
+        (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+            seg_loss, has_aux=True)(
+            params, states, carries, x, y, keys, weights, fm, lm)
+        wsum = jnp.sum(weights) if weights is not None \
+            else jnp.asarray(1.0, jnp.float32)
+        return (loss, wsum), (new_states, new_carries, grads)
+
+    return lane
+
+
+def apply_updaters(model, params, grads, opt_states, iteration):
+    """One updater application over the model's per-layer updaters — the
+    shared tail of every sharded step (MLN list / CG dict keyed)."""
+    is_graph = isinstance(model._updaters, dict)
+    updaters = model._updaters
+    if is_graph:
+        new_params, new_opts = dict(params), dict(opt_states)
+        keys = [n.name for n in model.topo if n.is_layer]
+    else:
+        new_params, new_opts = list(params), list(opt_states)
+        keys = range(len(model.layers))
+    with cmod.optimizer_scope():
+        for k in keys:
+            if not grads[k]:
+                continue
+            p, s = upd.apply_updater(
+                updaters[k], params[k], grads[k], opt_states[k], iteration)
+            new_params[k] = p
+            new_opts[k] = s
+    return new_params, new_opts
+
+
+# ---------------------------------------------------------------------------
+# ZeRO optimizer-state sharding (arXiv:2004.13336)
+# ---------------------------------------------------------------------------
+
+
+def zero_shardings(mesh: Mesh, tree, axis: str = "data",
+                   min_elements: int = 1024):
+    """Per-leaf ``NamedSharding`` tree for ZeRO-style optimizer-state
+    sharding: each array leaf shards its first dimension divisible by the
+    ``axis`` size; leaves too small (< ``min_elements``) or with no
+    divisible dimension stay replicated. Sharding choice never changes
+    values — optimizer updates are elementwise — only which device holds
+    (and updates) which slice."""
+    n = int(mesh.shape[axis]) if axis in mesh.shape else 1
+
+    def spec_of(leaf):
+        shape = np.shape(leaf)
+        if n <= 1 or int(np.prod(shape or (0,))) < min_elements:
+            return NamedSharding(mesh, P())
+        for d, size in enumerate(shape):
+            if size and size % n == 0:
+                return NamedSharding(
+                    mesh, P(*([None] * d + [axis])))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(spec_of, tree)
+
+
+def constrain_tree(tree, shardings):
+    """with_sharding_constraint leaf-wise (inside jit)."""
+    return jax.tree_util.tree_map(
+        lambda t, s: lax.with_sharding_constraint(t, s), tree, shardings)
+
+
+def place_tree(tree, shardings):
+    """device_put leaf-wise (outside jit)."""
+    return jax.tree_util.tree_map(
+        lambda t, s: jax.device_put(t, s), tree, shardings)
+
+
+def sharded_fraction(shardings) -> float:
+    """Fraction of leaves whose spec actually partitions (telemetry)."""
+    leaves = [s for s in jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))]
+    if not leaves:
+        return 0.0
+    n = sum(1 for s in leaves if any(s.spec))
+    return n / len(leaves)
+
+
+def tree_bytes_per_device(tree) -> int:
+    """Bytes one device holds for a placed pytree — the ZeRO memory
+    number. Computed from each leaf's sharding (``shard_shape``), not by
+    fetching data."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = np.shape(leaf)
+        itemsize = np.dtype(leaf.dtype).itemsize if hasattr(leaf, "dtype") \
+            else 4
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += int(np.prod(shape or (1,))) * itemsize
+    return total
+
+
+def tree_bytes(tree) -> int:
+    return sum(
+        int(np.prod(np.shape(l) or (1,)))
+        * (np.dtype(l.dtype).itemsize if hasattr(l, "dtype") else 4)
+        for l in jax.tree_util.tree_leaves(tree))
+
+
+def describe_shardings(tree) -> Dict[str, str]:
+    """{key-path: PartitionSpec} for a placed pytree — the per-device
+    layout table kept on ``ParallelWrapper.layout`` and summarized by the
+    ``parallel.*`` telemetry gauges."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        s = getattr(leaf, "sharding", None)
+        out[key] = str(getattr(s, "spec", s))
+    return out
+
+
+def layout_signature(mesh, extra: Any = None) -> str:
+    """Stable string describing the mesh layout (+ optional extras like the
+    ZeRO flag / replica count): folded into AOT/compile-cache keys so an
+    executable compiled for one sharding layout is never served for
+    another. (jit's in-memory dispatch cache and the persistent XLA
+    compilation cache both already key on input shardings/partitioned HLO;
+    this signature makes the layout explicit for on-disk export keys and
+    for tests.)"""
+    shape = dict(getattr(mesh, "shape", {})) or {}
+    sig = ",".join(f"{k}={v}" for k, v in sorted(shape.items()))
+    return f"mesh({sig})|extra({extra})"

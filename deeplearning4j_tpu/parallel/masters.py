@@ -11,29 +11,31 @@ Reference parity (SURVEY.md §2.2 J18, §3.4):
   everyone's quantized updates; residual stays local (call stack §3.4).
 - SparkDl4jMultiLayer.java — the user facade.
 
-TPU-native collapse: "workers" are mesh devices along the 'data' axis inside
-ONE SPMD program per step (shard_map). Parameter averaging keeps genuinely
-divergent per-device params (leading stacked axis) and pmean-averages every N
-steps — semantically identical to the Spark master with zero serialization.
-Shared training runs the encode → psum(quantized) → decode → update chain
-inside the step: the psum over ICI/DCN replaces the Aeron mesh, the residual
-is device-local state, and the threshold adapts exactly like
-AdaptiveThresholdAlgorithm. No Spark, no parameter server process, no
-message queues — the collective IS the parameter server.
+TPU-native collapse: "workers" are lanes of ONE ``jit``-compiled GSPMD
+program — a leading worker axis on the stacked state, sharded
+``PartitionSpec("data")`` over the mesh, with the per-worker step vmapped
+across it (parallel/gspmd.py; no per-device mapped functions — ROADMAP item 1). Parameter
+averaging keeps genuinely divergent per-worker params (the stacked axis)
+and averages every N steps with a deterministic pairwise-tree combine —
+semantically identical to the Spark master with zero serialization. Shared
+training runs the encode → cross-worker mean(quantized) → decode → update
+chain inside the step: the partitioner-inserted all-reduce over ICI/DCN
+replaces the Aeron mesh, the residual is worker-local state (stacked,
+sharded), and the threshold adapts exactly like AdaptiveThresholdAlgorithm.
+No Spark, no parameter server process, no message queues — the collective
+IS the parameter server.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.parallel import gspmd
 from deeplearning4j_tpu.parallel.accumulator import EncodedGradientsAccumulator
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 from deeplearning4j_tpu.util import telemetry as tm
@@ -77,38 +79,29 @@ class ParameterAveragingTrainingMaster:
     def _build(self, model):
         mesh = self.mesh.mesh
         step_fn = model.make_step_fn(weighted=True)
+        stacked = NamedSharding(mesh, P("data"))
 
-        def local_step(params, states, opts, iteration, x, y, keys, w, fm, lm):
-            params, states, opts = map(_unstack_first, (params, states, opts))
-            key = keys[0]
-            new_p, new_s, new_o, loss = step_fn(
-                params, states, opts, iteration, x, y, key, w, fm, lm)
-            one = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
-            return one(new_p), one(new_s), one(new_o), loss[None]
+        def lanes_step(params, states, opts, iteration, x, y, keys, w, fm, lm):
+            # every worker fits locally: the per-worker step vmapped over
+            # the stacked axis, which the partitioner splits over 'data'
+            return jax.vmap(
+                step_fn, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0, 0)
+            )(params, states, opts, iteration, x, y, keys, w, fm, lm)
 
         def average(params, opts, states):
-            avg = lambda t: jax.tree_util.tree_map(
-                lambda v: lax.pmean(v, "data"), t)
+            # deterministic pairwise-tree average, re-stacked so the state
+            # keeps its worker-sharded layout for the next local steps
+            def avg(t):
+                return jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        jnp.broadcast_to(
+                            gspmd.pairwise_mean(v)[None], v.shape),
+                        stacked),
+                    t)
             return avg(params), avg(opts), avg(states)
 
-        stacked = P("data")
-        self._step = jax.jit(
-            jax.shard_map(
-                local_step, mesh=mesh,
-                in_specs=(stacked, stacked, stacked, P(), stacked, stacked,
-                          stacked, stacked, stacked, stacked),
-                out_specs=(stacked, stacked, stacked, stacked),
-                check_vma=False,
-            ),
-            donate_argnums=(0, 1, 2),
-        )
-        self._avg = jax.jit(
-            jax.shard_map(
-                average, mesh=mesh, in_specs=(stacked, stacked, stacked),
-                out_specs=(stacked, stacked, stacked), check_vma=False,
-            ),
-            donate_argnums=(0, 1, 2),
-        )
+        self._step = jax.jit(lanes_step, donate_argnums=(0, 1, 2))
+        self._avg = jax.jit(average, donate_argnums=(0, 1, 2))
 
     # -- orchestration ------------------------------------------------------
     def fit(self, model, iterator, epochs: int = 1):
@@ -127,8 +120,9 @@ class ParameterAveragingTrainingMaster:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x, y, w, (fm, lm) = self.mesh.pad_shard_batch(
-                    ds.features, ds.labels, extras=_batch_masks(ds, model))
+                x, y, w, (fm, lm) = self.mesh.pad_lane_batch(
+                    ds.features, ds.labels, n,
+                    extras=_batch_masks(ds, model))
                 model._rng_key, sub = jax.random.split(model._rng_key)
                 keys = jax.device_put(
                     jax.random.split(sub, n), shard)
@@ -170,73 +164,36 @@ class SharedTrainingMaster:
         self._step = None
 
     def _build(self, model):
-        mesh = self.mesh.mesh
-        updaters = model._updaters
         acc = self.accumulator
-        # MLN keys layers by integer index; ComputationGraph by node name.
-        is_graph = isinstance(updaters, dict)
-        if is_graph:
-            # arbitrary DAGs, any number of inputs/outputs
-            # (SharedTrainingWrapper.java wraps arbitrary ComputationGraphs)
-            layer_keys = [n.name for n in model.topo if n.is_layer]
-            in_names = list(model.conf.inputs)
-            out_names = list(model.conf.outputs)
-        else:
-            layer_keys = list(range(len(model.layers)))
+        lane_vg = gspmd.make_lane_value_and_grad(model)
 
-        def local_step(params, states, opts, residual, threshold, iteration,
-                       x, y, keys, w, fm, lm):
-            residual = _unstack_first(residual)
-            threshold = threshold[0]
-            key = keys[0]
-            subkeys = jax.random.split(key, len(layer_keys))
-            if is_graph:
-                lkeys = dict(zip(layer_keys, subkeys))
-                feed = (dict(zip(in_names, x))
-                        if isinstance(x, (list, tuple)) else {in_names[0]: x})
-                labs = (dict(zip(out_names, y))
-                        if isinstance(y, (list, tuple)) else {out_names[0]: y})
-                (loss, new_states), grads = jax.value_and_grad(
-                    model._loss, has_aux=True)(
-                    params, states, feed, labs, lkeys, w, fm, lm)
-            else:
-                lkeys = list(subkeys)
-                (loss, new_states), grads = jax.value_and_grad(
-                    model._loss, has_aux=True)(
-                    params, states, x, y, lkeys, w, fm, lm)
+        def lane(params, states, residual, threshold, iteration,
+                 x, y, key, w, fm, lm):
+            (loss, _), (new_states, grads) = lane_vg(
+                params, states, x, y, key, w, fm, lm)
             quant, new_res, new_thr, _ratio = acc.encode(
                 grads, residual, threshold, iteration)
-            shared = jax.tree_util.tree_map(
-                lambda q: lax.pmean(q, "data"), quant)
-            new_params = dict(params) if is_graph else list(params)
-            new_opts = dict(opts) if is_graph else list(opts)
-            for k in layer_keys:
-                if not grads[k]:
-                    continue
-                p, s = upd.apply_updater(
-                    updaters[k], params[k], shared[k], opts[k], iteration)
-                new_params[k] = p
-                new_opts[k] = s
-            # non-trainable state (batchnorm stats) kept consistent by pmean
-            new_states = jax.tree_util.tree_map(
-                lambda v: lax.pmean(v, "data") if jnp.issubdtype(
-                    jnp.asarray(v).dtype, jnp.floating) else v, new_states)
-            one = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
-            return (new_params, new_states, new_opts, one(new_res),
-                    new_thr[None], lax.pmean(loss, "data"))
+            return loss, new_states, quant, new_res, new_thr
 
-        stacked = P("data")
-        rep = P()
-        self._step = jax.jit(
-            jax.shard_map(
-                local_step, mesh=mesh,
-                in_specs=(rep, rep, rep, stacked, stacked, rep, stacked,
-                          stacked, stacked, stacked, stacked, stacked),
-                out_specs=(rep, rep, rep, stacked, stacked, rep),
-                check_vma=False,
-            ),
-            donate_argnums=(0, 1, 2, 3),
-        )
+        def step(params, states, opts, residual, threshold, iteration,
+                 x, y, keys, w, fm, lm):
+            # per-worker lanes: params/states broadcast, residual/threshold
+            # and the batch ride the stacked worker axis (sharded 'data')
+            loss_l, states_l, quant_l, new_res, new_thr = jax.vmap(
+                lane, in_axes=(None, None, 0, 0, None, 0, 0, 0, 0, 0, 0)
+            )(params, states, residual, threshold, iteration,
+              x, y, keys, w, fm, lm)
+            # the all-reduce of quantized updates IS the parameter server;
+            # pairwise-tree mean keeps the combine deterministic
+            shared = gspmd.tree_pairwise_mean(quant_l)
+            new_params, new_opts = gspmd.apply_updaters(
+                model, params, shared, opts, iteration)
+            # non-trainable state (batchnorm stats) kept consistent by mean
+            new_states = gspmd.combine_states(states_l)
+            return (new_params, new_states, new_opts, new_res, new_thr,
+                    gspmd.pairwise_mean(loss_l))
+
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def fit(self, model, iterator, epochs: int = 1):
         if self._step is None:
@@ -256,8 +213,9 @@ class SharedTrainingMaster:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x, y, w, (fm, lm) = self.mesh.pad_shard_batch(
-                    ds.features, ds.labels, extras=_batch_masks(ds, model))
+                x, y, w, (fm, lm) = self.mesh.pad_lane_batch(
+                    ds.features, ds.labels, n,
+                    extras=_batch_masks(ds, model))
                 model._rng_key, sub = jax.random.split(model._rng_key)
                 keys = jax.device_put(jax.random.split(sub, n), shard)
                 params, states, opts, residual, threshold, loss = self._step(

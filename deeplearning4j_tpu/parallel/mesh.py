@@ -15,6 +15,7 @@ identical — jax.distributed bootstrap happens in parallel.distributed.
 
 from __future__ import annotations
 
+import re
 from typing import Optional, Sequence
 
 import jax
@@ -58,36 +59,51 @@ class TrainingMesh:
         )
         return out if len(out) > 1 else out[0]
 
-    def pad_shard_batch(self, x, y, extras=None):
-        """Pad (x, y) to 'data'-axis divisibility and shard; returns
-        (x, y, weights) where padded rows carry loss weight 0 so a weighted
-        loss divides by the REAL example count — gradients stay exact for
-        ragged batches, not just divisible ones. ``x``/``y`` may each be a
-        list/tuple of arrays (multi-input/multi-output ComputationGraphs);
-        the matching return slot is then a tuple, sharded leaf-wise.
-        ``extras``: optional pytree of (B, ...) arrays (sequence masks etc.)
-        padded/sharded the same way — returned as a 4th element when given."""
+    @staticmethod
+    def _pad_ragged(x, y, divisor: int, extras):
+        """Shared host-side ragged-batch padding: pad (x, y, extras) to
+    ``divisor`` divisibility by repeating the last row, with a 0/1 loss-
+    weight vector over the padded rows so a weighted loss divides by the
+    REAL example count — gradients stay exact for ragged batches. Returns
+    (xs, ys, w, extras, multi_x, multi_y). The ONE implementation behind
+    both the flat-batch and the lane-decomposed placements, so the padding
+    semantics can never drift between them (the deterministic mode's
+    bit-identity contract rides on this)."""
         multi_x = isinstance(x, (list, tuple))
         multi_y = isinstance(y, (list, tuple))
         xs = [np.asarray(v) for v in (x if multi_x else [x])]
         ys = [np.asarray(v) for v in (y if multi_y else [y])]
         n = len(xs[0])
-        pad = (self.data - n % self.data) % self.data
+        pad = (divisor - n % divisor) % divisor
         w = np.ones(n + pad, np.float32)
-        rep = lambda v: np.concatenate(
+        rep = lambda v: np.concatenate(  # noqa: E731
             [v, np.repeat(v[-1:], pad, axis=0)], axis=0)
         if pad:
             xs = [rep(v) for v in xs]
             ys = [rep(v) for v in ys]
             w[n:] = 0.0
+        if extras is not None:
+            extras = jax.tree_util.tree_map(
+                lambda v: rep(np.asarray(v)) if pad else np.asarray(v),
+                extras)
+        return xs, ys, w, extras, multi_x, multi_y
+
+    def pad_shard_batch(self, x, y, extras=None):
+        """Pad (x, y) to 'data'-axis divisibility and shard; returns
+        (x, y, weights) with 0-weighted padding rows (see ``_pad_ragged``).
+        ``x``/``y`` may each be a list/tuple of arrays (multi-input/multi-
+        output ComputationGraphs); the matching return slot is then a
+        tuple, sharded leaf-wise. ``extras``: optional pytree of (B, ...)
+        arrays (sequence masks etc.) padded/sharded the same way —
+        returned as a 4th element when given."""
+        xs, ys, w, extras, multi_x, multi_y = self._pad_ragged(
+            x, y, self.data, extras)
         sharded = self.shard_batch(*xs, *ys, w)
         sx, sy, sw = sharded[: len(xs)], sharded[len(xs):-1], sharded[-1]
         out = (sx if multi_x else sx[0], sy if multi_y else sy[0], sw)
         if extras is None:
             return out
-        ex = jax.tree_util.tree_map(
-            lambda v: self.shard_batch(rep(np.asarray(v)) if pad
-                                       else np.asarray(v)), extras)
+        ex = jax.tree_util.tree_map(lambda v: self.shard_batch(v), extras)
         return out + (ex,)
 
     def replicate(self, tree, keep_existing: bool = True):
@@ -107,6 +123,69 @@ class TrainingMesh:
             return jax.device_put(x, sharding)
 
         return jax.tree_util.tree_map(place, tree)
+
+    def pad_lane_batch(self, x, y, replicas: int, extras=None):
+        """Lane-decomposed variant of :meth:`pad_shard_batch` (the
+        deterministic GSPMD path — parallel/gspmd.py): the same ragged
+        padding (``_pad_ragged``), then every array reshapes to
+        ``(replicas, b, ...)`` with the LANE axis sharded over 'data'.
+        Returns (x, y, weights[, extras]) with weights shaped
+        ``(replicas, b)``. The lane count is fixed by the caller — not by
+        the device count — which is what makes a fit reproducible across
+        mesh sizes."""
+        xs, ys, w, extras, multi_x, multi_y = self._pad_ragged(
+            x, y, replicas, extras)
+        lane = lambda v: np.reshape(  # noqa: E731
+            v, (replicas, v.shape[0] // replicas) + v.shape[1:])
+        place = lambda v: jax.device_put(  # noqa: E731
+            v, NamedSharding(self.mesh, P("data", *([None] * (v.ndim - 1)))))
+        sx = tuple(place(lane(v)) for v in xs)
+        sy = tuple(place(lane(v)) for v in ys)
+        sw = place(lane(w))
+        out = (sx if multi_x else sx[0], sy if multi_y else sy[0], sw)
+        if extras is None:
+            return out
+        ex = jax.tree_util.tree_map(lambda v: place(lane(v)), extras)
+        return out + (ex,)
+
+    def tensor_shard_params(self, tree, rules):
+        """Tensor parallelism as pure annotation (SNIPPETS.md [3]): place
+        param leaves whose key path matches a rule regex with the rule's
+        PartitionSpec on THIS mesh; everything else is left untouched (a
+        later :meth:`replicate` keeps the TP placements). ``rules``:
+        iterable of (pattern, PartitionSpec) — e.g.
+        ``[(r"W1$", P(None, "model")), (r"W2$", P("model", None))]``.
+        Leaves whose matched dimension is not divisible by the axis size
+        are skipped (annotation must never change semantics)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            placed = leaf
+            for pattern, spec in rules:
+                if not re.search(pattern, key):
+                    continue
+                ok = True
+                for d, ax in enumerate(spec):
+                    if ax is None:
+                        continue
+                    size = self.mesh.shape[ax]
+                    if d >= np.ndim(leaf) or np.shape(leaf)[d] % size:
+                        ok = False
+                        break
+                if ok:
+                    placed = jax.device_put(
+                        leaf, NamedSharding(self.mesh, spec))
+                break
+            out.append(placed)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def layout_signature(self, extra=None) -> str:
+        """Stable layout key for compile-cache / AOT-export keying
+        (parallel/gspmd.py:layout_signature)."""
+        from deeplearning4j_tpu.parallel import gspmd
+
+        return gspmd.layout_signature(self.mesh, extra=extra)
 
     @property
     def n_devices(self) -> int:

@@ -1,12 +1,20 @@
-"""Pipeline parallelism: GPipe-style microbatched stage execution.
+"""Pipeline parallelism: GPipe-style microbatched stage execution, as GSPMD.
 
 The reference has no pipeline parallelism (SURVEY.md §2.3: PP — absent);
 this is the TPU-native extension: S identical-signature stages live on S
-devices along a mesh axis, microbatches stream through the ring with
-``ppermute`` hops, and every device runs the SAME program (SPMD) — its own
-stage's params applied to whatever activation just arrived. The schedule is
-the classic GPipe fill-drain: n_micro + S - 1 ticks, bubble fraction
-(S-1)/(n_micro+S-1).
+devices along a mesh axis and microbatches stream through the stage ring.
+The schedule is the classic GPipe fill-drain: n_micro + S - 1 ticks, bubble
+fraction (S-1)/(n_micro+S-1).
+
+GSPMD formulation (no per-device mapped functions — ROADMAP item 1): stage params and the
+inter-stage activation buffer carry an explicit leading stage axis annotated
+``PartitionSpec(axis_name)``; each tick applies the stage function across
+the stage axis with ``vmap`` (per device: its own stage's params on the
+activation that just arrived) and rotates the buffer one stage with
+``jnp.roll`` on the sharded axis — the partitioner lowers the roll to the
+ring's collective-permute. The tick loop is a ``lax.scan``, so the whole
+pipeline is ONE whole-program-compiled XLA computation (arXiv:1810.09868)
+and reverse AD through the scan gives the backward pipeline for free.
 
 API:
 
@@ -16,24 +24,71 @@ API:
 
 ``stage_fn(params_i, x) -> y`` must map activations of a fixed shape to the
 same shape (equal-width stages — the standard PP regime; embed/head layers
-live outside the pipeline). Differentiable: JAX AD reverses the ppermute
-ring, giving the backward pipeline for free.
+live outside the pipeline).
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+import functools
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def stack_stage_params(params_list: Sequence):
     """[per-stage pytree] → one pytree with a leading stage axis (shard it
     over the pipeline mesh axis)."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+@functools.lru_cache(maxsize=64)
+def _pipeline_program(stage_fn: Callable, mesh: Mesh, axis_name: str,
+                      s: int, n_micro: int):
+    stage_spec = NamedSharding(mesh, P(axis_name))
+
+    def constrain(t):
+        return jax.tree_util.tree_map(
+            lambda v: lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(axis_name))), t)
+
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+
+    def run(stacked_params, micro):
+        # micro: (n_micro, mb, ...); buffer: (s, mb, ...) — the activation
+        # each stage processes this tick, stage axis sharded over the ring
+        stacked_params = constrain(stacked_params)
+        mb_shape = micro.shape[1:]
+        buffer = jnp.zeros((s,) + mb_shape, micro.dtype)
+        outs = jnp.zeros((n_micro,) + mb_shape, micro.dtype)
+
+        def tick(carry, t):
+            buffer, outs = carry
+            feed = lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            # stage 0 ingests microbatch t; stages 1..s-1 use what arrived
+            inp = lax.with_sharding_constraint(
+                buffer.at[0].set(feed), stage_spec)
+            out = lax.with_sharding_constraint(
+                vstage(stacked_params, inp), stage_spec)
+            # last stage banks its result at slot t-(s-1) once the fill
+            # phase is over
+            slot = jnp.clip(t - (s - 1), 0, n_micro - 1)
+            prev = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(t >= s - 1, out[s - 1], prev), slot, axis=0)
+            # rotate activations one hop around the stage ring
+            buffer = lax.with_sharding_constraint(
+                jnp.roll(out, 1, axis=0), stage_spec)
+            return (buffer, outs), None
+
+        (_, outs), _ = lax.scan(tick, (buffer, outs),
+                                jnp.arange(n_micro + s - 1))
+        return outs
+
+    return jax.jit(run)
 
 
 def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
@@ -44,7 +99,7 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
     batch must divide n_micro. Output matches running the stages
     sequentially (tested), with stage weights resident on separate devices.
     """
-    s = mesh.shape[axis_name]
+    s = int(mesh.shape[axis_name])
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
@@ -55,51 +110,9 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
             f"{axis_name!r} mesh axis size {s} (one stage per device)")
     mb = b // n_micro
     micro = x.reshape(n_micro, mb, *x.shape[1:])
-
-    def local(params, micro):
-        # this device's stage params: shard_map leaves the (length-1) sharded
-        # leading axis in place — strip it
-        params = jax.tree_util.tree_map(lambda v: v[0], params)
-        stage = lax.axis_index(axis_name)
-        n_ticks = n_micro + s - 1
-        # state held between ticks: the activation each device will process
-        carry = jnp.zeros((mb,) + micro.shape[2:], micro.dtype)
-        outs = jnp.zeros((n_micro, mb) + micro.shape[2:], micro.dtype)
-        perm = [(j, (j + 1) % s) for j in range(s)]
-
-        def tick(t, state):
-            carry, outs = state
-            # stage 0 ingests microbatch t (when in range); others use the
-            # activation that arrived from the previous stage
-            feed = lax.dynamic_index_in_dim(
-                micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
-            inp = jnp.where(stage == 0, feed, carry)
-            out = stage_fn(params, inp)
-            # last stage banks its result at slot t-(s-1)
-            slot = jnp.clip(t - (s - 1), 0, n_micro - 1)
-            bank = (stage == s - 1) & (t >= s - 1)
-            outs = lax.dynamic_update_index_in_dim(
-                outs,
-                jnp.where(bank, out,
-                          lax.dynamic_index_in_dim(outs, slot, keepdims=False)),
-                slot, axis=0)
-            # rotate activations one hop around the ring
-            carry = lax.ppermute(out, axis_name, perm)
-            return carry, outs
-
-        _, outs = lax.fori_loop(0, n_ticks, tick, (carry, outs))
-        # results live on the last stage; share them (replicated output)
-        outs = lax.psum(jnp.where(stage == s - 1, outs, jnp.zeros_like(outs)),
-                        axis_name)
-        return outs
-
-    out = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
-        check_vma=False,
-    )(stacked_params, micro)
-    return out.reshape(b, *x.shape[1:])
+    outs = _pipeline_program(stage_fn, mesh, axis_name, s,
+                             int(n_micro))(stacked_params, micro)
+    return outs.reshape(b, *x.shape[1:])
 
 
 def sequential_reference(stage_fn: Callable, params_list: Sequence, x):

@@ -1,57 +1,92 @@
-"""Ring attention: sequence/context parallelism over the device mesh.
+"""Ring attention: sequence/context parallelism, expressed as GSPMD.
 
 The reference has NO long-context story — SURVEY.md §5.7: no ring attention,
 no sequence/context parallelism anywhere; long sequences are handled by
 truncated BPTT only. This module is the TPU-native extension the brief makes
-first-class: shard the sequence axis across a mesh axis and rotate K/V blocks
-around the ring with ``ppermute`` while each device accumulates its queries'
-online-softmax state (Liu et al., Ring Attention with Blockwise Transformers —
-PAPERS.md). Collectives ride ICI; each hop overlaps with the local block's
-compute under XLA's async collective scheduling.
+first-class: shard the sequence axis over a mesh axis and rotate K/V blocks
+around the ring while each block of queries accumulates its online-softmax
+state (Liu et al., Ring Attention with Blockwise Transformers — PAPERS.md).
+
+GSPMD formulation (no per-device mapped functions — ROADMAP item 1): the sequence axis is
+reshaped to an explicit block axis ``[n, B, H, S/n, D]`` annotated with
+``PartitionSpec(axis_name)``; each hop updates ALL query blocks against the
+current K/V blocks (a ``vmap`` over the block axis — per-device that is its
+own resident blocks) and then rotates K/V one block with ``jnp.roll`` on the
+sharded axis, which the partitioner lowers to the ring's collective-permute.
+Each device's live working set is its own q/k/v blocks plus one in-flight
+block — the S×S score matrix never materializes on any one device — and the
+hop's collective overlaps the local block's compute under XLA's async
+collective scheduling. Numerically this is the same online-softmax update
+order as the classic per-device formulation (exact vs
+``dot_product_attention`` up to fp association, and differentiable — AD
+reverses the rolls).
 
 Layout: [batch, heads, seq, head_dim], sharded P(None, None, axis, None).
 """
 
 from __future__ import annotations
 
-from functools import partial
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.ops.attention import _NEG_BIG, online_softmax_update
 
 
-def _ring_body(q, k, v, src_block, n_local, scale, causal, axis_name, m, l, acc):
-    """One online-softmax update of the local queries against one K/V block."""
-    q_pos = k_pos = None
-    if causal:
-        my = lax.axis_index(axis_name)
-        q_pos = my * n_local + jnp.arange(n_local)
-        k_pos = src_block * n_local + jnp.arange(n_local)
-    return online_softmax_update(q, k, v, m, l, acc, scale, q_pos=q_pos, k_pos=k_pos)
+@functools.lru_cache(maxsize=64)
+def _ring_program(mesh: Mesh, axis_name: str, n: int, scale: float,
+                  causal: bool):
+    """One jitted SPMD ring-attention program per (mesh, axis, blocks,
+    scale, causal) — shapes key jit's own cache."""
+    block_spec = NamedSharding(mesh, P(axis_name))
 
+    def constrain(t):
+        return jax.lax.with_sharding_constraint(t, block_spec)
 
-def _ring_attention_local(q, k, v, *, axis_name, axis_size, scale, causal):
-    """Per-device body under shard_map: local q stays put, k/v ring-rotate."""
-    b, h, sl, d = q.shape
-    m = jnp.full((b, h, sl), _NEG_BIG, jnp.float32)
-    l = jnp.zeros((b, h, sl), jnp.float32)
-    acc = jnp.zeros((b, h, sl, d), jnp.float32)
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-    my = lax.axis_index(axis_name)
-    for i in range(axis_size):
-        # after i hops this device holds the block that started at (my - i)
-        src = (my - i) % axis_size
-        m, l, acc = _ring_body(q, k, v, src, sl, scale, causal, axis_name, m, l, acc)
-        if i + 1 < axis_size:
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / safe_l[..., None]).astype(q.dtype)
+    # causal / non-causal vmapped block updates (q_pos/k_pos are per-block
+    # 1-D vectors; None cannot ride a vmapped axis, hence two variants)
+    upd_causal = jax.vmap(online_softmax_update,
+                          in_axes=(0, 0, 0, 0, 0, 0, None, 0, 0))
+    upd_plain = jax.vmap(
+        lambda q, k, v, m, l, a, s: online_softmax_update(q, k, v, m, l, a, s),
+        in_axes=(0, 0, 0, 0, 0, 0, None))
+
+    def run(q, k, v):
+        b, h, s, d = q.shape
+        blk = s // n
+
+        def to_blocks(t):
+            # [B,H,S,D] -> [n,B,H,blk,D], block axis sharded over the ring
+            t = t.reshape(b, h, n, blk, d).transpose(2, 0, 1, 3, 4)
+            return constrain(t)
+
+        qb, kb, vb = to_blocks(q), to_blocks(k), to_blocks(v)
+        m = jnp.full((n, b, h, blk), _NEG_BIG, jnp.float32)
+        l = jnp.zeros((n, b, h, blk), jnp.float32)
+        acc = jnp.zeros((n, b, h, blk, d), jnp.float32)
+        blocks = jnp.arange(n)
+        offs = jnp.arange(blk)
+        q_pos = blocks[:, None] * blk + offs[None, :]  # (n, blk)
+        for i in range(n):
+            # after i hops block j holds the K/V that started at (j - i)
+            if causal:
+                src = (blocks - i) % n
+                k_pos = src[:, None] * blk + offs[None, :]
+                m, l, acc = upd_causal(qb, kb, vb, m, l, acc, scale,
+                                       q_pos, k_pos)
+            else:
+                m, l, acc = upd_plain(qb, kb, vb, m, l, acc, scale)
+            if i + 1 < n:
+                kb = constrain(jnp.roll(kb, 1, axis=0))
+                vb = constrain(jnp.roll(vb, 1, axis=0))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc / safe_l[..., None]).astype(q.dtype)
+        return out.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
+
+    return jax.jit(run)
 
 
 def ring_attention(
@@ -65,24 +100,20 @@ def ring_attention(
 ):
     """Sequence-parallel attention: [B,H,S,D] with S sharded over ``axis_name``.
 
-    Exact (up to fp) equivalence with ``dot_product_attention``; memory and
-    compute per device are O(S/n · S) with the S×S matrix never materialized
-    on any one device. Differentiable (JAX AD through ppermute reverses the
-    ring). Sequence length must divide the axis size.
+    Exact (up to fp) equivalence with ``dot_product_attention``; per-device
+    memory and compute are O(S/n · S) with the S×S matrix never materialized
+    on any one device. Differentiable (JAX AD reverses the block rotation).
+    Sequence length must divide the ring size.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    axis_size = mesh.shape[axis_name]
-    spec = P(None, None, axis_name, None)
-    fn = partial(
-        _ring_attention_local,
-        axis_name=axis_name,
-        axis_size=axis_size,
-        scale=float(scale),
-        causal=bool(causal),
-    )
-    shmap = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return shmap(q, k, v)
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by "
+            f"{axis_name!r} axis size {n}")
+    return _ring_program(mesh, axis_name, int(n), float(scale),
+                         bool(causal))(q, k, v)
 
 
 def shard_sequence(x, mesh: Mesh, axis_name: str = "seq", dim: int = 2):

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.parallel import gspmd
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 from deeplearning4j_tpu.util import telemetry as tm
 
@@ -39,6 +40,24 @@ class ParallelWrapper:
         pw.fit(iterator, epochs=2)
         # net.params are updated in place (replicated arrays)
 
+    Two execution modes, both ONE ``jit``-compiled GSPMD program per step
+    (docs/DISTRIBUTED.md):
+
+    - default: the model's own step with the batch sharded over 'data' and
+      params replicated; the partitioner inserts the fused gradient
+      all-reduce. With ``zero_optimizer=True`` (default) the optimizer
+      moments are additionally ZeRO-sharded over 'data'
+      (``with_sharding_constraint`` — arXiv:2004.13336): the weight update
+      becomes reduce-scatter → 1/N-sharded update → all-gather, cutting
+      per-chip optimizer memory and update compute ~Nx.
+    - ``deterministic=True``: the batch is decomposed into a fixed number of
+      ``replicas`` lanes (vmapped, lane axis sharded) and cross-lane
+      combines use explicit pairwise-tree adds (parallel/gspmd.py), making
+      the fit BIT-identical across mesh sizes — an 8-device sharded fit
+      reproduces the single-device fit exactly (params, Adam moments, RNG
+      key), proven in tests/test_gspmd_identity.py. TBPTT segments are
+      supported on MultiLayerNetworks.
+
     Telemetry: every step records a ``parallel.step`` dispatch span; every
     ``skew_every`` steps a completion probe watches each replica's loss
     shard become ready, emits one ``parallel.replica_step`` span per replica
@@ -49,12 +68,16 @@ class ParallelWrapper:
     it runs at window cadence, not per step; ``skew_every=0`` disables it.
     On a single-host CPU mesh the compiled all-reduce has already
     synchronized the replicas, so the skew reads ≈0 there — the gauge is
-    meaningful on real multi-chip ICI.
+    meaningful on real multi-chip ICI. ``_build`` additionally publishes
+    the mesh axis sizes, the ZeRO sharded fraction, and the per-device
+    optimizer-state bytes as gauges, and keeps the full per-leaf layout
+    table on ``self.layout``.
     """
 
     def __init__(self, model, workers: Optional[int] = None,
                  mesh: Optional[TrainingMesh] = None, prefetch: int = 2,
-                 skew_every: int = 10):
+                 skew_every: int = 10, zero_optimizer: bool = True,
+                 deterministic: bool = False, replicas: Optional[int] = None):
         self.model = model
         if mesh is None:
             devices = jax.devices()[: workers or len(jax.devices())]
@@ -62,24 +85,211 @@ class ParallelWrapper:
         self.mesh = mesh
         self.prefetch = prefetch
         self.skew_every = skew_every
+        self.zero_optimizer = zero_optimizer
+        self.deterministic = deterministic
+        if deterministic and (mesh.model != 1 or mesh.seq != 1):
+            raise ValueError(
+                "deterministic lane mode is a data-parallel contract; use a "
+                "data-only mesh (model=seq=1)")
+        # lane count: fixed at construction so a fit is reproducible across
+        # device counts (pass the same replicas on every topology)
+        self.replicas = int(replicas if replicas is not None else mesh.data)
         self._sharded_step = None
+        self._tbptt_step = None
+        self._zero_specs = None
+        self._param_specs = self._state_specs = self._opt_specs = None
+        self.layout: dict = {}
 
     def _build(self):
-        if self.model._train_step is None:
+        model = self.model
+        if model._train_step is None and not self.deterministic:
             raise ValueError("model must be init()ed first")
+        if not model.params:
+            raise ValueError("model must be init()ed first")
+        if self.zero_optimizer and self.mesh.n_devices > 1:
+            self._zero_specs = gspmd.zero_shardings(
+                self.mesh.mesh, model.opt_states)
+        # replicate current model state across the mesh (TP-sharded leaves
+        # placed on this mesh keep their sharding); ZeRO places the
+        # optimizer state sharded over 'data'
+        model.params = self.mesh.replicate(model.params)
+        model.states = self.mesh.replicate(model.states)
+        if self._zero_specs is not None:
+            model.opt_states = gspmd.place_tree(
+                model.opt_states, self._zero_specs)
+        else:
+            model.opt_states = self.mesh.replicate(model.opt_states)
+        # pin each step's OUTPUT layouts to the placement just made:
+        # without this the partitioner propagates the ZeRO-sharded moments
+        # into the updated params, the next step's inputs arrive with a
+        # different (partially sharded) layout, and the program silently
+        # re-partitions — layout must be a fixed point across steps
+        if self.mesh.n_devices > 1:
+            from jax.sharding import NamedSharding
+
+            def spec_of(leaf):
+                s = getattr(leaf, "sharding", None)
+                return s if isinstance(s, NamedSharding) \
+                    else self.mesh.replicated()
+
+            self._param_specs = jax.tree_util.tree_map(
+                spec_of, model.params)
+            self._state_specs = jax.tree_util.tree_map(
+                spec_of, model.states)
+            self._opt_specs = (self._zero_specs
+                               if self._zero_specs is not None
+                               else jax.tree_util.tree_map(
+                                   spec_of, model.opt_states))
+        else:
+            self._param_specs = self._state_specs = self._opt_specs = None
+        self._sharded_step = (self._build_lane_step() if self.deterministic
+                              else self._build_fast_step())
+        self._publish_layout()
+
+    def _build_fast_step(self):
         # The model's own step function (weighted variant for exact ragged-
         # batch masking), jitted over sharded operands: params replicated,
         # batch split over 'data'. jit infers the SPMD partition from operand
         # shardings (set by device_put in fit); the gradient all-reduce is
         # emitted by the partitioner, not written here.
-        self._sharded_step = jax.jit(
-            self.model.make_step_fn(weighted=True), donate_argnums=(0, 1, 2)
-        )
-        # replicate current model state across the mesh (TP-sharded leaves
-        # placed on this mesh keep their sharding)
-        self.model.params = self.mesh.replicate(self.model.params)
-        self.model.states = self.mesh.replicate(self.model.states)
-        self.model.opt_states = self.mesh.replicate(self.model.opt_states)
+        base = self.model.make_step_fn(weighted=True)
+        zspecs = self._zero_specs
+        if self._param_specs is None:
+            return jax.jit(base, donate_argnums=(0, 1, 2))
+        pspecs, sspecs, ospecs = (self._param_specs, self._state_specs,
+                                  self._opt_specs)
+
+        def step(params, states, opts, iteration, x, y, key, w):
+            # assert the ZeRO layout on entry and every layout on exit: the
+            # partitioner then emits reduce-scatter(grads) -> sharded
+            # update -> all-gather(params) instead of N redundant full
+            # updates, and the step's output layout equals its input
+            # layout (donation-exact, stable across steps)
+            if zspecs is not None:
+                opts = gspmd.constrain_tree(opts, zspecs)
+            p, s, o, loss = base(params, states, opts, iteration, x, y,
+                                 key, w)
+            return (gspmd.constrain_tree(p, pspecs),
+                    gspmd.constrain_tree(s, sspecs),
+                    gspmd.constrain_tree(o, ospecs), loss)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # Determinism note (pinned by tests/test_gspmd_identity.py): the lane
+    # step is THREE jit programs, not one. LLVM's FMA contraction fuses a
+    # multiply into a following add WITHIN one compiled kernel (and
+    # ``optimization_barrier`` does not reach that level), so a lane-weight
+    # multiply living in the same kernel as the cross-lane add tree rounds
+    # differently on 1 device (fused mul+add) than on 8 (the adds cross
+    # device boundaries and cannot contract). Splitting at jit boundaries
+    # forces materialization: stage A ends in multiplies (no consumer
+    # adds), stage B is slices+adds with post-multiplies only (no
+    # contractible mul→add), stage C is the elementwise updater — each
+    # stage is topology-invariant, so the composition is bit-identical on
+    # every mesh size.
+    def _lane_combine_fns(self):
+        sspecs = self._state_specs
+
+        def combine(loss_s, s_l, states_l, scaled_g):
+            total = gspmd.pairwise_sum(s_l)
+            inv = 1.0 / jnp.where(total == 0.0, 1.0, total)
+            grads = jax.tree_util.tree_map(
+                lambda t: gspmd.pairwise_sum(t) * inv.astype(t.dtype),
+                scaled_g)
+            loss = gspmd.pairwise_sum(loss_s) * inv
+            new_states = gspmd.combine_states(states_l)
+            if sspecs is not None:
+                new_states = gspmd.constrain_tree(new_states, sspecs)
+            return loss, grads, new_states
+
+        model = self.model
+        zspecs = self._zero_specs
+        pspecs = self._param_specs
+
+        def update(params, opts, grads, iteration):
+            if zspecs is not None:
+                opts = gspmd.constrain_tree(opts, zspecs)
+            new_params, new_opts = gspmd.apply_updaters(
+                model, params, grads, opts, iteration)
+            # pin the output layout to the input layout (see _build): the
+            # updated params must come back replicated even though the
+            # ZeRO-sharded moments fed the update
+            if pspecs is not None:
+                new_params = gspmd.constrain_tree(new_params, pspecs)
+            if zspecs is not None:
+                new_opts = gspmd.constrain_tree(new_opts, zspecs)
+            return new_params, new_opts
+
+        return jax.jit(combine), jax.jit(update, donate_argnums=(0, 1))
+
+    @staticmethod
+    def _lane_scale(loss_l, s_l, grads_l):
+        """Lane-side weighting — multiplies whose only consumers are jit
+        outputs (the cross-lane adds live in the next jit)."""
+        scale = jax.tree_util.tree_map(
+            lambda t: t * s_l.reshape(
+                s_l.shape + (1,) * (t.ndim - 1)).astype(t.dtype), grads_l)
+        return loss_l * s_l, scale
+
+    def _build_lane_step(self):
+        model = self.model
+        lane_vg = gspmd.make_lane_value_and_grad(model)
+
+        def lanes(params, states, x, y, keys, w):
+            # the SAME vmapped program on every topology: on one device it
+            # executes unpartitioned, on N the lane axis is sharded — the
+            # per-lane values are identical either way (pinned exceptions:
+            # conv filter grads and >=1024-wide gemm contractions, whose
+            # XLA:CPU lowering is fold-dependent; docs/DISTRIBUTED.md)
+            (loss_l, s_l), (states_l, grads_l) = jax.vmap(
+                lane_vg, in_axes=(None, None, 0, 0, 0, 0, None, None)
+            )(params, states, x, y, keys, w, None, None)
+            loss_s, scaled = self._lane_scale(loss_l, s_l, grads_l)
+            return loss_s, s_l, states_l, scaled
+
+        j_lanes = jax.jit(lanes)
+        j_combine, j_update = self._lane_combine_fns()
+
+        def step(params, states, opts, iteration, x, y, keys, w):
+            loss_s, s_l, states_l, scaled = j_lanes(params, states, x, y,
+                                                    keys, w)
+            loss, grads, new_states = j_combine(loss_s, s_l, states_l,
+                                                scaled)
+            new_params, new_opts = j_update(params, opts, grads, iteration)
+            return new_params, new_states, new_opts, loss
+
+        return step
+
+    def _build_tbptt_step(self):
+        model = self.model
+        lane_vg = gspmd.make_lane_tbptt_value_and_grad(model)
+
+        def lanes(params, states, carries, x, y, keys, w, fm, lm):
+            (loss_l, s_l), (states_l, carries_l, grads_l) = jax.vmap(
+                lane_vg, in_axes=(None, None, 0, 0, 0, 0, 0, 0, 0)
+            )(params, states, carries, x, y, keys, w, fm, lm)
+            loss_s, scaled = self._lane_scale(loss_l, s_l, grads_l)
+            return loss_s, s_l, states_l, carries_l, scaled
+
+        j_lanes = jax.jit(lanes)
+        j_combine, j_update = self._lane_combine_fns()
+
+        def step(params, states, opts, carries, iteration, x, y, keys, w,
+                 fm, lm):
+            loss_s, s_l, states_l, carries_l, scaled = j_lanes(
+                params, states, carries, x, y, keys, w, fm, lm)
+            loss, grads, new_states = j_combine(loss_s, s_l, states_l,
+                                                scaled)
+            new_params, new_opts = j_update(params, opts, grads, iteration)
+            return new_params, new_states, new_opts, carries_l, loss
+
+        return step
+
+    def _lane_keys(self, sub):
+        keys = jax.random.split(sub, self.replicas)
+        if self.mesh.n_devices > 1:
+            keys = jax.device_put(keys, self.mesh.spec("data"))
+        return keys
 
     def step_batch(self, ds):
         """Run ONE sharded train step on a DataSet (listeners included) —
@@ -90,15 +300,22 @@ class ParallelWrapper:
         if self._sharded_step is None:
             self._build()
         model = self.model
+        if (self.deterministic
+                and getattr(model.conf, "tbptt_length", None)
+                and not isinstance(model._updaters, dict)
+                and np.ndim(ds.features) == 3 and np.ndim(ds.labels) == 3
+                and np.shape(ds.features)[1] > model.conf.tbptt_length):
+            return self._step_batch_tbptt(ds)
         x, y, w = self._shard(ds.features, ds.labels)
         model._rng_key, sub = jax.random.split(model._rng_key)
+        key_arg = self._lane_keys(sub) if self.deterministic else sub
         t0 = _time.time_ns()
         with tm.span("parallel.step", iteration=model.iteration,
                      replicas=self.mesh.data):
             model.params, model.states, model.opt_states, loss = (
                 self._sharded_step(
                     model.params, model.states, model.opt_states,
-                    jnp.asarray(model.iteration), x, y, sub, w,
+                    jnp.asarray(model.iteration), x, y, key_arg, w,
                 )
             )
         model.score_value = loss
@@ -110,6 +327,48 @@ class ParallelWrapper:
         for lst in model.listeners:
             lst.iteration_done(model, model.iteration, model.epoch)
         return loss
+
+    def _step_batch_tbptt(self, ds):
+        """Deterministic sharded TBPTT (MultiLayerNetwork): the segment
+        loop of ``doTruncatedBPTT`` with every segment one lane-decomposed
+        SPMD step — carries stay lane-stacked across segments, gradients
+        truncate at segment boundaries, one update per segment."""
+        model = self.model
+        k = model.conf.tbptt_length
+        R = self.replicas
+        fm = getattr(ds, "features_mask", None)
+        lm = getattr(ds, "labels_mask", None)
+        x, y, w, (fm, lm) = self.mesh.pad_lane_batch(
+            ds.features, ds.labels, R, extras=(fm, lm))
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        b = x.shape[1]
+        dtype = model._cast(x).dtype
+        carries = jax.tree_util.tree_map(
+            lambda c: jnp.broadcast_to(c[None], (R,) + c.shape),
+            model._init_carries(b, dtype))
+        T = x.shape[2]
+        losses = []
+        for s in range(0, T, k):
+            xs = x[:, :, s:s + k]
+            ys = y[:, :, s:s + k] if y.ndim == 4 else y
+            ms = None if fm is None else fm[:, :, s:s + k]
+            lms = None if lm is None else lm[:, :, s:s + k]
+            model._rng_key, sub = jax.random.split(model._rng_key)
+            keys = self._lane_keys(sub)
+            with tm.span("parallel.tbptt_step", iteration=model.iteration,
+                         segment_start=s):
+                (model.params, model.states, model.opt_states, carries,
+                 loss) = self._tbptt_step(
+                    model.params, model.states, model.opt_states, carries,
+                    jnp.asarray(model.iteration), xs, ys, keys, w, ms, lms)
+            model.iteration += 1
+            losses.append(loss)
+        model.score_value = float(jnp.mean(jnp.stack(losses)))
+        tm.counter("train.steps_total", model="parallel")
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration, model.epoch)
+        return model.score_value
 
     def end_epoch(self):
         """Advance the epoch counter + epoch-end callbacks (the tail of one
@@ -132,7 +391,147 @@ class ParallelWrapper:
         return self.model
 
     def _shard(self, x, y):
+        if self.deterministic:
+            return self.mesh.pad_lane_batch(x, y, self.replicas)
         return self.mesh.pad_shard_batch(x, y)
+
+    # ------------------------------------------------------- layout plumbing
+    def _publish_layout(self):
+        """Telemetry gauges + the per-leaf layout table (satellite:
+        telemetry reports per-device layouts; docs/OBSERVABILITY.md)."""
+        mesh = self.mesh
+        for axis, size in (("data", mesh.data), ("model", mesh.model),
+                           ("seq", mesh.seq)):
+            tm.gauge("parallel.mesh_axis_size", size, axis=axis)
+        frac = (gspmd.sharded_fraction(self._zero_specs)
+                if self._zero_specs is not None else 0.0)
+        tm.gauge("parallel.zero_state_sharded_fraction", frac)
+        tm.gauge("parallel.opt_state_bytes_per_device",
+                 self.opt_state_bytes_per_device())
+        self.layout = {
+            "signature": mesh.layout_signature(
+                extra=(self.zero_optimizer, self.deterministic,
+                       self.replicas)),
+            "params": gspmd.describe_shardings(self.model.params),
+            "opt_states": gspmd.describe_shardings(self.model.opt_states),
+        }
+
+    def opt_state_bytes_per_device(self) -> int:
+        """Bytes of optimizer state ONE device holds — the ZeRO memory
+        number (~1/N of the replicated total when sharded; bench.py
+        ``zero_optimizer_memory_bytes_per_device``)."""
+        return gspmd.tree_bytes_per_device(self.model.opt_states)
+
+    def reshard(self, mesh: Optional[TrainingMesh] = None):
+        """Re-place model state and re-build the compiled step on a NEW
+        mesh — the elastic regroup hook (parallel/elastic.py): after worker
+        loss the survivors form a shrunken mesh and the same program
+        recompiles onto it (the sharding layout is part of the compile
+        key). Deterministic mode keeps its lane count across the re-shard,
+        so the fit trajectory is preserved up to lane-fold fp association
+        (docs/DISTRIBUTED.md)."""
+        model = self.model
+        # pull state off the old placement (host round trip — regroup-rare)
+        model.params = jax.tree_util.tree_map(np.asarray, model.params)
+        model.states = jax.tree_util.tree_map(np.asarray, model.states)
+        model.opt_states = jax.tree_util.tree_map(np.asarray,
+                                                  model.opt_states)
+        if mesh is None:
+            # re-derive from the CURRENT device view (after worker loss the
+            # survivors), keeping the model/seq factors when they still fit
+            devices = jax.devices()
+            model_ax, seq_ax = self.mesh.model, self.mesh.seq
+            if len(devices) % (model_ax * seq_ax):
+                model_ax = seq_ax = 1
+            mesh = TrainingMesh(
+                data=len(devices) // (model_ax * seq_ax),
+                model=model_ax, seq=seq_ax, devices=devices)
+        if self.deterministic and (mesh.model != 1 or mesh.seq != 1):
+            raise ValueError("deterministic lane mode needs a data-only mesh")
+        self.mesh = mesh
+        self._sharded_step = None
+        self._tbptt_step = None
+        self._zero_specs = None
+        self._build()
+        tm.counter("parallel.reshards_total")
+        return self
+
+    # --------------------------------------------------------- cost report
+    def cost_report(self, batch_size=None, *, shape=None, dtype=jnp.float32,
+                    name: str = "parallel", publish: bool = True):
+        """Per-layer cost table for ONE GSPMD-sharded train step.
+        ``cost_analysis()`` totals of a partitioned executable are
+        PER-DEVICE — the report carries ``devices`` and exposes both
+        per-device and global FLOPs/bytes (``totals_global``), keeping the
+        reconciliation semantics honest under sharding
+        (docs/OBSERVABILITY.md#cost-attribution--mfu)."""
+        from deeplearning4j_tpu.util import cost_model as _cm
+
+        model = self.model
+        if self.deterministic:
+            raise NotImplementedError(
+                "cost_report targets the default GSPMD step; build a "
+                "non-deterministic wrapper for cost analysis")
+        if self._sharded_step is None:
+            self._build()
+        conf = model.conf
+        if shape is None:
+            if getattr(conf, "input_shape", None) is None:
+                raise ValueError("cost_report() needs shape= or "
+                                 "conf.input_shape")
+            shape = ((int(batch_size or 8 * self.mesh.data),)
+                     + tuple(conf.input_shape))
+        shape = tuple(int(d) for d in shape)
+        b = shape[0]
+        if b % self.mesh.data:
+            raise ValueError(f"global batch {b} must divide the data axis "
+                             f"({self.mesh.data})")
+
+        def struct(t):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
+                t)
+
+        p_s, s_s, o_s = (struct(model.params), struct(model.states),
+                         struct(model.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        key_s = struct(model._rng_key)
+        bsh = self.mesh.batch_sharding(len(shape))
+        x_s = jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+        y_s = jax.ShapeDtypeStruct((b,) + tuple(model._output_shape),
+                                   jnp.float32,
+                                   sharding=self.mesh.batch_sharding(
+                                       1 + len(model._output_shape)))
+        w_s = jax.ShapeDtypeStruct((b,), jnp.float32,
+                                   sharding=self.mesh.batch_sharding(1))
+        compiled = self._sharded_step.lower(
+            p_s, s_s, o_s, it_s, x_s, y_s, key_s, w_s).compile()
+        params_by_tag = {}
+        if hasattr(model, "_layer_tags"):
+            params_by_tag = {
+                t: int(sum(int(np.prod(l.shape))
+                           for l in jax.tree_util.tree_leaves(p)))
+                for t, p in zip(model._layer_tags, model.params)}
+        totals, attrib, source = {}, None, "analytic"
+        try:
+            totals = _cm.compiled_totals(compiled)
+            attrib = _cm.attribute_hlo(_cm.compiled_text(compiled))
+            source = "xla"
+        except _cm.CostAnalysisUnavailable:
+            pass
+        if attrib is not None:
+            rows = _cm.rows_from_attribution(attrib, params_by_tag, None)
+        else:
+            rows = []
+        report = _cm.CostReport(
+            rows=rows, totals=totals, batch=b,
+            params_total=model.num_params(), source=source, model=str(name),
+            peak_flops=_cm.peak_flops_from_env(),
+            devices=self.mesh.n_devices)
+        if publish:
+            _cm.publish_report(str(name), report)
+        return report
 
     def _probe_replica_skew(self, loss, dispatch_t0_ns: int):
         """Record when each replica's loss shard became ready: one
@@ -207,13 +606,19 @@ class ParallelWrapper:
             x = np_.zeros((int(b),) + in_shape, np_.float32)
             y = np_.zeros((int(b),) + out_shape, np_.float32)
             xs, ys, w = self._shard(x, y)
-            # shadow state, same shardings as the real one (replicated)
+            # shadow state, same shardings as the real one (params/states
+            # replicated, optimizer state ZeRO-sharded when enabled — the
+            # warm executable must match the fit-time layout, which is part
+            # of jit's dispatch key and the persistent compile-cache key)
             p = self.mesh.replicate(zeros(model.params), keep_existing=False)
             s = self.mesh.replicate(zeros(model.states), keep_existing=False)
-            o = self.mesh.replicate(zeros(model.opt_states),
-                                    keep_existing=False)
-            self._sharded_step(p, s, o, jnp.asarray(0),
-                               xs, ys, jax.random.PRNGKey(0), w)
+            o = zeros(model.opt_states)
+            o = (gspmd.place_tree(o, self._zero_specs)
+                 if self._zero_specs is not None
+                 else self.mesh.replicate(o, keep_existing=False))
+            key = (self._lane_keys(jax.random.PRNGKey(0))
+                   if self.deterministic else jax.random.PRNGKey(0))
+            self._sharded_step(p, s, o, jnp.asarray(0), xs, ys, key, w)
             primed += 1
         return primed
 

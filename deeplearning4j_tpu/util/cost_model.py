@@ -711,7 +711,14 @@ def peak_flops_from_env() -> Optional[float]:
 
 @dataclasses.dataclass
 class CostReport:
-    """Per-layer cost table + whole-step totals + utilization."""
+    """Per-layer cost table + whole-step totals + utilization.
+
+    ``devices``: how many devices the analyzed executable spans. XLA's
+    ``cost_analysis()`` on a GSPMD-partitioned module reports PER-DEVICE
+    totals — ``totals`` (and ``flops_per_step``) keep that per-device
+    meaning so the profiled-time reconciliation stays exact, while
+    ``totals_global``/``flops_per_step_global`` scale by ``devices`` for
+    the whole-program numbers."""
 
     rows: List[CostRow]
     totals: Dict[str, float]
@@ -722,11 +729,21 @@ class CostReport:
     step_time_s: Optional[float] = None   # measured wall per step
     device_time_s: Optional[float] = None  # attributed device time per step
     peak_flops: Optional[float] = None
+    devices: int = 1
 
     @property
     def flops_per_step(self) -> float:
         return float(self.totals.get("flops", 0.0)) or sum(
             r.flops for r in self.rows)
+
+    @property
+    def flops_per_step_global(self) -> float:
+        return self.flops_per_step * max(1, self.devices)
+
+    @property
+    def totals_global(self) -> Dict[str, float]:
+        n = max(1, self.devices)
+        return {k: v * n for k, v in self.totals.items()}
 
     @property
     def examples_per_sec(self) -> Optional[float]:
@@ -756,7 +773,10 @@ class CostReport:
             "params_total": self.params_total,
             "source": self.source,
             "totals": dict(self.totals),
+            "devices": self.devices,
+            "totals_global": self.totals_global,
             "flops_per_step": self.flops_per_step,
+            "flops_per_step_global": self.flops_per_step_global,
             "step_time_s": self.step_time_s,
             "device_time_s": self.device_time_s,
             "examples_per_sec": self.examples_per_sec,
@@ -797,6 +817,11 @@ class CostReport:
         lines.append(
             f"TOTAL: {fmt(self.flops_per_step)}FLOP/step over B={self.batch}"
             f" ({fmt(float(self.params_total))} params, source={self.source})")
+        if self.devices > 1:
+            lines.append(
+                f"  sharded over {self.devices} devices: totals above are "
+                f"PER-DEVICE; global {fmt(self.flops_per_step_global)}"
+                "FLOP/step")
         if self.step_time_s:
             lines.append(
                 f"  step {self.step_time_s * 1e3:.2f} ms wall -> "

@@ -1,0 +1,339 @@
+"""GSPMD sharded-fit bit-identity + ZeRO memory + sharded cost report.
+
+The deterministic lane mode (parallel/gspmd.py) makes an 8-virtual-device
+sharded fit BIT-identical to the single-device fit — params, Adam moments,
+and the RNG key — because both topologies execute the SAME vmapped lane
+program, cross-lane combines are explicit pairwise-tree adds GSPMD cannot
+re-associate, and the step is staged as three jit programs so LLVM FMA
+contraction can never fuse a lane-weight multiply into the tree adds (the
+determinism note in parallel/wrapper.py).
+
+Known backend boundary, pinned below: XLA:CPU lowers the vmapped conv
+FILTER gradient to a batch-grouped convolution whose accumulation grouping
+depends on the lane fold (and gemm k-blocking is shape-dependent for
+contraction dims >= ~1024) — conv topologies reproduce to ~1e-5 instead of
+bit-exactly (docs/DISTRIBUTED.md).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMesh, gspmd
+
+
+def _mesh8():
+    return TrainingMesh(data=8)
+
+
+def _mesh1():
+    return TrainingMesh(data=1, devices=jax.devices()[:1])
+
+
+def _leaves(tree):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_tree_equal(a, b, what):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for i, (u, v) in enumerate(zip(la, lb)):
+        assert u.shape == v.shape, (what, i)
+        assert (u == v).all(), (
+            f"{what} leaf {i} differs: maxdiff "
+            f"{np.abs(u.astype(np.float64) - v.astype(np.float64)).max()}")
+
+
+def _fit_pair(make_net, data_iter_fn, epochs=2, replicas=8):
+    """Fit the same net on a 1-device and an 8-device deterministic wrapper
+    (same lane count) and return both nets."""
+    nets = []
+    for mesh in (_mesh1(), _mesh8()):
+        net = make_net()
+        pw = ParallelWrapper(net, mesh=mesh, deterministic=True,
+                             replicas=replicas, skew_every=0)
+        pw.fit(data_iter_fn(), epochs=epochs)
+        nets.append(net)
+    return nets
+
+
+def _dense_mln():
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=32, activation="relu"))
+            .layer(DenseLayer(n_in=32, n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_in=32, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.multichip
+class TestBitIdentityMLN:
+    def test_dense_fit_bit_identical(self, rng):
+        xs = rng.standard_normal((64, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+        n1, n8 = _fit_pair(
+            _dense_mln, lambda: ArrayDataSetIterator(xs, ys, batch=32))
+        _assert_tree_equal(n1.params, n8.params, "params")
+        _assert_tree_equal(n1.opt_states, n8.opt_states, "adam moments")
+        _assert_tree_equal(n1.states, n8.states, "states")
+        np.testing.assert_array_equal(np.asarray(n1._rng_key),
+                                      np.asarray(n8._rng_key))
+        assert n1.iteration == n8.iteration
+
+    def test_ragged_bucketed_batch_bit_identical(self, rng):
+        # global batch 20 on 8 lanes: pads to 24 with 0-weighted rows; the
+        # weighted-lane recombination must keep the 1-dev and 8-dev runs
+        # identical AND both finite
+        xs = rng.standard_normal((20, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 20)]
+        n1, n8 = _fit_pair(
+            _dense_mln, lambda: [DataSet(xs, ys)], epochs=3)
+        _assert_tree_equal(n1.params, n8.params, "params(ragged)")
+        _assert_tree_equal(n1.opt_states, n8.opt_states, "moments(ragged)")
+        assert np.isfinite(float(n8.score_value))
+
+    def test_zero_optimizer_composes_with_identity(self, rng):
+        # ZeRO sharding the moments must not change a single bit (Adam is
+        # elementwise) — the 8-dev run here has zero_optimizer on (default)
+        xs = rng.standard_normal((32, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+
+        net1 = _dense_mln()
+        ParallelWrapper(net1, mesh=_mesh1(), deterministic=True, replicas=8,
+                        skew_every=0).fit([DataSet(xs, ys)], epochs=2)
+        net8 = _dense_mln()
+        pw8 = ParallelWrapper(net8, mesh=_mesh8(), deterministic=True,
+                              replicas=8, zero_optimizer=True, skew_every=0)
+        pw8.fit([DataSet(xs, ys)], epochs=2)
+        _assert_tree_equal(net1.opt_states, net8.opt_states, "zero moments")
+        # and the moments really are distributed
+        frac = gspmd.sharded_fraction(pw8._zero_specs)
+        assert frac > 0.0, pw8.layout["opt_states"]
+
+
+def _lstm_mln(tbptt=8):
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+            .tbptt_length(tbptt)
+            .list()
+            .layer(LSTM(n_in=5, n_out=24))
+            .layer(RnnOutputLayer(n_in=24, n_out=3, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(5, 16))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.multichip
+class TestBitIdentityTBPTT:
+    def test_tbptt_segments_bit_identical(self, rng):
+        """16-step sequences with tbptt_length=8: two lane-decomposed
+        segment updates per batch, carries lane-stacked across segments —
+        params, Adam moments and the RNG key must match the single-device
+        run exactly."""
+        xs = rng.standard_normal((16, 16, 5)).astype(np.float32)
+        ids = rng.integers(0, 3, size=(16, 16))
+        ys = np.eye(3, dtype=np.float32)[ids]
+        n1, n8 = _fit_pair(
+            _lstm_mln, lambda: [DataSet(xs, ys)], epochs=2)
+        assert n1.iteration == n8.iteration == 4  # 2 segments x 2 epochs
+        _assert_tree_equal(n1.params, n8.params, "params(tbptt)")
+        _assert_tree_equal(n1.opt_states, n8.opt_states, "moments(tbptt)")
+        np.testing.assert_array_equal(np.asarray(n1._rng_key),
+                                      np.asarray(n8._rng_key))
+
+
+def _dense_cg():
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.nn.vertices import MergeVertex
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .graph_builder()
+            .add_inputs("ina", "inb")
+            .add_layer("da", DenseLayer(n_in=4, n_out=16,
+                                        activation="relu"), "ina")
+            .add_layer("db", DenseLayer(n_in=3, n_out=16,
+                                        activation="relu"), "inb")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out1", OutputLayer(n_in=32, n_out=2, loss="mcxent",
+                                           activation="softmax"), "m")
+            .add_layer("out2", OutputLayer(n_in=32, n_out=3, loss="mcxent",
+                                           activation="softmax"), "m")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(4),
+                             InputType.feed_forward(3))
+            .build())
+    from deeplearning4j_tpu.nn import ComputationGraph as CG
+
+    return CG(conf).init()
+
+
+@pytest.mark.multichip
+class TestBitIdentityCG:
+    def test_multi_io_graph_fit_bit_identical(self, rng):
+        from deeplearning4j_tpu.data import MultiDataSet
+
+        xa = rng.standard_normal((24, 4)).astype(np.float32)
+        xb = rng.standard_normal((24, 3)).astype(np.float32)
+        y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 24)]
+        y2 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 24)]
+        mds = MultiDataSet(features=[xa, xb], labels=[y1, y2])
+        n1, n8 = _fit_pair(_dense_cg, lambda: [mds], epochs=3)
+        _assert_tree_equal(n1.params, n8.params, "cg params")
+        _assert_tree_equal(n1.opt_states, n8.opt_states, "cg moments")
+        np.testing.assert_array_equal(np.asarray(n1._rng_key),
+                                      np.asarray(n8._rng_key))
+
+
+def _conv_mln():
+    """Flagship-topology family: conv + batchnorm + pool + dense head."""
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                              ConvolutionLayer, DenseLayer,
+                                              OutputLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(0.01))
+            .list()
+            .layer(ConvolutionLayer(n_in=3, n_out=8, kernel_size=(3, 3),
+                                    padding="SAME", activation="relu"))
+            .layer(BatchNormalization(n_out=8))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_in=8, n_out=8, kernel_size=(3, 3),
+                                    padding="SAME", activation="relu"))
+            .layer(OutputLayer(n_in=8 * 6 * 6, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.convolutional(12, 12, 3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.multichip
+class TestFlagshipTopology:
+    def test_conv_bn_fit_reproduces(self, rng):
+        """Conv topologies: everything except the conv FILTER gradient is
+        exact; XLA:CPU lowers that one op to a batch-grouped conv whose
+        accumulation grouping depends on the lane fold (pinned boundary —
+        docs/DISTRIBUTED.md). The fit must reproduce to float tolerance
+        and the non-conv state exactly."""
+        xs = rng.standard_normal((32, 12, 12, 3)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+        n1, n8 = _fit_pair(
+            _conv_mln, lambda: [DataSet(xs, ys)], epochs=2)
+        np.testing.assert_array_equal(np.asarray(n1._rng_key),
+                                      np.asarray(n8._rng_key))
+        for a, b in zip(_leaves(n1.params), _leaves(n8.params)):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4)
+        for a, b in zip(_leaves(n1.opt_states), _leaves(n8.opt_states)):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4)
+
+
+@pytest.mark.multichip
+class TestZeroMemory:
+    def test_optimizer_state_bytes_shrink(self, rng):
+        """ZeRO satellite: Adam moment bytes/device drop ~Nx on the 8-way
+        mesh (every weight matrix of this net has an 8-divisible dim)."""
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_in=256, n_out=512, activation="relu"))
+                .layer(DenseLayer(n_in=512, n_out=512, activation="relu"))
+                .layer(OutputLayer(n_in=512, n_out=16, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(256)).build())
+        net = MultiLayerNetwork(conf).init()
+        replicated_bytes = gspmd.tree_bytes(net.opt_states)
+
+        pw = ParallelWrapper(net, mesh=_mesh8(), zero_optimizer=True,
+                             skew_every=0)
+        xs = rng.standard_normal((32, 256)).astype(np.float32)
+        ys = np.eye(16, dtype=np.float32)[rng.integers(0, 16, 32)]
+        pw.fit([DataSet(xs, ys)], epochs=1)
+        per_dev = pw.opt_state_bytes_per_device()
+        # biases and tiny leaves stay replicated; the big moment matrices
+        # shard 8-ways -> well under 1/4 of the replicated footprint
+        assert per_dev < replicated_bytes / 4, (per_dev, replicated_bytes)
+        assert np.isfinite(float(net.score_value))
+
+    def test_zero_off_keeps_state_replicated(self, rng):
+        net = _dense_mln()
+        pw = ParallelWrapper(net, mesh=_mesh8(), zero_optimizer=False,
+                             skew_every=0)
+        xs = rng.standard_normal((16, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        pw.fit([DataSet(xs, ys)], epochs=1)
+        assert pw.opt_state_bytes_per_device() == gspmd.tree_bytes(
+            net.opt_states)
+
+
+@pytest.mark.multichip
+class TestLayoutAndReshard:
+    def test_layout_signature_and_gauges(self, rng):
+        net = _dense_mln()
+        pw = ParallelWrapper(net, mesh=_mesh8(), skew_every=0)
+        xs = rng.standard_normal((16, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        pw.fit([DataSet(xs, ys)], epochs=1)
+        assert "data=8" in pw.layout["signature"]
+        assert pw.layout["opt_states"], pw.layout
+        # layout signatures key executables: a different mesh is a
+        # different signature (and a different jit dispatch entry)
+        assert _mesh8().layout_signature() != _mesh1().layout_signature()
+
+    def test_reshard_onto_smaller_mesh_continues(self, rng):
+        """Elastic regroup hook: mid-fit re-shard 8 -> 4 devices re-places
+        params/ZeRO state and recompiles; training continues and the loss
+        stays finite (values equivalent up to fp association)."""
+        net = _dense_mln()
+        pw = ParallelWrapper(net, mesh=_mesh8(), skew_every=0)
+        xs = rng.standard_normal((32, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+        pw.fit([DataSet(xs, ys)], epochs=2)
+        s_before = float(net.score_value)
+        pw.reshard(TrainingMesh(data=4, devices=jax.devices()[:4]))
+        assert pw.mesh.data == 4
+        pw.fit([DataSet(xs, ys)], epochs=4)
+        assert np.isfinite(float(net.score_value))
+        assert float(net.score_value) < s_before  # still learning
+
+
+@pytest.mark.multichip
+class TestShardedCostReport:
+    def test_per_device_and_global_totals(self, rng):
+        """cost_analysis() of a GSPMD executable is per-device: the sharded
+        report must expose devices + global totals, and the per-device
+        FLOPs must be ~1/8 of the single-device program's (collectives add
+        a little, padding none — band is loose on purpose)."""
+        net = _dense_mln()
+        single = net.cost_report(batch_size=64, publish=False)
+        pw = ParallelWrapper(net, mesh=_mesh8(), skew_every=0)
+        xs = rng.standard_normal((64, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+        pw.fit([DataSet(xs, ys)], epochs=1)
+        rep = pw.cost_report(batch_size=64, publish=False)
+        assert rep.devices == 8
+        assert rep.flops_per_step_global == rep.flops_per_step * 8
+        assert rep.totals_global["flops"] == rep.totals["flops"] * 8
+        if rep.source == "xla" and single.source == "xla":
+            ratio = rep.flops_per_step / (single.flops_per_step / 8)
+            assert 0.7 < ratio < 1.8, (rep.flops_per_step,
+                                       single.flops_per_step)
+        assert "PER-DEVICE" in rep.summary()

@@ -79,6 +79,24 @@ class TestBands:
         assert rg._passed(results, strict=False)
         assert not rg._passed(results, strict=True)
 
+    def test_critical_metric_missing_is_fatal_even_unstrict(self):
+        metric = "dp_sharding_efficiency_8dev_virtual_cpu"
+        assert metric in rg.CRITICAL
+        traj = [("r1", {metric: (0.58, None), "tput": (100.0, None)})]
+        results = rg.gate(traj, {"tput": (100.0, None)})
+        # the scaling-efficiency contract may never silently disappear
+        assert not rg._passed(results, strict=False)
+        ok = rg.gate(traj, {metric: (0.9, None), "tput": (100.0, None)})
+        assert rg._passed(ok, strict=False)
+
+    def test_zero_memory_metric_is_lower_better(self):
+        metric = "zero_optimizer_memory_bytes_per_device"
+        assert metric in rg.LOWER_BETTER
+        traj = [("r1", {metric: (25e6, 0.01)})]
+        assert rg.gate(traj, {metric: (24e6, 0.01)})[0]["status"] == "ok"
+        assert rg.gate(traj, {metric: (60e6, 0.01)})[0]["status"] == \
+            "regressed"
+
     def test_default_noise_applies_to_legacy_records(self):
         traj = [("r1", {"tput": (100.0, None)})]  # pre-noise-field record
         # tol = 0.05 + 0.05 + 0.02 -> bound 88
