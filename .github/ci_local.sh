@@ -85,6 +85,14 @@ JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/test_gspmd_identity.py -q
 
+echo "== step: Serving smoke (model server + continuous batching + drain) =="
+# ISSUE 8: the HTTP model server (dense classifier + causal BERT-tiny
+# KV-cache decoder) under concurrent mixed-model traffic — all 200s, p99
+# under the sanity bound, steady-state serving.recompiles_total delta 0,
+# bit-identical classify responses, 429/404 shed contract, /metrics +
+# /healthz serving surfaces, graceful drain -> 503.
+JAX_PLATFORMS=cpu python benchmarks/serving_smoke.py
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

@@ -62,6 +62,9 @@ LOWER_BETTER = {
     "cost_attribution_overhead",
     "elastic_overhead",
     "zero_optimizer_memory_bytes_per_device",
+    # serving tier (ISSUE 8): request latency gates downward, its QPS
+    # companion (serving_qps) gates upward via the higher-is-better default
+    "serving_p99_latency_ms",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

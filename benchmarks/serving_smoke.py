@@ -1,0 +1,243 @@
+"""Serving smoke check (the ISSUE 8 CI leg, wired in ci.yml/ci_local.sh).
+
+End-to-end proof of the serving-tier acceptance criteria on a real HTTP
+server with two models:
+
+1. boot a :class:`ModelServer` over a router holding a dense classifier
+   (explicit batch buckets) and a causal BERT-tiny KV-cache decoder,
+   warm every bucket executable, then fire CONCURRENT mixed-model
+   requests (interactive classify + batch-lane generate) from worker
+   threads through the real HTTP surface;
+2. assert every response is correct-shaped, the classify responses are
+   BIT-identical to a direct ``net.output`` at the same bucket, p99
+   submit→complete latency sits under a CPU sanity bound, and the
+   steady-state ``serving.recompiles_total`` delta is exactly 0
+   (compile-once serving — docs/SERVING.md);
+3. exercise the load-shed contract deterministically: an already-expired
+   ``deadline_ms`` answers HTTP 429 with Retry-After, an unknown model
+   404;
+4. curl ``/metrics`` (Prometheus text with the serving series) and
+   ``/healthz`` (JSON with the serving section), then drain gracefully
+   and assert a post-drain request answers 503.
+
+Exit 0 on success, 1 with a FAIL line on any violated check.
+
+    JAX_PLATFORMS=cpu python benchmarks/serving_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILED = []
+
+P99_SANITY_MS = 2500.0  # CPU CI bound: catches collapse, not jitter
+
+
+def check(name: str, ok: bool, detail: str = ""):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def http_get(url: str, use_curl: bool):
+    """(status, body) via curl when available (the CI leg's literal
+    requirement), urllib otherwise."""
+    if use_curl and shutil.which("curl"):
+        out = subprocess.run(
+            ["curl", "-sS", "-w", "\n%{http_code}", url],
+            capture_output=True, text=True, timeout=30)
+        body, _, code = out.stdout.rpartition("\n")
+        if not code.strip().isdigit():
+            return 0, f"curl failed: {out.stderr.strip()}"
+        return int(code), body
+    try:
+        r = urllib.request.urlopen(url, timeout=30)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def http_post(url: str, obj: dict):
+    """(status, json body, retry_after) for a JSON POST."""
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, json.loads(r.read()), None
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        return e.code, body, e.headers.get("Retry-After")
+
+
+def build_server():
+    import numpy as np
+
+    from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import (ModelRouter, ModelServer,
+                                            ServingModel)
+    from deeplearning4j_tpu.zoo.bert import Bert
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .batch_buckets((1, 2, 4, 8)).list()
+            .layer(DenseLayer(n_in=12, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_in=32, n_out=5, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    clf_net = MultiLayerNetwork(conf).init()
+    bert = Bert.tiny(causal=True, task="mlm", vocab_size=48, max_length=32,
+                     hidden_dropout=0.0).init()
+    router = ModelRouter(name="smoke")
+    router.register(ServingModel(clf_net, "dense"), max_wait_ms=1.0,
+                    queue_limit=128)
+    router.register(
+        ServingModel(bert, "bert-decode", kind="generate",
+                     bucketing=BucketingPolicy(batch_buckets=(1, 2, 4),
+                                               seq_buckets=(8,))),
+        max_wait_ms=1.0, queue_limit=128)
+    server = ModelServer(router, port=0).start()  # warms every bucket
+    return server, clf_net, np
+
+
+def fire_mixed_traffic(server, np, n_classify=24, n_generate=4,
+                       threads=4):
+    """Concurrent mixed-model HTTP requests; returns per-request latencies
+    and the (status, payload) results."""
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(n_classify, 3, 12)).astype(np.float32)
+    prompts = [list(map(int, rng.integers(1, 48, size=5)))
+               for _ in range(n_generate)]
+    jobs = []
+    for i in range(n_classify):
+        jobs.append(("dense", {"inputs": xs[i].tolist(),
+                               "lane": "interactive"}))
+    for p in prompts:
+        jobs.append(("bert-decode", {"prompt_tokens": [p],
+                                     "max_new_tokens": 4, "lane": "batch"}))
+    results = [None] * len(jobs)
+    lats = [None] * len(jobs)
+    idx_lock = threading.Lock()
+    next_idx = [0]
+
+    def worker():
+        while True:
+            with idx_lock:
+                if next_idx[0] >= len(jobs):
+                    return
+                i = next_idx[0]
+                next_idx[0] += 1
+            model, body = jobs[i]
+            t0 = time.perf_counter()
+            verb = "generate" if model == "bert-decode" else "infer"
+            results[i] = http_post(
+                f"{server.url}/v1/models/{model}/{verb}", body)
+            lats[i] = time.perf_counter() - t0
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    return jobs, results, lats, xs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--no-curl", action="store_true")
+    args = ap.parse_args(argv)
+    use_curl = not args.no_curl
+
+    server, clf_net, np = build_server()
+    from deeplearning4j_tpu.util import telemetry as tm
+
+    print("== serving smoke: warm + steady-state traffic ==")
+    fire_mixed_traffic(server, np, n_classify=8, n_generate=2)  # settle
+    tele = tm.get_telemetry()
+    rec = lambda: sum(  # noqa: E731
+        v for (name, _l), v in tele.counters.items()
+        if name == "serving.recompiles_total")
+    rec_before = rec()
+    jobs, results, lats, xs = fire_mixed_traffic(server, np)
+    ok_all = all(r is not None and r[0] == 200 for r in results)
+    check("all mixed-model requests answered 200", ok_all,
+          f"{sum(1 for r in results if r and r[0] == 200)}/{len(results)}")
+    check("steady-state serving recompiles == 0", rec() - rec_before == 0,
+          f"delta {rec() - rec_before}")
+    lat_ms = sorted(l * 1e3 for l in lats if l is not None)
+    p99 = lat_ms[min(len(lat_ms) - 1, int(round(0.99 * (len(lat_ms) - 1))))]
+    check(f"p99 latency under sanity bound ({P99_SANITY_MS:.0f} ms)",
+          p99 < P99_SANITY_MS, f"p99 {p99:.1f} ms")
+
+    # classify response bit-identical to a direct forward AT THE SAME
+    # BUCKET (3 rows -> bucket 4; docs/SERVING.md bit-identity contract)
+    first = results[0][1]["outputs"]
+    pad = np.concatenate([xs[0], np.zeros((1, 12), np.float32)])
+    direct = np.asarray(clf_net.output(pad))[:3]
+    check("classify response bit-identical to direct forward",
+          np.array_equal(np.asarray(first, np.float32),
+                         direct.astype(np.float32)))
+
+    print("== load-shed contract ==")
+    code, _body, retry = http_post(
+        f"{server.url}/v1/models/dense/infer",
+        {"inputs": xs[0].tolist(), "deadline_ms": -1})
+    check("expired deadline answers 429 + Retry-After",
+          code == 429 and retry is not None, f"code {code}, retry {retry}")
+    code, _body, _ = http_post(f"{server.url}/v1/models/ghost/infer",
+                               {"inputs": [[0.0] * 12]})
+    check("unknown model answers 404", code == 404, f"code {code}")
+
+    print("== observability surfaces ==")
+    code, text = http_get(f"{server.url}/metrics", use_curl)
+    check("/metrics answers 200", code == 200)
+    for series in ("serving_requests_total", "serving_queue_depth",
+                   "serving_recompiles_total",
+                   "serving_request_latency_seconds"):
+        check(f"/metrics carries {series}", series in text)
+    code, text = http_get(f"{server.url}/healthz", use_curl)
+    health = json.loads(text) if text.strip().startswith("{") else {}
+    check("/healthz answers 200", code == 200)
+    models = health.get("serving", {}).get("models", {})
+    check("/healthz serving section lists both models",
+          set(models) == {"dense", "bert-decode"}, str(sorted(models)))
+    check("/healthz reports completed work",
+          all(m.get("completed", 0) > 0 for m in models.values()))
+
+    print("== graceful drain ==")
+    server.request_drain()
+    check("server drains clean", server.wait_drained(timeout=30))
+    code, _body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                               {"inputs": xs[0].tolist()})
+    check("post-drain request answers 503", code == 503, f"code {code}")
+    server.stop()
+
+    if _FAILED:
+        print(f"SERVING SMOKE FAIL: {len(_FAILED)} checks failed: "
+              f"{_FAILED}")
+        return 1
+    print("serving smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,6 +167,51 @@ class BucketingPolicy:
     def bucket_seq(self, t: int) -> int:
         return self._round(int(t), self.seq_buckets)
 
+    def largest_batch_bucket(self) -> Optional[int]:
+        """Largest explicit batch bucket, or None (pow2 / unbucketed)."""
+        if isinstance(self.batch_buckets, tuple):
+            return self.batch_buckets[-1]
+        return None
+
+    def plan_serving_batch(self, n: int, cap: Optional[int] = None):
+        """Split a serving batch of ``n`` rows into chunks that each round
+        up to an EXISTING bucket, so no request size ever traces a new
+        program: sizes between buckets pad up to the next bucket, sizes
+        ABOVE the largest bucket split into largest-bucket chunks with the
+        remainder rounding up to its own bucket (the pad-up-not-retrace
+        contract — docs/SERVING.md). ``cap`` (ParallelInference's
+        batch_limit) bounds the PADDED per-call batch — a device-memory
+        limit must hold after padding, so chunking targets the largest
+        bucket that still fits under it; when NO bucket fits, the memory
+        bound wins and chunks pass through unpadded at ``cap`` (each such
+        size keeps its own compile, loudly visible in the CompileWatcher).
+        Returns a list of ``(real_rows, padded_rows)`` pairs covering
+        ``n`` in order."""
+        n = int(n)
+        top = self.largest_batch_bucket()
+        raw_cap = None  # set when the cap excludes every bucket
+        if cap is not None:
+            cap = int(cap)
+            if isinstance(self.batch_buckets, tuple):
+                fitting = [b for b in self.batch_buckets if b <= cap]
+                top = fitting[-1] if fitting else None
+            elif self.batch_buckets == "pow2":
+                top = 1 << (max(1, cap).bit_length() - 1)  # pow2 <= cap
+            else:
+                top = cap
+            if top is None:
+                raw_cap = cap
+        plan = []
+        while n > 0:
+            if raw_cap is not None:
+                take = min(n, raw_cap)
+                plan.append((take, take))
+            else:
+                take = n if top is None else min(n, top)
+                plan.append((take, self.bucket_batch(take)))
+            n -= take
+        return plan
+
     # --------------------------------------------------------------- padding
     @staticmethod
     def _pad_axis(a: np.ndarray, axis: int, target: int) -> np.ndarray:

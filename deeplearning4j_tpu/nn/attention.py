@@ -37,6 +37,7 @@ class BaseAttentionLayer(Layer):
     project_input: bool = True
     weight_init: str = "xavier"
     flash: Any = "auto"  # True | False | "auto" (measured-crossover dispatch)
+    causal: bool = False  # autoregressive mask (decoder-only stacks)
 
     @property
     def _head_size(self) -> int:
@@ -90,11 +91,13 @@ class SelfAttentionLayer(BaseAttentionLayer):
             y = attn_ops.multi_head_dot_product_attention(
                 x, x, x, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
                 n_heads=self.n_heads, mask=mask, flash=self.flash,
+                causal=self.causal,
             )
         else:
             q = x[:, None]  # single head
             amask = None if mask is None else mask[:, None, None, :]
-            y = attn_ops.dot_product_attention(q, q, q, mask=amask)[:, 0]
+            y = attn_ops.dot_product_attention(
+                q, q, q, mask=amask, causal=self.causal)[:, 0]
         if mask is not None:
             y = y * mask[..., None].astype(y.dtype)
         return y, state

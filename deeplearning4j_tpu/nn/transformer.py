@@ -76,6 +76,17 @@ class BertEmbeddingLayer(Layer):
         h = _layer_norm(h, params["gamma"], params["beta"])
         return self._maybe_dropout(h, training, key), state
 
+    def embed_step(self, params, tokens, positions):
+        """One decode-step embedding: ``tokens`` (B,) int ids at per-row
+        ``positions`` (B,) → (B, H). Same word+pos+type-0 sum and LayerNorm
+        as ``apply`` on a (B, T) batch, so an incrementally-embedded token
+        matches the full-sequence embedding at that position exactly
+        (serving/generate.py KV-cache decode)."""
+        h = (jnp.take(params["word"], tokens.astype(jnp.int32), axis=0)
+             + jnp.take(params["pos"], positions.astype(jnp.int32), axis=0)
+             + params["type"][0])
+        return _layer_norm(h, params["gamma"], params["beta"])
+
     def output_shape(self, input_shape):
         return (input_shape[0], self.hidden_size)
 
@@ -88,6 +99,9 @@ class TransformerEncoderBlock(Layer):
         h = LN(x + Dropout(MHA(x)));  out = LN(h + Dropout(FFN(h)))
 
     ``mask``: (B,T) padding mask — masked keys are never attended to.
+    ``causal=True`` adds the autoregressive mask (decoder-only / GPT
+    style), which is also what enables the KV-cache ``prefill`` /
+    ``decode_step`` serving path (serving/generate.py).
     """
 
     hidden_size: int = 0
@@ -99,6 +113,7 @@ class TransformerEncoderBlock(Layer):
     init_range: float = 0.02
     flash: Any = "auto"  # True | False | "auto" (measured-crossover dispatch)
     pre_norm: bool = False  # pre-LN variant (GPT-style)
+    causal: bool = False  # autoregressive mask (decoder-only LM)
 
     @property
     def _ffn(self):
@@ -120,7 +135,10 @@ class TransformerEncoderBlock(Layer):
             "ln2_g": jnp.ones((hs,)), "ln2_b": jnp.zeros((hs,)),
         }, {}
 
-    def _mha(self, params, x, mask):
+    def _qkv(self, params, x):
+        """Per-head Q/K/V projections: (B,T,H) → three (B,nh,T,dh). Shared
+        by the full forward and the KV-cache prefill/decode paths so the
+        cached K/V are bit-identical to the recomputed ones."""
         b, t, hs = x.shape
         nh = self.n_heads
         dh = hs // nh
@@ -128,41 +146,122 @@ class TransformerEncoderBlock(Layer):
         q = split(x @ params["Wq"] + params["bq"])
         k = split(x @ params["Wk"] + params["bk"])
         v = split(x @ params["Wv"] + params["bv"])
-        if attn_ops.resolve_flash(self.flash, t, t, mask):
-            o = attn_ops.flash_attention(q, k, v)
-        else:
-            amask = None if mask is None else mask[:, None, None, :].astype(bool)
-            o = attn_ops.dot_product_attention(q, k, v, mask=amask)
-        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, hs)
+        return q, k, v
+
+    def _proj_out(self, params, o):
+        b, nh, t, dh = o.shape
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, nh * dh)
         return o @ params["Wo"] + params["bo"]
 
-    def apply(self, params, state, x, *, training=False, key=None, mask=None):
-        k1 = k2 = None
-        if key is not None:
-            k1, k2 = jax.random.split(key)
+    def _mha(self, params, x, mask):
+        t = x.shape[1]
+        q, k, v = self._qkv(params, x)
+        if attn_ops.resolve_flash(self.flash, t, t, mask):
+            o = attn_ops.flash_attention(q, k, v, causal=self.causal)
+        else:
+            amask = None if mask is None else mask[:, None, None, :].astype(bool)
+            o = attn_ops.dot_product_attention(q, k, v, mask=amask,
+                                               causal=self.causal)
+        return self._proj_out(params, o)
+
+    def _attn_input(self, params, x):
+        """What the attention sublayer sees: LN(x) pre-norm, x post-norm."""
+        return (_layer_norm(x, params["ln1_g"], params["ln1_b"])
+                if self.pre_norm else x)
+
+    def _finish(self, params, x, a, k1=None, k2=None, training=False):
+        """Residual + LayerNorm + FFN composition after the attention
+        output ``a`` — the ONE copy shared by ``apply``, ``prefill``, and
+        ``decode_step``, so the bit-exact cache==recompute contract cannot
+        drift between paths."""
 
         def drop(h, k):
             # sublayer-output dropout at hidden_dropout (a different rate
             # from Layer.dropout, which is input dropout)
             if training and self.hidden_dropout > 0.0 and k is not None:
-                return randops.dropout(h, k, self.hidden_dropout, training=True)
+                return randops.dropout(h, k, self.hidden_dropout,
+                                       training=True)
             return h
 
-        fn = act.resolve(self.activation)
         if self.pre_norm:
-            a = self._mha(params, _layer_norm(x, params["ln1_g"], params["ln1_b"]), mask)
             h = x + drop(a, k1)
-            f = _layer_norm(h, params["ln2_g"], params["ln2_b"])
-            f = fn(f @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
-            out = h + drop(f, k2)
-        else:
-            a = self._mha(params, x, mask)
-            h = _layer_norm(x + drop(a, k1), params["ln1_g"], params["ln1_b"])
-            f = fn(h @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
-            out = _layer_norm(h + drop(f, k2), params["ln2_g"], params["ln2_b"])
+            f = self._ffn_block(
+                params, _layer_norm(h, params["ln2_g"], params["ln2_b"]))
+            return h + drop(f, k2)
+        h = _layer_norm(x + drop(a, k1), params["ln1_g"], params["ln1_b"])
+        return _layer_norm(h + drop(self._ffn_block(params, h), k2),
+                           params["ln2_g"], params["ln2_b"])
+
+    def apply(self, params, state, x, *, training=False, key=None, mask=None):
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        a = self._mha(params, self._attn_input(params, x), mask)
+        out = self._finish(params, x, a, k1, k2, training)
         if mask is not None:
             out = out * mask[..., None].astype(out.dtype)
         return out, state
+
+    # --------------------------------------------------- KV-cache decoding
+    # Serving substrate (serving/generate.py): ``prefill`` runs the causal
+    # forward over the whole prompt once and captures per-position K/V;
+    # ``decode_step`` then extends the sequence one token at a time, each
+    # step one small attention row over the cache instead of a full T×T
+    # recompute. Both reuse ``_qkv``/``_proj_out`` and the exact sublayer
+    # math of ``apply``, so greedy decode through the cache reproduces the
+    # full-recompute decode exactly (tests/test_serving.py).
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Empty K/V cache for ``batch`` rows and ``max_len`` positions."""
+        dh = self.hidden_size // self.n_heads
+        z = jnp.zeros((batch, self.n_heads, max_len, dh), dtype)
+        return {"k": z, "v": z}
+
+    def _ffn_block(self, params, h):
+        fn = act.resolve(self.activation)
+        return fn(h @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
+
+    def prefill(self, params, x, cache, mask=None):
+        """Causal forward over the prompt (B,T,H), writing K/V for positions
+        [0, T) into ``cache`` (T <= cache max_len). Returns (out, cache).
+        Inference-only (no dropout); ``mask`` is the (B,T) padding mask.
+        Padding positions write garbage K/V but every later read is masked
+        to ``k_pos <= position`` and generation overwrites position
+        ``length`` before first attending to it, so they are never seen."""
+        if not self.causal:
+            raise ValueError("prefill/decode_step need causal=True blocks")
+        q, k, v = self._qkv(params, self._attn_input(params, x))
+        zero = (0, 0, 0, 0)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), zero),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), zero),
+        }
+        amask = None if mask is None else mask[:, None, None, :].astype(bool)
+        o = attn_ops.dot_product_attention(q, k, v, mask=amask, causal=True)
+        return self._finish(params, x, self._proj_out(params, o)), cache
+
+    def decode_step(self, params, x_t, cache, positions):
+        """One autoregressive step: ``x_t`` (B,1,H) is the new token's
+        hidden state, ``positions`` (B,) its per-row position. Writes this
+        step's K/V at each row's position (per-row scatter — the written
+        slot is exactly the new value, every other slot exactly the old,
+        and the update is O(B·H·Dh), not a full-cache rewrite) and attends
+        the single query over ``k_pos <= position``. Returns
+        (out (B,1,H), cache)."""
+        q, k, v = self._qkv(params, self._attn_input(params, x_t))  # T=1
+        L = cache["k"].shape[2]
+        rows = jnp.arange(x_t.shape[0])
+        new_k = cache["k"].at[rows, :, positions].set(
+            k[:, :, 0].astype(cache["k"].dtype))
+        new_v = cache["v"].at[rows, :, positions].set(
+            v[:, :, 0].astype(cache["v"].dtype))
+        amask = (jnp.arange(L)[None, :]
+                 <= positions[:, None])[:, None, None, :]
+        o = attn_ops.dot_product_attention(q, new_k, new_v, mask=amask)
+        out = self._finish(params, x_t, self._proj_out(params, o))
+        return out, {"k": new_k, "v": new_v}
 
     def output_shape(self, input_shape):
         return (input_shape[0], self.hidden_size)

@@ -667,20 +667,45 @@ class ParallelInference:
     def output(self, x):
         x = np.asarray(x)
         n = len(x)
+        if self.bucketing is not None:
+            # ONE bucket plan for every request size (data/bucketing.py
+            # plan_serving_batch, shared with the serving scheduler —
+            # docs/SERVING.md): sizes between buckets pad up to the next
+            # bucket, sizes above the largest bucket chunk into
+            # largest-bucket pieces — a novel request size NEVER traces a
+            # new program once warmup() has primed the buckets
+            plan = self.bucketing.plan_serving_batch(n, cap=self.batch_limit)
+            if len(plan) > 1:
+                chunks, off = [], 0
+                for take, padded in plan:
+                    chunks.append(self._output_one(x[off:off + take],
+                                                   padded))
+                    off += take
+                return np.concatenate(chunks, axis=0)
+            return self._output_one(x, plan[0][1])
         if n > self.batch_limit:
             # chunk to bound per-call device memory (the reference's queue
             # coalescing bounds batches the same way)
             chunks = [
-                self.output(x[i : i + self.batch_limit])
+                self._output_one(x[i : i + self.batch_limit])
                 for i in range(0, n, self.batch_limit)
             ]
             return np.concatenate(chunks, axis=0)
+        return self._output_one(x)
+
+    def _output_one(self, x, target=None):
+        """One device call, padded to ``target`` rows (the plan's padded
+        size — which the plan may deliberately leave UNPADDED when
+        batch_limit excludes every bucket, honoring the memory bound) then
+        to mesh divisibility. Without a plan, buckets first then
+        mesh-pads."""
+        n = len(x)
         d = self.mesh.data
-        target = len(x)
-        if self.bucketing is not None:
+        if target is None:
             # bucket first, then mesh-divisibility: one compiled forward per
             # bucket instead of one per distinct (padded) request size
-            target = self.bucketing.bucket_batch(target)
+            target = (n if self.bucketing is None
+                      else self.bucketing.bucket_batch(n))
         target += (d - target % d) % d
         pad = target - n
         if pad:

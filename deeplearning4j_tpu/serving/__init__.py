@@ -1,0 +1,45 @@
+"""Production serving tier (docs/SERVING.md).
+
+The "millions of users" half of the north star: continuous/dynamic
+batching with deadline-aware priority queues (``scheduler``), multi-model
+multi-tenant routing with per-model admission control (``router``),
+KV-cache autoregressive decode for the transformer stack (``generate``),
+and an HTTP model server with queue-depth-driven load shedding and
+SIGTERM graceful drain (``server``) — all riding the r8 compile-once
+substrate (bucketing + AOT warmup), so steady-state serving performs
+ZERO XLA compiles.
+
+    from deeplearning4j_tpu.serving import (ModelRouter, ModelServer,
+                                            ServingModel)
+
+    router = ModelRouter()
+    router.register(ServingModel(net, "lenet"))           # live model
+    router.load("bert", "/models/bert.zip", kind="generate")
+    server = ModelServer(router, port=8080).start()        # warms buckets
+"""
+
+from deeplearning4j_tpu.serving.generate import Generator
+from deeplearning4j_tpu.serving.model import ServingModel
+from deeplearning4j_tpu.serving.router import (ModelRouter,
+                                               UnknownModelError,
+                                               current_status)
+from deeplearning4j_tpu.serving.scheduler import (BatchScheduler,
+                                                  DeadlineExceededError,
+                                                  QueueFullError,
+                                                  SchedulerDrainingError,
+                                                  ShedError)
+from deeplearning4j_tpu.serving.server import ModelServer
+
+__all__ = [
+    "BatchScheduler",
+    "DeadlineExceededError",
+    "Generator",
+    "ModelRouter",
+    "ModelServer",
+    "QueueFullError",
+    "SchedulerDrainingError",
+    "ServingModel",
+    "ShedError",
+    "UnknownModelError",
+    "current_status",
+]

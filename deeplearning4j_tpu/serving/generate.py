@@ -1,0 +1,269 @@
+"""KV-cache autoregressive generation for the nlp/transformer stack.
+
+The decode tier of the model server (docs/SERVING.md): a decoder-only LM
+built from the native transformer layers (``BertEmbeddingLayer`` →
+``TransformerEncoderBlock(causal=True)``× N → ``RnnOutputLayer``, e.g.
+``zoo.bert.Bert(causal=True, task="mlm")``) is served with TWO compiled
+programs instead of one quadratic recompute per token:
+
+- **prefill**: one causal forward over the whole prompt, capturing every
+  position's K/V into per-layer caches (``TransformerEncoderBlock.prefill``).
+  Prompt lengths round up to the bucketing policy's ``seq_buckets`` — the
+  decode-shape extension of ``data/bucketing.py``, so arbitrary prompt
+  lengths reuse a small fixed set of prefill executables.
+- **decode_step**: one token per call — embed at the row's position
+  (``BertEmbeddingLayer.embed_step``), attend the single query over the
+  cache (``TransformerEncoderBlock.decode_step``), project logits. One
+  executable per batch bucket, every generated token reuses it.
+
+Exactness contract (tests/test_serving.py): the cached K/V are computed by
+the same ``_qkv`` projections as the full forward and written with
+identity-preserving updates, so **greedy decode through the cache equals
+greedy full-recompute decode token-for-token**. ``generate_full_recompute``
+runs the O(T²) path for that proof (and as a reference implementation).
+
+Both programs are plain ``jax.jit`` functions with trace markers, so the
+CompileWatcher (and the ``serving.recompiles_total`` counter) sees every
+signature they ever trace — steady-state serving shows 0.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.util.compile_watcher import note_trace
+
+
+class Generator:
+    """Compile-once prefill/decode serving head over a decoder-only
+    MultiLayerNetwork.
+
+    ``batch_buckets`` / ``prefill_buckets`` default to the model conf's
+    bucketing knobs (ONE policy source of truth with training and the
+    classify tier); ``max_length`` defaults to the embedding layer's
+    ``max_position`` and bounds prompt + generated tokens.
+    """
+
+    def __init__(self, net, *, max_length: Optional[int] = None,
+                 batch_buckets=None, prefill_buckets=None):
+        from deeplearning4j_tpu.nn.transformer import (BertEmbeddingLayer,
+                                                       TransformerEncoderBlock)
+
+        layers = net.layers
+        if not layers or not isinstance(layers[0], BertEmbeddingLayer):
+            raise ValueError("Generator needs a BertEmbeddingLayer input "
+                             "(e.g. zoo.bert.Bert(causal=True, task='mlm'))")
+        blocks = layers[1:-1]
+        if not blocks or not all(isinstance(b, TransformerEncoderBlock)
+                                 for b in blocks):
+            raise ValueError("Generator needs TransformerEncoderBlock middle "
+                             "layers")
+        if not all(b.causal for b in blocks):
+            raise ValueError("Generator needs causal=True blocks — a "
+                             "bidirectional encoder cannot decode "
+                             "autoregressively")
+        if not hasattr(layers[-1], "_logits"):
+            raise ValueError("Generator needs a per-token logits head "
+                             "(RnnOutputLayer, task='mlm')")
+        self.net = net
+        self.emb = layers[0]
+        self.blocks = list(blocks)
+        self.head = layers[-1]
+        self.max_length = int(max_length or self.emb.max_position)
+        conf_policy = BucketingPolicy.from_conf(getattr(net, "conf", None))
+        if batch_buckets is None and conf_policy is not None:
+            batch_buckets = conf_policy.batch_buckets
+        if prefill_buckets is None and conf_policy is not None:
+            prefill_buckets = conf_policy.seq_buckets
+        self.policy = BucketingPolicy(
+            batch_buckets=batch_buckets or "pow2",
+            seq_buckets=prefill_buckets or "pow2")
+        self._prefill_jit = jax.jit(self._prefill)
+        self._decode_jit = jax.jit(self._decode)
+
+    # ------------------------------------------------------ traced programs
+    def _prefill(self, params, tokens, lengths):
+        """tokens (B, T) int32, lengths (B,) int32 → (next-token logits
+        (B, V), caches). Padding rows/positions are masked out of every
+        attention read; the cache rows they write are overwritten by
+        generation before they are ever visible (nn/transformer.py)."""
+        note_trace("serving.prefill", tokens, lengths)  # trace-time only
+        b, t = tokens.shape
+        x, _ = self.emb.apply(params[0], {}, tokens)
+        pad_mask = (jnp.arange(t)[None, :]
+                    < lengths[:, None]).astype(x.dtype)
+        caches = []
+        for i, blk in enumerate(self.blocks):
+            cache = blk.init_cache(b, self.max_length, x.dtype)
+            x, cache = blk.prefill(params[i + 1], x, cache, mask=pad_mask)
+            caches.append(cache)
+        h_last = x[jnp.arange(b), lengths - 1]
+        logits = self.head._logits(params[-1], h_last)
+        return logits, caches
+
+    def _decode(self, params, caches, tokens, positions):
+        """One autoregressive step: tokens (B,) placed at per-row
+        ``positions`` (B,) → (next-token logits (B, V), caches)."""
+        note_trace("serving.decode_step", tokens, positions)
+        x = self.emb.embed_step(params[0], tokens, positions)[:, None, :]
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            x, cache = blk.decode_step(params[i + 1], x, caches[i], positions)
+            new_caches.append(cache)
+        logits = self.head._logits(params[-1], x[:, 0])
+        return logits, new_caches
+
+    # ------------------------------------------------------------- sampling
+    @staticmethod
+    def _sample(logits, temperature: float, key):
+        if temperature and temperature > 0.0:
+            return jax.random.categorical(
+                key, logits / jnp.asarray(temperature, logits.dtype), axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _prefill_len(self, longest: int) -> int:
+        """Prefill shape for the longest prompt: its seq bucket, with
+        ``max_length`` as the implicit FINAL bucket — a prompt above the
+        largest explicit bucket pads up to max_length instead of tracing a
+        fresh per-length executable (the pad-up-not-retrace contract,
+        docs/SERVING.md; warmup() primes the max_length shape too)."""
+        t = self.policy.bucket_seq(longest)
+        top = self.policy.seq_buckets
+        if isinstance(top, tuple) and longest > top[-1]:
+            return self.max_length
+        return min(t, self.max_length)
+
+    def _prep(self, prompts: Sequence[Sequence[int]], max_new_tokens: int):
+        lens = [len(p) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt")
+        if max(lens) + max_new_tokens > self.max_length:
+            raise ValueError(
+                f"prompt ({max(lens)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_length ({self.max_length})")
+        b_real = len(prompts)
+        b = self.policy.bucket_batch(b_real)
+        t = self._prefill_len(max(lens))
+        tokens = np.zeros((b, t), np.int32)
+        lengths = np.ones((b,), np.int32)  # padded rows: 1 fake token
+        for i, p in enumerate(prompts):
+            tokens[i, :lens[i]] = np.asarray(p, np.int32)
+            lengths[i] = lens[i]
+        return (jnp.asarray(tokens), jnp.asarray(lengths), b_real, lens)
+
+    def _trim(self, stacked, b_real: int, lens, max_new_tokens: int,
+              eos_id: Optional[int]) -> List[List[int]]:
+        out = []
+        for i in range(b_real):
+            row = [int(v) for v in stacked[i][:max_new_tokens]]
+            if eos_id is not None and eos_id in row:
+                row = row[: row.index(eos_id) + 1]
+            out.append(row)
+        return out
+
+    # ------------------------------------------------------------ decoding
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 16, *, temperature: float = 0.0,
+                 key=None, eos_id: Optional[int] = None) -> List[List[int]]:
+        """KV-cache decode: one prefill + ``max_new_tokens - 1`` decode
+        steps, all on warmed executables. ``temperature=0`` is greedy
+        (deterministic); otherwise categorical sampling from ``key``
+        (default PRNGKey(0) — pass a key for fresh randomness)."""
+        if max_new_tokens < 1:
+            return [[] for _ in prompts]
+        tokens, lengths, b_real, lens = self._prep(prompts, max_new_tokens)
+        params = self.net.params
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        logits, caches = self._prefill_jit(params, tokens, lengths)
+        positions = lengths  # where the sampled token goes
+        steps = []
+        key, sub = jax.random.split(key)
+        cur = self._sample(logits, temperature, sub)
+        for i in range(max_new_tokens):
+            steps.append(cur)
+            if i == max_new_tokens - 1:
+                break
+            logits, caches = self._decode_jit(params, caches, cur, positions)
+            positions = positions + 1
+            key, sub = jax.random.split(key)
+            cur = self._sample(logits, temperature, sub)
+        stacked = np.stack([np.asarray(s) for s in steps], axis=1)
+        return self._trim(stacked, b_real, lens, max_new_tokens, eos_id)
+
+    def generate_full_recompute(self, prompts: Sequence[Sequence[int]],
+                                max_new_tokens: int = 16, *,
+                                temperature: float = 0.0, key=None,
+                                eos_id: Optional[int] = None
+                                ) -> List[List[int]]:
+        """O(T²) reference decode: re-prefill the whole grown sequence for
+        every token. Exactly the same sampling stream as ``generate`` —
+        the KV-cache path must reproduce it token-for-token (greedy) —
+        kept as the verification oracle, not a serving path."""
+        if max_new_tokens < 1:
+            return [[] for _ in prompts]
+        grown = [list(p) for p in prompts]
+        params = self.net.params
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        steps = []
+        for i in range(max_new_tokens):
+            tokens, lengths, b_real, _ = self._prep(grown, 1)
+            logits, _ = self._prefill_jit(params, tokens, lengths)
+            key, sub = jax.random.split(key)
+            cur = self._sample(logits, temperature, sub)
+            steps.append(cur)
+            host = np.asarray(cur)
+            for r in range(len(grown)):
+                grown[r].append(int(host[r]))
+        stacked = np.stack([np.asarray(s) for s in steps], axis=1)
+        lens = [len(p) for p in prompts]
+        return self._trim(stacked, len(prompts), lens, max_new_tokens,
+                          eos_id)
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, batch_sizes=None, prompt_lengths=None) -> int:
+        """Pre-trace every (batch bucket × prefill bucket) prefill and every
+        batch-bucket decode step, so steady-state serving never compiles
+        (docs/SERVING.md). Defaults to the explicit bucket lists of the
+        policy. Returns the number of signatures primed."""
+        if batch_sizes is None:
+            if not isinstance(self.policy.batch_buckets, tuple):
+                raise ValueError("warmup() without batch_sizes needs "
+                                 "explicit batch buckets")
+            batch_sizes = self.policy.batch_buckets
+        if prompt_lengths is None:
+            if isinstance(self.policy.seq_buckets, tuple):
+                # max_length is the implicit final bucket (_prefill_len)
+                prompt_lengths = tuple(self.policy.seq_buckets) \
+                    + (self.max_length,)
+            else:
+                # pow2 (the default policy): every pow2 prefill shape up to
+                # max_length — log2(L) signatures, so router.load(kind=
+                # "generate") on a conf without seq_buckets still boots
+                prompt_lengths = tuple(
+                    2 ** i for i in range(self.max_length.bit_length())
+                ) + (self.max_length,)
+        params = self.net.params
+        primed = 0
+        for b in batch_sizes:
+            b = int(b)
+            caches = None
+            for t in sorted({min(int(t), self.max_length)
+                             for t in prompt_lengths}):
+                tokens = jnp.zeros((b, t), jnp.int32)
+                lengths = jnp.ones((b,), jnp.int32)
+                _, caches = self._prefill_jit(params, tokens, lengths)
+                primed += 1
+            if caches is not None:
+                cur = jnp.zeros((b,), jnp.int32)
+                pos = jnp.ones((b,), jnp.int32)
+                self._decode_jit(params, caches, cur, pos)
+                primed += 1
+        return primed

@@ -1,0 +1,200 @@
+"""ServingModel — one loaded model behind the batching scheduler.
+
+Binds a trained ``MultiLayerNetwork``/``ComputationGraph`` (or a mesh-backed
+``ParallelInference``) to the serving tier with ONE
+:class:`~deeplearning4j_tpu.data.bucketing.BucketingPolicy` as the shared
+source of truth for every shape decision — warmup, coalescing limit,
+request padding, and prefill/decode buckets all read the same policy, so a
+request size that "falls between buckets" pads up to the next bucket
+instead of tracing a new program (docs/SERVING.md).
+
+Two kinds:
+
+- ``kind="classify"``: forward inference. Requests are (n, …feature) row
+  batches; the scheduler's coalesced rows are chunk-planned
+  (``plan_serving_batch``) and executed through the AOT-warmed
+  ``net.output`` path (or ``ParallelInference.output`` when ``use_mesh``),
+  then split back per request. Row independence makes the batched result
+  bit-identical to per-request results.
+- ``kind="generate"``: KV-cache autoregressive decode
+  (serving/generate.py). Requests are token prompts; coalesced prompts
+  decode as one batch, per-request ``max_new_tokens`` honored by trimming
+  (rows are attention-independent, so batching never changes a row's
+  tokens).
+
+``execute`` counts the XLA traces it causes via the CompileWatcher — the
+scheduler publishes them as ``serving.recompiles_total``, the steady-state-
+zero contract the CI smoke asserts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.util.compile_watcher import get_watcher
+
+_DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+class ServingModel:
+    """One model-id's executor (see module doc)."""
+
+    def __init__(self, net, model_id: str, *, kind: str = "classify",
+                 bucketing=None, use_mesh: bool = False,
+                 export_dir: Optional[str] = None,
+                 max_length: Optional[int] = None,
+                 prefill_buckets=None):
+        if kind not in ("classify", "generate"):
+            raise ValueError(f"unknown serving kind {kind!r}")
+        self.net = net
+        self.model_id = str(model_id)
+        self.kind = kind
+        self.export_dir = export_dir
+        if isinstance(bucketing, str):
+            bucketing = BucketingPolicy.from_spec(bucketing)
+        if bucketing is None:
+            bucketing = BucketingPolicy.from_conf(getattr(net, "conf", None))
+        if bucketing is None or not isinstance(
+                bucketing.batch_buckets, tuple):
+            # serving needs a FINITE bucket list (warmup must enumerate it);
+            # keep any seq buckets the conf declared
+            seq = getattr(bucketing, "seq_buckets", None)
+            bucketing = BucketingPolicy(batch_buckets=_DEFAULT_BUCKETS,
+                                        seq_buckets=seq)
+        self.policy = bucketing
+        self.inference = None
+        self.generator = None
+        if kind == "generate":
+            from deeplearning4j_tpu.serving.generate import Generator
+
+            self.generator = Generator(
+                net, max_length=max_length,
+                batch_buckets=self.policy.batch_buckets,
+                prefill_buckets=(prefill_buckets
+                                 or self.policy.seq_buckets))
+            self.policy = self.generator.policy
+        elif use_mesh:
+            from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+
+            # the SAME policy object the scheduler plans with — one bucket
+            # source of truth for warmup() and coalescing
+            self.inference = ParallelInference(net, bucketing=self.policy)
+        self.warmed = False
+
+    # -------------------------------------------------------------- shapes
+    def coalesce_limit(self) -> int:
+        """Largest batch the scheduler should coalesce to — the largest
+        bucket (a bigger batch would just be split again)."""
+        top = self.policy.largest_batch_bucket()
+        return int(top) if top else 64
+
+    def payload_rows(self, payload) -> int:
+        if self.kind == "generate":
+            return 1  # one prompt row per request
+        return int(np.shape(payload)[0])
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """Compile every bucket signature before traffic: the classify
+        forward per batch bucket (through the r8 AOT path — with
+        ``export_dir`` a warm process deserializes the stored lowering
+        instead of re-tracing), or every prefill/decode executable for
+        generate. Returns the number of signatures primed."""
+        if self.kind == "generate":
+            primed = self.generator.warmup()
+        elif self.inference is not None:
+            primed = self.inference.warmup(
+                batch_sizes=self.policy.batch_buckets)
+        else:
+            conf = getattr(self.net, "conf", None)
+            shape = tuple(getattr(conf, "input_shape", None) or ())
+            if not shape:
+                raise ValueError(
+                    f"{self.model_id}: warmup() needs conf.input_shape")
+            primed = self.net.warmup(
+                shapes=[(int(b),) + shape
+                        for b in self.policy.batch_buckets],
+                train=False, inference=True, export_dir=self.export_dir)
+            # prime the jit dispatch too (output() prefers AOT executables,
+            # but a signature miss must still find a warm jit cache)
+            for b in self.policy.batch_buckets:
+                self.net.output(np.zeros((int(b),) + shape, np.float32))
+        self.warmed = True
+        return primed
+
+    # ------------------------------------------------------------- execute
+    def execute(self, payloads: List[Any], **opts
+                ) -> Tuple[List[Any], Dict[str, Any]]:
+        """Run one coalesced batch; returns (per-payload results, stats).
+        stats: real/padded row counts and the number of XLA traces this
+        batch caused (0 in steady state)."""
+        watcher = get_watcher()
+        traces_before = watcher.total_traces()
+        if self.kind == "generate":
+            results, real, padded = self._execute_generate(payloads, **opts)
+        else:
+            results, real, padded = self._execute_classify(payloads, **opts)
+        return results, {
+            "real_rows": real,
+            "padded_rows": padded,
+            "recompiles": watcher.total_traces() - traces_before,
+        }
+
+    def _execute_classify(self, payloads, **opts):
+        if opts:
+            raise ValueError(f"classify takes no options, got {opts}")
+        xs = np.concatenate([np.asarray(p) for p in payloads], axis=0)
+        n = len(xs)
+        # the SAME cap-aware plan the mesh path executes, so the occupancy
+        # stat reflects the padding that actually ran (mesh-divisibility
+        # rounding of the 'data' axis is not included — on a 1-device
+        # serving mesh it is zero)
+        cap = (self.inference.batch_limit if self.inference is not None
+               else None)
+        plan = self.policy.plan_serving_batch(n, cap=cap)
+        padded = sum(p for _, p in plan)
+        if self.inference is not None:
+            out = self.inference.output(xs)  # plans the same chunks inside
+        else:
+            chunks, off = [], 0
+            for take, bucket in plan:
+                chunk = xs[off:off + take]
+                if bucket != take:
+                    pad = np.zeros((bucket - take,) + xs.shape[1:], xs.dtype)
+                    chunk = np.concatenate([chunk, pad], axis=0)
+                res = np.asarray(self.net.output(chunk))[:take]
+                chunks.append(res)
+                off += take
+            out = np.concatenate(chunks, axis=0)
+        results, off = [], 0
+        for p in payloads:
+            k = int(np.shape(p)[0])
+            results.append(out[off:off + k])
+            off += k
+        return results, n, padded
+
+    def _execute_generate(self, payloads, **opts):
+        prompts = [list(np.asarray(p).ravel().astype(np.int64)) for p in
+                   payloads]
+        max_new = int(opts.get("max_new_tokens", 16))
+        tokens = self.generator.generate(
+            prompts, max_new_tokens=max_new,
+            temperature=float(opts.get("temperature", 0.0)),
+            eos_id=opts.get("eos_id"))
+        real = len(prompts)
+        padded = self.policy.bucket_batch(real)
+        return tokens, real, padded
+
+    def describe(self) -> dict:
+        return {
+            "kind": self.kind,
+            "buckets": self.policy.to_spec(),
+            "coalesce_limit": self.coalesce_limit(),
+            "warmed": self.warmed,
+            "mesh": self.inference is not None,
+            "params": int(self.net.num_params())
+            if hasattr(self.net, "num_params") else None,
+        }

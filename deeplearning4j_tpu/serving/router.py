@@ -1,0 +1,170 @@
+"""Multi-model, multi-tenant routing for the serving tier.
+
+One :class:`ModelRouter` owns a registry of model-id →
+(:class:`~deeplearning4j_tpu.serving.model.ServingModel`,
+:class:`~deeplearning4j_tpu.serving.scheduler.BatchScheduler`). Every model
+gets its OWN scheduler — queue, lanes, admission limit, worker thread — so
+tenant isolation is structural: one model's flood fills one queue and sheds
+there; it cannot starve another model's priority lane
+(tests/test_serving.py::test_multi_model_isolation).
+
+Models register live (``register``) or load from a ModelSerializer archive
+(``load`` — ``util/model_serializer.py``), and ``warmup()`` primes every
+registered model's bucket executables through the r8 AOT export store
+before the server accepts traffic.
+
+The module keeps a registry of live routers so ``/healthz`` (ui_server)
+and the telemetry default collectors can report serving state without the
+probe importing the serving package (the same ``sys.modules`` guard the
+elastic runtime uses).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Optional, Tuple
+
+from deeplearning4j_tpu.serving.model import ServingModel
+from deeplearning4j_tpu.serving.scheduler import BatchScheduler
+from deeplearning4j_tpu.util import telemetry as tm
+
+_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class UnknownModelError(KeyError):
+    """No such model-id (HTTP 404)."""
+
+    http_status = 404
+
+
+class ModelRouter:
+    """model-id → (ServingModel, BatchScheduler) registry (see module doc)."""
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._models: Dict[str, Tuple[ServingModel, BatchScheduler]] = {}
+        self.draining = False
+        _ROUTERS.add(self)
+
+    # ------------------------------------------------------------ registry
+    def register(self, model: ServingModel, *, max_wait_ms: float = 2.0,
+                 max_batch: Optional[int] = None, queue_limit: int = 64,
+                 start: bool = True) -> BatchScheduler:
+        """Attach a model under its ``model_id`` with its own scheduler
+        (per-model admission control via ``queue_limit``)."""
+        sched = BatchScheduler(model, max_wait_ms=max_wait_ms,
+                               max_batch=max_batch, queue_limit=queue_limit)
+        with self._lock:
+            if model.model_id in self._models:
+                raise ValueError(f"model {model.model_id!r} already "
+                                 "registered")
+            self._models[model.model_id] = (model, sched)
+        tm.counter("serving.models_registered_total")
+        if start:
+            sched.start()
+        return sched
+
+    def load(self, model_id: str, path: str, *, kind: str = "classify",
+             **model_kw) -> BatchScheduler:
+        """Restore a ModelSerializer archive and register it. ``model_kw``
+        passes through to :class:`ServingModel` (bucketing, export_dir,
+        use_mesh, …)."""
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        net = ModelSerializer.restore_model(path, load_updater=False)
+        model = ServingModel(net, model_id, kind=kind, **model_kw)
+        return self.register(model)
+
+    def get(self, model_id: str) -> Tuple[ServingModel, BatchScheduler]:
+        with self._lock:
+            entry = self._models.get(model_id)
+        if entry is None:
+            raise UnknownModelError(model_id)
+        return entry
+
+    def model_ids(self):
+        with self._lock:
+            return list(self._models)
+
+    # ------------------------------------------------------------- serving
+    def submit(self, model_id: str, payload, *, lane: str = "interactive",
+               deadline_ms: Optional[float] = None, **opts):
+        """Route one request to its model's scheduler; returns a Future."""
+        _model, sched = self.get(model_id)
+        return sched.submit(payload, lane=lane, deadline_ms=deadline_ms,
+                            **opts)
+
+    def warmup(self) -> int:
+        """Prime every model's bucket executables (docs/SERVING.md).
+        Returns total signatures compiled/loaded."""
+        primed = 0
+        for model_id in self.model_ids():
+            model, _sched = self.get(model_id)
+            with tm.span("serving.warmup", model=model_id):
+                primed += model.warmup()
+        return primed
+
+    # ----------------------------------------------------------- lifecycle
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful drain across every model (the SIGTERM path): stop
+        admission everywhere, finish queued work, stop workers."""
+        self.draining = True
+        ok = True
+        for model_id in self.model_ids():
+            _m, sched = self.get(model_id)
+            ok = sched.drain(timeout=timeout) and ok
+        tm.counter("serving.drains_total")
+        tm.set_health("serving.drained", True,
+                      f"router {self.name} drained (clean={ok})")
+        return ok
+
+    def shutdown(self):
+        self.draining = True
+        for model_id in self.model_ids():
+            _m, sched = self.get(model_id)
+            sched.shutdown()
+
+    # ---------------------------------------------------------------- stats
+    def status(self) -> dict:
+        out = {"draining": self.draining, "models": {}}
+        for model_id in self.model_ids():
+            model, sched = self.get(model_id)
+            out["models"][model_id] = {**model.describe(), **sched.stats()}
+        return out
+
+
+def current_status() -> dict:
+    """Serving section for /healthz (util/ui_server.py): every live
+    router's per-model queue/latency/shed state. Empty dict when no router
+    exists — the probe stays cheap."""
+    routers = [r for r in list(_ROUTERS)]
+    if not routers:
+        return {}
+    if len(routers) == 1:
+        return routers[0].status()
+    return {r.name: r.status() for r in routers}
+
+
+def collect_metrics() -> list:
+    """Scrape-time gauges for the telemetry default collectors: fresh
+    queue depth / p50 / p99 / QPS per model even when no batch has run
+    since the last scrape."""
+    rows = []
+    for r in list(_ROUTERS):
+        for model_id in r.model_ids():
+            try:
+                _m, sched = r.get(model_id)
+            except UnknownModelError:
+                continue
+            labels = {"model": model_id}
+            rows.append(("serving.queue_depth", labels,
+                         float(sched.queue_depth())))
+            rows.append(("serving.qps_10s", labels, float(sched.qps())))
+            for q, name in ((0.5, "serving.latency_p50_seconds"),
+                            (0.99, "serving.latency_p99_seconds")):
+                v = sched.latencies.quantile(q)
+                if v is not None:
+                    rows.append((name, labels, float(v)))
+    return rows

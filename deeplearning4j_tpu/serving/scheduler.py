@@ -1,0 +1,374 @@
+"""Continuous/dynamic batching scheduler with deadline-aware queues.
+
+The request plane of the model server (docs/SERVING.md). Requests enter
+per-priority-lane FIFO queues and a background worker coalesces them into
+device batches:
+
+- **lanes**: ``"interactive"`` drains strictly before ``"batch"`` — a bulk
+  tenant's flood queues behind nothing the interactive lane needs (and
+  every model gets its OWN scheduler via the router, so cross-model
+  isolation is structural, not fair-queuing luck).
+- **coalescing**: the first request opens a batch; the worker keeps
+  admitting compatible requests (same lane, same per-request options)
+  until the model's coalesce limit (the largest batch bucket) is reached
+  or ``max_wait_ms`` has elapsed since the batch opened — classic
+  max-batch/max-wait dynamic batching (ParallelInference.java's observable
+  queue, grown up). The coalesced rows ride ``data/bucketing.py`` padding,
+  so the batched output is BIT-identical to per-request output
+  (tests/test_serving.py).
+- **deadlines**: ``deadline_ms`` is the caller's queueing budget. A request
+  still queued when it expires is shed with :class:`DeadlineExceededError`
+  (the HTTP 429 path) instead of executing late — load-shedding work the
+  caller has already given up on.
+- **admission control**: a full queue rejects at submit time
+  (:class:`QueueFullError`, HTTP 429 + Retry-After) — queue depth, not
+  latency collapse, is the overload signal, and it feeds ``/healthz``.
+
+Telemetry (all on the process registry → /metrics): per-model request/shed
+counters, queue-depth gauge, batch-occupancy and latency histograms,
+p50/p99 latency gauges, and ``serving.recompiles_total`` — the count of
+XLA traces serving has caused since warmup, asserted 0 in steady state by
+the CI smoke (benchmarks/serving_smoke.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.util import telemetry as tm
+
+LANES = ("interactive", "batch")  # priority order, first drains first
+
+
+class ShedError(RuntimeError):
+    """Request rejected by load shedding (HTTP 429 + Retry-After)."""
+
+    http_status = 429
+    retry_after_s = 1.0
+
+
+class QueueFullError(ShedError):
+    """Admission control: the model's queue is at capacity."""
+
+
+class DeadlineExceededError(ShedError):
+    """The request's queueing deadline expired before execution started."""
+
+
+class SchedulerDrainingError(ShedError):
+    """The scheduler is draining (SIGTERM) — no new work accepted."""
+
+    http_status = 503
+
+
+@dataclasses.dataclass
+class _Request:
+    payload: Any
+    rows: int
+    future: Future
+    lane: str
+    opts_key: Tuple
+    opts: Dict[str, Any]
+    t_enqueue: float                 # monotonic
+    deadline: Optional[float]        # absolute monotonic, or None
+
+
+class _LatencyWindow:
+    """Sliding window of recent request latencies for p50/p99 gauges (the
+    telemetry histogram keeps the full Prometheus series; this gives exact
+    quantiles over the recent past for /healthz and the bench)."""
+
+    def __init__(self, size: int = 1024):
+        self._buf = collections.deque(maxlen=size)
+        self._lock = threading.Lock()
+
+    def add(self, v: float):
+        with self._lock:
+            self._buf.append(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._buf:
+                return None
+            vals = sorted(self._buf)
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+
+class BatchScheduler:
+    """One model's request queue + coalescing worker (see module doc)."""
+
+    def __init__(self, model, *, max_wait_ms: float = 2.0,
+                 max_batch: Optional[int] = None, queue_limit: int = 64,
+                 lanes=LANES):
+        self.model = model
+        self.model_id = model.model_id
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_batch = int(max_batch or model.coalesce_limit())
+        self.queue_limit = int(queue_limit)
+        self.lanes = tuple(lanes)
+        self._queues: Dict[str, collections.deque] = {
+            lane: collections.deque() for lane in self.lanes}
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._accepting = True
+        self._inflight = 0
+        self.latencies = _LatencyWindow()
+        self._completed_ts = collections.deque(maxlen=4096)
+        self._ts_lock = threading.Lock()  # appends race /metrics scrapes
+        self.counts = collections.Counter()  # completed/shed_* totals
+
+    # ------------------------------------------------------------ admission
+    def submit(self, payload, *, lane: str = "interactive",
+               deadline_ms: Optional[float] = None, **opts) -> Future:
+        """Enqueue one request; returns a Future of the model result.
+        Raises a :class:`ShedError` subclass instead of queueing when the
+        scheduler is draining or the queue is full."""
+        if lane not in self._queues:
+            raise ValueError(f"unknown lane {lane!r} (have {self.lanes})")
+        rows = self.model.payload_rows(payload)
+        now = time.monotonic()
+        req = _Request(
+            payload=payload, rows=rows, future=Future(), lane=lane,
+            opts_key=tuple(sorted(opts.items())), opts=opts, t_enqueue=now,
+            deadline=None if deadline_ms is None else now + deadline_ms / 1e3)
+        with self._cv:
+            if not self._accepting:
+                self.counts["shed_draining"] += 1
+                tm.counter("serving.shed_total", model=self.model_id,
+                           reason="draining")
+                raise SchedulerDrainingError(
+                    f"{self.model_id}: scheduler draining")
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.queue_limit:
+                self.counts["shed_queue_full"] += 1
+                tm.counter("serving.shed_total", model=self.model_id,
+                           reason="queue_full")
+                raise QueueFullError(
+                    f"{self.model_id}: queue at capacity ({depth})")
+            self._queues[lane].append(req)
+            tm.gauge("serving.queue_depth", depth + 1, model=self.model_id)
+            self._cv.notify()
+        tm.counter("serving.requests_total", model=self.model_id, lane=lane)
+        return req.future
+
+    # --------------------------------------------------------------- worker
+    def start(self) -> "BatchScheduler":
+        with self._cv:
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"serving-{self.model_id}")
+                self._thread.start()
+        return self
+
+    def _shed(self, req: _Request, exc: ShedError, reason: str):
+        self.counts[f"shed_{reason}"] += 1
+        tm.counter("serving.shed_total", model=self.model_id, reason=reason)
+        if not req.future.set_running_or_notify_cancel():
+            return
+        req.future.set_exception(exc)
+
+    def _sweep_expired_locked(self, now: float):
+        for lane in self.lanes:
+            q = self._queues[lane]
+            kept = collections.deque()
+            while q:
+                req = q.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    self._shed(req, DeadlineExceededError(
+                        f"{self.model_id}: deadline expired after "
+                        f"{(now - req.t_enqueue) * 1e3:.1f} ms in queue"),
+                        "deadline")
+                else:
+                    kept.append(req)
+            self._queues[lane] = q
+            q.extend(kept)
+
+    def _open_batch_locked(self) -> Optional[List[_Request]]:
+        """Pop the head of the highest-priority non-empty lane."""
+        for lane in self.lanes:
+            if self._queues[lane]:
+                return [self._queues[lane].popleft()]
+        return None
+
+    def _fill_batch_locked(self, batch: List[_Request]) -> int:
+        """Admit compatible queued requests into the open batch (same lane
+        first, then lower lanes — occupancy over strictness once the
+        priority head is already in the batch). A request whose deadline
+        expired while the batch was filling is shed here, not executed —
+        the 429 contract holds even under a busy worker. Returns total
+        rows."""
+        head = batch[0]
+        rows = sum(r.rows for r in batch)
+        for lane in self.lanes:
+            q = self._queues[lane]
+            scan = len(q)
+            for _ in range(scan):
+                if rows >= self.max_batch:
+                    return rows
+                req = q[0]
+                now = time.monotonic()
+                if req.deadline is not None and now > req.deadline:
+                    q.popleft()
+                    self._shed(req, DeadlineExceededError(
+                        f"{self.model_id}: deadline expired after "
+                        f"{(now - req.t_enqueue) * 1e3:.1f} ms in queue"),
+                        "deadline")
+                    continue
+                if req.opts_key != head.opts_key \
+                        or rows + req.rows > self.max_batch:
+                    break
+                batch.append(q.popleft())
+                rows += req.rows
+        return rows
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._stop \
+                        and not any(self._queues[l] for l in self.lanes):
+                    self._cv.wait(timeout=0.1)
+                if self._stop \
+                        and not any(self._queues[l] for l in self.lanes):
+                    return
+                self._sweep_expired_locked(time.monotonic())
+                batch = self._open_batch_locked()
+                if batch is None:
+                    continue
+                self._inflight = 1
+            # max-wait window: keep admitting until the batch is full or
+            # max_wait_ms has passed since it opened (continuous batching)
+            t_open = time.monotonic()
+            deadline = t_open + self.max_wait_ms / 1e3
+            while True:
+                with self._cv:
+                    rows = self._fill_batch_locked(batch)
+                    if rows >= self.max_batch:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    tm.gauge("serving.queue_depth",
+                             sum(len(q) for q in self._queues.values()),
+                             model=self.model_id)
+                    self._cv.notify_all()
+
+    def _run_batch(self, batch: List[_Request]):
+        t0 = time.monotonic()
+        with tm.span("serving.batch", model=self.model_id,
+                     requests=len(batch), lane=batch[0].lane):
+            try:
+                results, stats = self.model.execute(
+                    [r.payload for r in batch], **batch[0].opts)
+            except Exception as e:  # a bad request fails its batch, never
+                for req in batch:   # the worker (ParallelInference contract)
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(e)
+                tm.counter("serving.batch_errors_total", model=self.model_id)
+                return
+        now = time.monotonic()
+        for req, res in zip(batch, results):
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(res)
+            lat = now - req.t_enqueue
+            self.latencies.add(lat)
+            with self._ts_lock:
+                self._completed_ts.append(now)
+            self.counts["completed"] += 1
+            tm.observe("serving.request_latency_seconds", lat,
+                       model=self.model_id, lane=req.lane)
+        tm.counter("serving.batches_total", model=self.model_id)
+        tm.counter("serving.recompiles_total", stats.get("recompiles", 0),
+                   model=self.model_id)
+        if stats.get("padded_rows"):
+            tm.observe("serving.batch_occupancy",
+                       stats["real_rows"] / stats["padded_rows"],
+                       model=self.model_id)
+        tm.observe("serving.batch_exec_seconds", now - t0,
+                   model=self.model_id)
+        for q, g in (("0.5", "serving.latency_p50_seconds"),
+                     ("0.99", "serving.latency_p99_seconds")):
+            val = self.latencies.quantile(float(q))
+            if val is not None:
+                tm.gauge(g, val, model=self.model_id)
+
+    # ----------------------------------------------------------- lifecycle
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful drain (the r11 SIGTERM seam, serving-side): stop
+        accepting, FINISH everything already queued, then stop the worker.
+        Returns True when the queues emptied within ``timeout``."""
+        with self._cv:
+            self._accepting = False
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (any(self._queues[l] for l in self.lanes)
+                   or self._inflight) and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.1)
+            drained = not any(self._queues[l] for l in self.lanes) \
+                and not self._inflight
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        return drained
+
+    def shutdown(self):
+        """Immediate stop: fail everything still queued."""
+        with self._cv:
+            self._accepting = False
+            self._stop = True
+            pending = [r for l in self.lanes for r in self._queues[l]]
+            for l in self.lanes:
+                self._queues[l].clear()
+            self._cv.notify_all()
+        for req in pending:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    SchedulerDrainingError(f"{self.model_id}: shut down"))
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ---------------------------------------------------------------- stats
+    def queue_depth(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._queues.values())
+
+    def qps(self, window_s: float = 10.0) -> float:
+        now = time.monotonic()
+        with self._ts_lock:
+            n = sum(1 for t in self._completed_ts if now - t <= window_s)
+        return n / window_s
+
+    def stats(self) -> dict:
+        p50 = self.latencies.quantile(0.5)
+        p99 = self.latencies.quantile(0.99)
+        return {
+            "queue_depth": self.queue_depth(),
+            "accepting": self._accepting,
+            "completed": self.counts["completed"],
+            "shed": {k[len("shed_"):]: v for k, v in self.counts.items()
+                     if k.startswith("shed_")},
+            "qps_10s": round(self.qps(), 3),
+            "latency_p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+            "latency_p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+            "max_batch": self.max_batch,
+            "max_wait_ms": self.max_wait_ms,
+            "queue_limit": self.queue_limit,
+        }

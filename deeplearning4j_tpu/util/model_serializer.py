@@ -153,6 +153,18 @@ class ModelSerializer:
         return net
 
     @staticmethod
+    def peek_meta(path: str) -> dict:
+        """Archive metadata (type, iteration, epoch, format_version) WITHOUT
+        building the model — the serving router's registry/listing path
+        (serving/router.py): a model catalog can be enumerated without
+        paying a restore per entry."""
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read(_META))
+        return {k: meta[k] for k in
+                ("type", "iteration", "epoch", "format_version")
+                if k in meta}
+
+    @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
         return ModelSerializer._restore(path, "MultiLayerNetwork", load_updater)
 

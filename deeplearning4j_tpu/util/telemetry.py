@@ -580,6 +580,7 @@ def install_default_collectors() -> Telemetry:
         tele.register_collector(_collect_device_memory)
         tele.register_collector(_collect_compile_cache)
         tele.register_collector(_collect_elastic)
+        tele.register_collector(_collect_serving)
         _defaults_installed = True
     return tele
 
@@ -652,6 +653,18 @@ def _collect_elastic() -> list:
     if mod is None:
         return []
     return mod.collect_elastic_gauges()
+
+
+def _collect_serving() -> list:
+    """Serving-tier gauges (per-model queue depth, p50/p99 latency, QPS) at
+    scrape time — import-guarded like elastic, so a process that never
+    served pays nothing (docs/SERVING.md)."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.serving.router")
+    if mod is None:
+        return []
+    return mod.collect_metrics()
 
 
 def _after_fork_child():

@@ -205,6 +205,18 @@ class UIServer:
                 body["elastic"] = status
         except Exception:
             pass  # a broken status provider must never break the probe
+        try:
+            import sys
+
+            # serving section (docs/SERVING.md): per-model queue depth,
+            # p50/p99 latency, shed counts, drain state — same sys.modules
+            # guard as elastic, so a liveness probe never imports serving
+            _serving = sys.modules.get("deeplearning4j_tpu.serving.router")
+            status = _serving.current_status() if _serving else {}
+            if status:
+                body["serving"] = status
+        except Exception:
+            pass
         return json.dumps(body), ok
 
     # ------------------------------------------------------------- rendering

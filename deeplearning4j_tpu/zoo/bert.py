@@ -44,6 +44,9 @@ class Bert(ZooModel):
     task: str = "classification"
     num_classes: int = 2
     flash: object = "auto"  # True | False | "auto" (measured-crossover dispatch)
+    causal: bool = False  # decoder-only (GPT-style) blocks — with
+    # task="mlm" this is an autoregressive LM whose per-token softmax head
+    # drives the KV-cache serving path (serving/generate.py)
 
     @classmethod
     def base(cls, **kw):
@@ -77,7 +80,7 @@ class Bert(ZooModel):
             lb.layer(TransformerEncoderBlock(
                 hidden_size=self.hidden_size, n_heads=self.n_heads,
                 ffn_size=self.ffn_size, hidden_dropout=self.hidden_dropout,
-                flash=self.flash))
+                flash=self.flash, causal=self.causal))
         if self.task == "classification":
             lb.layer(TimeStepLayer(index=0))  # [CLS]
             lb.layer(DenseLayer(n_in=self.hidden_size, n_out=self.hidden_size,

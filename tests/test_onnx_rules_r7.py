@@ -130,3 +130,48 @@ class TestNonMaxSuppression:
             np.stack([np.full(3, b), np.full(3, c), per], axis=-1)
             for b in range(2) for c in range(2)])
         np.testing.assert_array_equal(sel, expected)
+
+
+# ---------------------------------------------------------------------------
+# BitShift (r13 WAIVED.md burn-down): elementwise integer shift, direction
+# attribute LEFT/RIGHT, wired to the registry shift_left/shift_right ops.
+# ---------------------------------------------------------------------------
+
+from deeplearning4j_tpu.imports import protomini as pm  # noqa: E402
+from test_imports import _onnx_tensor  # noqa: E402
+
+
+def _onnx_attr_s(name, v):
+    return pm.f_str(1, name) + pm.f_str(4, v) + pm.f_varint(20, 3)
+
+
+class TestBitShift:
+    def _model(self, x, y, direction):
+        return _onnx_model(
+            nodes=[_onnx_node("BitShift", ["x", "s"], ["y"],
+                              _onnx_attr_s("direction", direction))],
+            initializers=[_onnx_tensor("x", x), _onnx_tensor("s", y)],
+            inputs=[], outputs=["y"])
+
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int32])
+    def test_left(self, dtype):
+        x = np.asarray([1, 2, 3, 7], dtype)
+        s = np.asarray([1, 2, 0, 3], dtype)
+        (y,) = _run(self._model(x, s, "LEFT"), {}, ["y"])
+        np.testing.assert_array_equal(y, np.left_shift(x, s))
+
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int32])
+    def test_right(self, dtype):
+        x = np.asarray([16, 4, 1, 255 if np.dtype(dtype) == np.uint8
+                        else 1024], dtype)
+        s = np.asarray([1, 2, 1, 3], dtype)
+        (y,) = _run(self._model(x, s, "RIGHT"), {}, ["y"])
+        np.testing.assert_array_equal(y, np.right_shift(x, s))
+
+    def test_broadcast_and_bad_direction(self):
+        x = np.arange(6, dtype=np.int32).reshape(2, 3)
+        s = np.asarray([1], np.int32)
+        (y,) = _run(self._model(x, s, "LEFT"), {}, ["y"])
+        np.testing.assert_array_equal(y, np.left_shift(x, 1))
+        with pytest.raises(ValueError, match="direction"):
+            _run(self._model(x, s, "UP"), {}, ["y"])
