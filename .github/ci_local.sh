@@ -93,6 +93,18 @@ echo "== step: Serving smoke (model server + continuous batching + drain) =="
 # /healthz serving surfaces, graceful drain -> 503.
 JAX_PLATFORMS=cpu python benchmarks/serving_smoke.py
 
+echo "== step: Kernel-engine equivalence (Pallas interpret, fused optimizer) =="
+# ISSUE 9: the hot-path kernel suite with the dispatch knob FORCED to
+# pallas — off-TPU that is the Pallas interpreter, bit-faithful to the
+# kernel block program — under 8 virtual devices for the ZeRO-sharded
+# fused-buffer leg: conv fwd/grads grid vs lax conv, LSTM cell/sequence/
+# TBPTT trajectories vs the exact scan, fused optimizer bit-identity vs
+# per-leaf, dynamic loss-scale skip/grow, masked flash vs exact.
+DL4J_TPU_KERNEL_IMPL=pallas \
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/test_kernels.py -q
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

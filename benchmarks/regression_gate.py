@@ -65,6 +65,9 @@ LOWER_BETTER = {
     # serving tier (ISSUE 8): request latency gates downward, its QPS
     # companion (serving_qps) gates upward via the higher-is-better default
     "serving_p99_latency_ms",
+    # kernel engine (ISSUE 9): the update phase's fraction of attributed
+    # device time — the fused donated optimizer apply must keep it down
+    "optimizer_update_ms_share",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

@@ -51,12 +51,25 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   host die), ``inject_nan`` (poison one batch), ``sigkill_host`` (kill
   this process). Read once at first injector access; unknown kinds raise.
   Unset = no faults (the injector costs one dict lookup per seam).
-- ``DL4J_TPU_PEAK_FLOPS`` — the accelerator's peak FLOP/s for the compute
-  dtype in use (e.g. ``1.97e14`` for a TPU v5e chip in bf16). Enables MFU
-  (model FLOPs utilization) in ``net.cost_report()``, the ``/costs`` route,
-  and the ``train.model_flops_utilization`` telemetry gauge
-  (util/cost_model.py, docs/OBSERVABILITY.md). Unset = throughput is still
-  reported, utilization is not (no silent guesses about the hardware).
+- ``DL4J_TPU_PEAK_FLOPS`` — the accelerator's peak FLOP/s, either a bare
+  number (``1.97e14``) or a per-dtype table (``bf16=1.97e14,fp32=9.85e13``
+  — TPU peaks differ ~2x by dtype, so a bf16 run must not compute MFU
+  against the fp32 roof). Enables MFU (model FLOPs utilization) in
+  ``net.cost_report()`` (which looks up its conf's compute dtype in the
+  table), the ``/costs`` route, and the
+  ``train.model_flops_utilization`` telemetry gauge (util/cost_model.py,
+  docs/OBSERVABILITY.md). Unset = throughput is still reported,
+  utilization is not (no silent guesses about the hardware).
+- ``DL4J_TPU_KERNEL_IMPL`` — default hot-path kernel dispatch for new
+  configs and direct op calls ("auto" | "exact" | "pallas" —
+  ops/kernels/, docs/KERNELS.md): ``auto`` engages the hand-tiled Pallas
+  conv/LSTM kernels only on the TPU backend, ``exact`` pins the XLA-HLO
+  reference path, ``pallas`` forces the kernels (Pallas interpreter on
+  CPU — the correctness-test mode).
+- ``DL4J_TPU_FUSED_UPDATE`` — default ``fused_update`` for new configs:
+  the optimizer apply runs over dtype-grouped contiguous buffers in the
+  donated train step instead of walking the param tree per leaf
+  (docs/KERNELS.md#fused-optimizer-apply).
 """
 
 from __future__ import annotations
@@ -102,6 +115,11 @@ class Environment:
         if self.default_remat_policy == "none":
             self.default_remat_policy = None
         self.default_sync_every = _env_int("DL4J_TPU_SYNC_EVERY", 1, floor=1)
+        # hot-path kernel engine defaults (ops/kernels/, docs/KERNELS.md);
+        # None = the ops-level resolver's own env/auto fallback applies
+        self.default_kernel_impl = (
+            os.environ.get("DL4J_TPU_KERNEL_IMPL") or None)
+        self.default_fused_update = _env_bool("DL4J_TPU_FUSED_UPDATE")
         self.etl_workers = _env_int("DL4J_TPU_ETL_WORKERS", 0, floor=0)
         self.default_buckets = os.environ.get("DL4J_TPU_BUCKETS") or None
         self.compile_cache_dir = (

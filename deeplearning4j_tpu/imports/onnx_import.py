@@ -1712,6 +1712,27 @@ def _o_window(m, node):
         name=node.outputs[0]))
 
 
+@orule("MelWeightMatrix")
+def _o_mel_weight_matrix(m, node):
+    """Opset-17 mel filterbank generator wired to the registry
+    ``mel_weight_matrix`` op (the r7 WAIVED.md row burned down — the waiver
+    was absence-of-demand, not difficulty; ROADMAP item 5). All five inputs
+    are scalars that must fold to constants (the op IS a constant
+    generator); ``output_datatype`` follows the TensorProto enum."""
+    num_mel_bins = int(m.const(node.inputs[0]))
+    dft_length = int(m.const(node.inputs[1]))
+    sample_rate = int(m.const(node.inputs[2]))
+    lower = float(m.const(node.inputs[3]))
+    upper = float(m.const(node.inputs[4]))
+    dtype = _DTYPES.get(node.attr("output_datatype", 1), np.float32)
+    from deeplearning4j_tpu.ops.signal import mel_weight_matrix
+
+    arr = np.asarray(mel_weight_matrix(
+        num_mel_bins, dft_length, sample_rate, lower, upper, dtype=dtype))
+    cvar = m.sd.constant(arr, name=node.outputs[0])
+    m.set(node.outputs[0], cvar, const_val=arr)
+
+
 @orule("DFT")
 def _o_dft(m, node):
     # input: (..., n, 1) real or (..., n, 2) real/imag pairs

@@ -81,6 +81,13 @@ class ComputationGraphConfiguration:
     # jitted step compiles once per bucket. None | "pow2" | explicit tuple.
     batch_buckets: Any = None
     seq_buckets: Any = None
+    # Hot-path kernel engine + fused optimizer apply (docs/KERNELS.md):
+    # same knobs as MultiLayerConfiguration.
+    kernel_impl: Optional[str] = None
+    fused_update: bool = False
+    loss_scale: str = "none"
+    loss_scale_value: float = 2.0 ** 15
+    loss_scale_growth: int = 2000
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -102,6 +109,11 @@ class ComputationGraphConfiguration:
                 "sync_every": self.sync_every,
                 "batch_buckets": _buckets_to_json(self.batch_buckets),
                 "seq_buckets": _buckets_to_json(self.seq_buckets),
+                "kernel_impl": self.kernel_impl,
+                "fused_update": self.fused_update,
+                "loss_scale": self.loss_scale,
+                "loss_scale_value": self.loss_scale_value,
+                "loss_scale_growth": self.loss_scale_growth,
                 "nodes": [
                     {
                         "name": n.name,
@@ -146,6 +158,11 @@ class ComputationGraphConfiguration:
             sync_every=d.get("sync_every", 1),
             batch_buckets=_buckets_from_json(d.get("batch_buckets")),
             seq_buckets=_buckets_from_json(d.get("seq_buckets")),
+            kernel_impl=d.get("kernel_impl"),
+            fused_update=d.get("fused_update", False),
+            loss_scale=d.get("loss_scale", "none"),
+            loss_scale_value=d.get("loss_scale_value", 2.0 ** 15),
+            loss_scale_growth=d.get("loss_scale_growth", 2000),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -262,6 +279,11 @@ class GraphBuilder:
             sync_every=getattr(self._p, "_sync_every", 1),
             batch_buckets=getattr(self._p, "_batch_buckets", None),
             seq_buckets=getattr(self._p, "_seq_buckets", None),
+            kernel_impl=getattr(self._p, "_kernel_impl", None),
+            fused_update=getattr(self._p, "_fused_update", False),
+            loss_scale=getattr(self._p, "_loss_scale", "none"),
+            loss_scale_value=getattr(self._p, "_loss_scale_value", 2.0 ** 15),
+            loss_scale_growth=getattr(self._p, "_loss_scale_growth", 2000),
         )
 
 
@@ -327,6 +349,13 @@ class ComputationGraph:
                     n.node.updater or conf.updater or upd.Sgd(0.1)
                 )
         self._rng_key = jax.random.PRNGKey(conf.seed)
+        # fused donated optimizer apply (docs/KERNELS.md): built in init()
+        self._fused = None
+        if (getattr(conf, "loss_scale", "none") != "none"
+                and not getattr(conf, "fused_update", False)):
+            raise ValueError(
+                "loss_scale requires fused_update=True — the scale "
+                "automaton lives in the fused optimizer state")
         node_names = {n.name for n in self.topo}
         for name in conf.outputs:
             if name not in node_names:
@@ -456,10 +485,21 @@ class ComputationGraph:
                 self.params[n.name] = {}
                 self.states[n.name] = {}
                 shape_of[n.name] = tuple(n.node.output_shape(*in_shapes))
-        self.opt_states = {
-            name: self._updaters[name].init_state(self.params[name])
-            for name in self._updaters
-        }
+        if getattr(self.conf, "fused_update", False):
+            self._fused = upd.FusedUpdateEngine(
+                self._updaters,
+                {k: self.params[k] for k in self._updaters},
+                loss_scale=getattr(self.conf, "loss_scale", "none"),
+                loss_scale_value=getattr(self.conf, "loss_scale_value",
+                                         2.0 ** 15),
+                growth_interval=getattr(self.conf, "loss_scale_growth", 2000))
+            self.opt_states = self._fused.init_state(
+                {k: self.params[k] for k in self._updaters})
+        else:
+            self.opt_states = {
+                name: self._updaters[name].init_state(self.params[name])
+                for name in self._updaters
+            }
         self._shape_of = shape_of
         self._train_step = self._jit_train_step()
         self._forward_jit = jax.jit(functools.partial(self._forward, training=False))
@@ -550,10 +590,23 @@ class ComputationGraph:
             return node.layer, node.source
         return node, name
 
+    def _kscope(self):
+        """Kernel-dispatch scope for every trace of this graph's layers
+        (ops/kernels — docs/KERNELS.md)."""
+        from deeplearning4j_tpu.ops import kernels as _kern
+
+        return _kern.impl_scope(getattr(self.conf, "kernel_impl", None))
+
     def _forward(self, params, states, inputs, *, training, keys=None,
                  mask=None):
         """inputs: dict name->array. Returns (dict name->activation, states)."""
         note_trace("ComputationGraph.forward", inputs, mask)  # trace-time only
+        with self._kscope():
+            return self._forward_body(params, states, inputs,
+                                      training=training, keys=keys, mask=mask)
+
+    def _forward_body(self, params, states, inputs, *, training, keys=None,
+                      mask=None):
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
         new_states = dict(states)
@@ -579,6 +632,12 @@ class ComputationGraph:
         """Sum of output-layer losses + regularization. labels: dict
         output-name -> labels array. ``mask``/``label_mask``: (B,T) feature/
         label masks for sequence graphs (single shared mask, like MLN)."""
+        with self._kscope():
+            return self._loss_body(params, states, inputs, labels, keys,
+                                   weights, mask, label_mask)
+
+    def _loss_body(self, params, states, inputs, labels, keys, weights=None,
+                   mask=None, label_mask=None):
         if self._segments is not None and mask is None and label_mask is None:
             # fusion-boundary path: stage-segmented remat/barriers (masked
             # sequence graphs keep the plain path — masks thread through the
@@ -749,6 +808,13 @@ class ComputationGraph:
         """_loss variant for one TBPTT segment: recurrent nodes take carries
         in and hand carries out; gradients truncate at the segment boundary
         because the incoming carry is a plain argument."""
+        with self._kscope():
+            return self._loss_tbptt_body(params, states, carries, inputs,
+                                         labels, keys, mask, label_mask,
+                                         weights)
+
+    def _loss_tbptt_body(self, params, states, carries, inputs, labels, keys,
+                         mask=None, label_mask=None, weights=None):
         acts = {k: self._cast(v) for k, v in inputs.items()}
         cparams = self._cast_params(params)
         new_states = dict(states)
@@ -813,20 +879,29 @@ class ComputationGraph:
                        mask, label_mask)
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
-            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                self._loss_tbptt, has_aux=True
-            )(params, states, carries, inputs, labels, keys, mask, label_mask,
-              weights)
-            new_params, new_opts = dict(params), dict(opts)
+            engine = self._fused
+            scale = engine.current_scale(opts) if engine is not None else None
+            (_, ((new_states, new_carries), loss)), grads = \
+                jax.value_and_grad(
+                    upd.FusedUpdateEngine.wrap_scaled(self._loss_tbptt,
+                                                      scale),
+                    has_aux=True)(
+                    params, states, carries, inputs, labels, keys, mask,
+                    label_mask, weights)
             with cmod.optimizer_scope():  # cost attribution: (optimizer) row
-                for name in layer_names:
-                    if not grads[name]:
-                        continue
-                    p, s = upd.apply_updater(
-                        updaters[name], params[name], grads[name], opts[name],
-                        iteration)
-                    new_params[name] = p
-                    new_opts[name] = s
+                if engine is not None:
+                    new_params, new_opts = engine.apply(
+                        params, grads, opts, iteration)
+                else:
+                    new_params, new_opts = dict(params), dict(opts)
+                    for name in layer_names:
+                        if not grads[name]:
+                            continue
+                        p, s = upd.apply_updater(
+                            updaters[name], params[name], grads[name],
+                            opts[name], iteration)
+                        new_params[name] = p
+                        new_opts[name] = s
             return new_params, new_states, new_opts, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -1060,20 +1135,29 @@ class ComputationGraph:
                           else {out_name: labels})
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
-            (loss, new_states), grads = jax.value_and_grad(self._loss, has_aux=True)(
-                params, states, inputs, labels, keys, weights, mask, label_mask
-            )
-            new_params, new_opts = dict(params), dict(opt_states)
+            engine = self._fused
+            scale = engine.current_scale(opt_states) if engine is not None \
+                else None
+            (_, (new_states, loss)), grads = jax.value_and_grad(
+                upd.FusedUpdateEngine.wrap_scaled(self._loss, scale),
+                has_aux=True
+            )(params, states, inputs, labels, keys, weights, mask,
+              label_mask)
             with cmod.optimizer_scope():  # cost attribution: (optimizer) row
-                for name in layer_names:
-                    if not grads[name]:
-                        continue
-                    p, s = upd.apply_updater(
-                        updaters[name], params[name], grads[name],
-                        opt_states[name], iteration,
-                    )
-                    new_params[name] = p
-                    new_opts[name] = s
+                if engine is not None:
+                    new_params, new_opts = engine.apply(
+                        params, grads, opt_states, iteration)
+                else:
+                    new_params, new_opts = dict(params), dict(opt_states)
+                    for name in layer_names:
+                        if not grads[name]:
+                            continue
+                        p, s = upd.apply_updater(
+                            updaters[name], params[name], grads[name],
+                            opt_states[name], iteration,
+                        )
+                        new_params[name] = p
+                        new_opts[name] = s
             return new_params, new_states, new_opts, loss
 
         if weighted:
@@ -1382,7 +1466,8 @@ class ComputationGraph:
             params_total=self.num_params(), source=source, model=str(name),
             step_time_s=step_time, device_time_s=device_time,
             peak_flops=(peak_flops if peak_flops is not None
-                        else _cm.peak_flops_from_env()))
+                        else _cm.peak_flops_from_env(
+                            self.conf.compute_dtype)))
         self._cost_flops_per_example = report.flops_per_step / b
         self._peak_flops = report.peak_flops
         if publish:

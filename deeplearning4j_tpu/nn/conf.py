@@ -69,6 +69,20 @@ class MultiLayerConfiguration:
     # an explicit size tuple per axis — see data/bucketing.py.
     batch_buckets: Any = None
     seq_buckets: Any = None
+    # Hot-path kernel engine (docs/KERNELS.md): "auto" | "exact" | "pallas"
+    # pins the conv/LSTM dispatch for this net's traces; None defers to the
+    # ambient DL4J_TPU_KERNEL_IMPL env knob (which itself defaults to auto).
+    kernel_impl: Optional[str] = None
+    # Fused donated optimizer apply (docs/KERNELS.md#fused-optimizer-apply):
+    # flatten the param pytree into dtype-grouped contiguous buffers and run
+    # each updater rule ONCE per group instead of per-leaf. Bit-identical to
+    # the per-leaf walk for fp32 params; prerequisite for loss scaling.
+    fused_update: bool = False
+    # Loss-scaling policy for sub-fp32 gradients (arXiv:1710.03740):
+    # "none" | "static" | "dynamic" (skip-on-nonfinite + growth automaton).
+    loss_scale: str = "none"
+    loss_scale_value: float = 2.0 ** 15
+    loss_scale_growth: int = 2000
 
     def to_json(self) -> str:
         return json.dumps(
@@ -85,6 +99,11 @@ class MultiLayerConfiguration:
                 "sync_every": self.sync_every,
                 "batch_buckets": _buckets_to_json(self.batch_buckets),
                 "seq_buckets": _buckets_to_json(self.seq_buckets),
+                "kernel_impl": self.kernel_impl,
+                "fused_update": self.fused_update,
+                "loss_scale": self.loss_scale,
+                "loss_scale_value": self.loss_scale_value,
+                "loss_scale_growth": self.loss_scale_growth,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -117,6 +136,11 @@ class MultiLayerConfiguration:
             sync_every=d.get("sync_every", 1),
             batch_buckets=_buckets_from_json(d.get("batch_buckets")),
             seq_buckets=_buckets_from_json(d.get("seq_buckets")),
+            kernel_impl=d.get("kernel_impl"),
+            fused_update=d.get("fused_update", False),
+            loss_scale=d.get("loss_scale", "none"),
+            loss_scale_value=d.get("loss_scale_value", 2.0 ** 15),
+            loss_scale_growth=d.get("loss_scale_growth", 2000),
         )
 
 
@@ -172,6 +196,15 @@ class Builder:
         self._sync_every = env.default_sync_every
         self._batch_buckets = None
         self._seq_buckets = None
+        # hot-path kernel engine + fused optimizer (docs/KERNELS.md);
+        # kernel_impl None defers to the DL4J_TPU_KERNEL_IMPL env knob
+        from deeplearning4j_tpu.ops import kernels as _kern
+
+        self._kernel_impl = _kern.validate_impl(env.default_kernel_impl)
+        self._fused_update = env.default_fused_update
+        self._loss_scale = "none"
+        self._loss_scale_value = 2.0 ** 15
+        self._loss_scale_growth = 2000
         if env.default_buckets:
             from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 
@@ -278,6 +311,46 @@ class Builder:
         self._seq_buckets = spec
         return self
 
+    def kernel_impl(self, impl: Optional[str]) -> "Builder":
+        """Pin the hot-path kernel dispatch (docs/KERNELS.md):
+        ``"auto"`` (Pallas only where measured to win, on TPU), ``"exact"``
+        (XLA-HLO reference path), ``"pallas"`` (force the kernels — the
+        Pallas interpreter on non-TPU backends, for correctness tests).
+        ``None`` defers to the DL4J_TPU_KERNEL_IMPL env knob."""
+        from deeplearning4j_tpu.ops import kernels as _kern
+
+        self._kernel_impl = _kern.validate_impl(impl)
+        return self
+
+    def fused_update(self, on: bool = True) -> "Builder":
+        """Fused donated optimizer apply (docs/KERNELS.md): the whole-net
+        update phase runs as a few contiguous-buffer ops (one per
+        (updater rule, dtype) group) instead of a per-leaf tree walk.
+        Bit-identical trajectories for fp32 params; required for
+        ``loss_scale``."""
+        self._fused_update = bool(on)
+        return self
+
+    def loss_scale(self, policy: str, value: float = 2.0 ** 15,
+                   growth_interval: int = 2000) -> "Builder":
+        """Loss-scaling policy for sub-fp32 gradient safety
+        (arXiv:1710.03740): "none" | "static" | "dynamic". Dynamic skips
+        any step with non-finite gradients (halving the scale) and doubles
+        the scale after ``growth_interval`` consecutive good steps.
+        Requires ``fused_update`` (the scale automaton lives in the fused
+        optimizer state)."""
+        if policy not in ("none", "static", "dynamic"):
+            raise ValueError(
+                f"loss_scale must be none|static|dynamic, got {policy!r}")
+        if policy != "none" and not self._fused_update:
+            raise ValueError(
+                "loss_scale requires fused_update(True) — the scale "
+                "automaton lives in the fused optimizer state")
+        self._loss_scale = policy
+        self._loss_scale_value = float(value)
+        self._loss_scale_growth = int(growth_interval)
+        return self
+
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
@@ -349,4 +422,9 @@ class ListBuilder:
             sync_every=self._p._sync_every,
             batch_buckets=self._p._batch_buckets,
             seq_buckets=self._p._seq_buckets,
+            kernel_impl=self._p._kernel_impl,
+            fused_update=self._p._fused_update,
+            loss_scale=self._p._loss_scale,
+            loss_scale_value=self._p._loss_scale_value,
+            loss_scale_growth=self._p._loss_scale_growth,
         )

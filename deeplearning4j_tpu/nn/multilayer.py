@@ -74,6 +74,14 @@ class MultiLayerNetwork:
         self._updaters = [
             (lyr.updater or conf.updater or upd.Sgd(0.1)) for lyr in conf.layers
         ]
+        # fused donated optimizer apply (docs/KERNELS.md#fused-optimizer-
+        # apply): built in init() once params exist; None = per-leaf walk
+        self._fused = None
+        if (getattr(conf, "loss_scale", "none") != "none"
+                and not getattr(conf, "fused_update", False)):
+            raise ValueError(
+                "loss_scale requires fused_update=True — the scale "
+                "automaton lives in the fused optimizer state")
         self._rng_key = jax.random.PRNGKey(conf.seed)
         # Mask plumbing (setLayerMaskArrays/feedForwardMaskArray parity):
         # which layers' apply()/compute_loss() accept a mask kwarg.
@@ -154,9 +162,18 @@ class MultiLayerNetwork:
             self.params.append(p)
             self.states.append(s)
             cur = lyr.output_shape(cur)
-        self.opt_states = [
-            u.init_state(p) for u, p in zip(self._updaters, self.params)
-        ]
+        if getattr(self.conf, "fused_update", False):
+            self._fused = upd.FusedUpdateEngine(
+                self._updaters, self.params,
+                loss_scale=getattr(self.conf, "loss_scale", "none"),
+                loss_scale_value=getattr(self.conf, "loss_scale_value",
+                                         2.0 ** 15),
+                growth_interval=getattr(self.conf, "loss_scale_growth", 2000))
+            self.opt_states = self._fused.init_state(self.params)
+        else:
+            self.opt_states = [
+                u.init_state(p) for u, p in zip(self._updaters, self.params)
+            ]
         self._output_shape = cur
         self._train_step = self._build_train_step()
         self._forward_jit = jax.jit(functools.partial(self._forward, training=False))
@@ -167,6 +184,14 @@ class MultiLayerNetwork:
         return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))
 
     # --------------------------------------------------------------- forward
+    def _kscope(self):
+        """Kernel-dispatch scope for every trace of this net's layers
+        (ops/kernels — docs/KERNELS.md). conf.kernel_impl None leaves the
+        ambient DL4J_TPU_KERNEL_IMPL / auto resolution in place."""
+        from deeplearning4j_tpu.ops import kernels as _kern
+
+        return _kern.impl_scope(getattr(self.conf, "kernel_impl", None))
+
     def _cast(self, x):
         if self.conf.compute_dtype == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(jnp.bfloat16)
@@ -182,6 +207,12 @@ class MultiLayerNetwork:
 
     def _forward(self, params, states, x, *, training, keys=None, mask=None):
         note_trace("MultiLayerNetwork.forward", x, mask)  # trace-time only
+        with self._kscope():
+            return self._forward_body(params, states, x, training=training,
+                                      keys=keys, mask=mask)
+
+    def _forward_body(self, params, states, x, *, training, keys=None,
+                      mask=None):
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states = []
@@ -211,6 +242,12 @@ class MultiLayerNetwork:
         layers through ``apply_seq`` (TBPTT segments). ``weights``: optional
         per-example loss weights (ParallelWrapper uses zeros to mask padded
         examples exactly). ``mask``/``label_mask``: (B,T) masks."""
+        with self._kscope():
+            return self._loss_body_impl(params, states, carries, x, y, keys,
+                                        weights, mask, label_mask, training)
+
+    def _loss_body_impl(self, params, states, carries, x, y, keys, weights,
+                        mask, label_mask, training=True):
         h = self._cast(x)
         cparams = self._cast_params(params)
         new_states, new_carries = [], []
@@ -279,6 +316,10 @@ class MultiLayerNetwork:
         ``stage_barriers`` fences fusion at the boundaries. Exact same values
         and gradients as the plain path (remat only changes what XLA keeps
         live across fwd/bwd)."""
+        with self._kscope():
+            return self._loss_remat_impl(params, states, x, y, keys, weights)
+
+    def _loss_remat_impl(self, params, states, x, y, keys, weights=None):
         from deeplearning4j_tpu.util import xla_tuning
 
         spans, tail_start = self._segments
@@ -337,26 +378,37 @@ class MultiLayerNetwork:
         loss-weight argument."""
         updaters = self._updaters
         n_layers = len(self.layers)
+        engine = self._fused
 
         def step(params, states, opt_states, iteration, x, y, key, weights=None,
                  mask=None, label_mask=None):
             keys = list(jax.random.split(key, n_layers))
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss, has_aux=True
+            scale = engine.current_scale(opt_states) if engine is not None \
+                else None
+            # loss scaling (arXiv:1710.03740): gradients come out scale x
+            # true (the fused apply unscales them); the aux threads the
+            # UNSCALED loss for reporting. One trace shape with/without.
+            (_, (new_states, loss)), grads = jax.value_and_grad(
+                upd.FusedUpdateEngine.wrap_scaled(self._loss, scale),
+                has_aux=True
             )(params, states, x, y, keys, weights, mask, label_mask)
-            new_params, new_opts = [], []
             with cmod.optimizer_scope():  # cost attribution: (optimizer) row
-                for i in range(n_layers):
-                    if not grads[i]:
-                        new_params.append(params[i])
-                        new_opts.append(opt_states[i])
-                        continue
-                    p, s = upd.apply_updater(
-                        updaters[i], params[i], grads[i], opt_states[i],
-                        iteration
-                    )
-                    new_params.append(p)
-                    new_opts.append(s)
+                if engine is not None:
+                    new_params, new_opts = engine.apply(
+                        params, grads, opt_states, iteration)
+                else:
+                    new_params, new_opts = [], []
+                    for i in range(n_layers):
+                        if not grads[i]:
+                            new_params.append(params[i])
+                            new_opts.append(opt_states[i])
+                            continue
+                        p, s = upd.apply_updater(
+                            updaters[i], params[i], grads[i], opt_states[i],
+                            iteration
+                        )
+                        new_params.append(p)
+                        new_opts.append(s)
             return new_params, new_states, new_opts, loss
 
         if weighted:
@@ -433,32 +485,37 @@ class MultiLayerNetwork:
         (MultiLayerNetwork.doTruncatedBPTT parity — SURVEY.md §5.7.)"""
         updaters = self._updaters
         n_layers = len(self.layers)
-
-        def seg_loss(params, states, carries, x, y, keys, weights, mask,
-                     label_mask):
-            return self._loss_body(params, states, carries, x, y, keys,
-                                   weights, mask, label_mask)
+        engine = self._fused
 
         def step(params, states, opt_states, carries, iteration, x, y, key,
                  mask, label_mask, weights=None):
             note_trace("MultiLayerNetwork.tbptt_step", x, y, weights, mask,
                        label_mask)
             keys = list(jax.random.split(key, n_layers))
-            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                seg_loss, has_aux=True
-            )(params, states, carries, x, y, keys, weights, mask, label_mask)
-            new_params, new_opts = [], []
+            scale = engine.current_scale(opt_states) if engine is not None \
+                else None
+            (_, ((new_states, new_carries), loss)), grads = \
+                jax.value_and_grad(
+                    upd.FusedUpdateEngine.wrap_scaled(self._loss_body, scale),
+                    has_aux=True)(
+                    params, states, carries, x, y, keys, weights, mask,
+                    label_mask)
             with cmod.optimizer_scope():  # cost attribution: (optimizer) row
-                for i in range(n_layers):
-                    if not grads[i]:
-                        new_params.append(params[i])
-                        new_opts.append(opt_states[i])
-                        continue
-                    p, s = upd.apply_updater(
-                        updaters[i], params[i], grads[i], opt_states[i],
-                        iteration)
-                    new_params.append(p)
-                    new_opts.append(s)
+                if engine is not None:
+                    new_params, new_opts = engine.apply(
+                        params, grads, opt_states, iteration)
+                else:
+                    new_params, new_opts = [], []
+                    for i in range(n_layers):
+                        if not grads[i]:
+                            new_params.append(params[i])
+                            new_opts.append(opt_states[i])
+                            continue
+                        p, s = upd.apply_updater(
+                            updaters[i], params[i], grads[i], opt_states[i],
+                            iteration)
+                        new_params.append(p)
+                        new_opts.append(s)
             return new_params, new_states, new_opts, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -866,7 +923,8 @@ class MultiLayerNetwork:
             params_total=self.num_params(), source=source, model=str(name),
             step_time_s=step_time, device_time_s=device_time,
             peak_flops=(peak_flops if peak_flops is not None
-                        else _cm.peak_flops_from_env()))
+                        else _cm.peak_flops_from_env(
+                            self.conf.compute_dtype)))
         self._cost_flops_per_example = report.flops_per_step / b
         self._peak_flops = report.peak_flops
         if publish:

@@ -140,10 +140,28 @@ class LSTM(BaseRecurrentLayer):
         g_act = act.resolve(self.gate_activation)
         # hoist the input projection out of the scan: one MXU matmul for all T
         xp = x @ params["W"].astype(x.dtype) + params["b"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+
+        # kernel-engine dispatch (docs/KERNELS.md): the fused Pallas cell
+        # replaces the scan body's matmul + gate chain with ONE kernel;
+        # mask/TBPTT handling stays in _scan, shared with the exact path
+        from deeplearning4j_tpu.ops import kernels as _kern
+        from deeplearning4j_tpu.ops.kernels import lstm as _klstm
+
+        mode = _kern.dispatch(_klstm.supports(
+            xp[:, 0] if xp.ndim == 3 else xp, U,
+            self.gate_activation, self.activation))
+        if mode is not None:
+            def step(c, xt):
+                h_new, c_new = _klstm.lstm_cell_fused(
+                    xt, c[0], c[1], U, _klstm.ORDER_IFOG, mode)
+                return (h_new, c_new), h_new
+
+            return self._scan(step, carry, xp, mask)
 
         def step(c, xt):
             h_prev, c_prev = c
-            z = xt + h_prev @ params["U"].astype(xt.dtype)
+            z = xt + h_prev @ U
             i, f, o, g = jnp.split(z, 4, axis=-1)
             c_new = g_act(f) * c_prev + g_act(i) * f_act(g)
             h_new = g_act(o) * f_act(c_new)

@@ -369,9 +369,19 @@ class TransferLearningHelper:
             jnp.array, self.net.params[self.frozen_until + 1:])
         tail.states = jax.tree_util.tree_map(
             jnp.array, self.net.states[self.frozen_until + 1:])
-        tail.opt_states = [
-            u.init_state(p) for u, p in zip(tail._updaters, tail.params)
-        ]
+        if getattr(conf, "fused_update", False):
+            from deeplearning4j_tpu.nn.updaters import FusedUpdateEngine
+
+            tail._fused = FusedUpdateEngine(
+                tail._updaters, tail.params,
+                loss_scale=getattr(conf, "loss_scale", "none"),
+                loss_scale_value=getattr(conf, "loss_scale_value", 2.0 ** 15),
+                growth_interval=getattr(conf, "loss_scale_growth", 2000))
+            tail.opt_states = tail._fused.init_state(tail.params)
+        else:
+            tail.opt_states = [
+                u.init_state(p) for u, p in zip(tail._updaters, tail.params)
+            ]
         tail._train_step = None
         tail._forward_jit = jax.jit(functools.partial(tail._forward, training=False))
         tail._forward_train_jit = jax.jit(functools.partial(tail._forward, training=True))
@@ -387,4 +397,9 @@ class TransferLearningHelper:
         for off, i in enumerate(range(self.frozen_until + 1, len(self.net.layers))):
             self.net.params[i] = tail.params[off]
             self.net.states[i] = tail.states[off]
+        if getattr(self.net, "_fused", None) is not None:
+            # fused engine invariant: params written outside the train step
+            # must resync the resident master buffers (nn/updaters.py)
+            self.net.opt_states = self.net._fused.resync_masters(
+                self.net.params, self.net.opt_states)
         return self.net

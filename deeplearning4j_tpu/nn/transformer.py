@@ -10,8 +10,10 @@ natively in MultiLayerNetwork/ComputationGraph, with the TF-import path
 TPU-native: [B,T,H] layout; each block is two residual sublayers whose
 matmuls XLA tiles onto the MXU; attention picks the exact or Pallas flash
 path by the measured crossover (``flash="auto"``, the default — flash from
-1024 tokens on TPU, BASELINE.md; the Pallas path has no padding-mask
-support, so masked batches always use the exact path).
+1024 tokens on TPU, BASELINE.md). The Pallas path takes (B,T) padding
+masks since r14 (key blocks masked inside the kernel, masked-vs-exact
+equivalence pinned in tests/test_kernels.py); only full [B,1|H,Tq,Tk]
+attention masks still force the exact path.
 """
 
 from __future__ import annotations
@@ -157,7 +159,8 @@ class TransformerEncoderBlock(Layer):
         t = x.shape[1]
         q, k, v = self._qkv(params, x)
         if attn_ops.resolve_flash(self.flash, t, t, mask):
-            o = attn_ops.flash_attention(q, k, v, causal=self.causal)
+            o = attn_ops.flash_attention(q, k, v, causal=self.causal,
+                                         mask=mask)
         else:
             amask = None if mask is None else mask[:, None, None, :].astype(bool)
             o = attn_ops.dot_product_attention(q, k, v, mask=amask,

@@ -34,24 +34,29 @@ from deeplearning4j_tpu.ops.registry import op
 _NEG_BIG = -1e30
 
 
-def online_softmax_update(q, k, v, m, l, acc, scale, q_pos=None, k_pos=None):
+def online_softmax_update(q, k, v, m, l, acc, scale, q_pos=None, k_pos=None,
+                          kv_mask=None):
     """One online-softmax block update (the flash-attention inner step).
 
     q: [..., sq, d]; k/v: [..., bk, d]; m/l: [..., sq] f32; acc: [..., sq, d]
     f32. If q_pos/k_pos are given, applies the causal mask k_pos <= q_pos.
-    Shared by the blockwise-scan forward and the ring-attention body so the
-    numerically subtle m/l/acc correction exists exactly once.
+    ``kv_mask``: optional per-key padding mask broadcastable to s's
+    [..., sq, bk] (1/True = attend). Shared by the blockwise-scan forward
+    and the ring-attention body so the numerically subtle m/l/acc
+    correction exists exactly once.
     """
     s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     causal = q_pos is not None
     if causal:
         s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, _NEG_BIG)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask, s, _NEG_BIG)
     m_cur = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_cur)
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
-    if causal:
+    if causal or kv_mask is not None:
         # fully-masked rows: keep the spurious exp(0) mass out of l/acc
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, p)
     l_new = corr * l + jnp.sum(p, axis=-1)
@@ -112,7 +117,8 @@ def dot_product_attention(
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
-                      scale, causal, block_q, block_k, nk, kv_offset):
+                      scale, causal, block_q, block_k, nk, kv_offset,
+                      mask_ref=None):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -134,13 +140,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + kv_offset
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(k_pos <= q_pos, s, _NEG_BIG)
+    if mask_ref is not None:
+        # (1, bk) per-key padding block, broadcast over the bq query rows
+        s = jnp.where(mask_ref[...] > 0.0, s, _NEG_BIG)
 
     m_prev = m_s[:, 0]  # (bq,)
     m_cur = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m_prev, m_cur)
     corr = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new[:, None])  # (bq, bk)
-    if causal:
+    if causal or mask_ref is not None:
         # fully-masked rows: keep p's spurious exp(0) mass out of l/acc
         p = jnp.where((s <= _NEG_BIG / 2), 0.0, p)
     l_new = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
@@ -161,7 +170,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         lse_ref[0] = (m_s[:, 0] + jnp.log(safe_l))[:, None]
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret,
+                      mask=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -174,18 +184,33 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
     vf = v.reshape(b * h, sk, d)
     nq, nk = sq // bq, sk // bk
 
-    kernel = functools.partial(
+    base = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         nk=nk, kv_offset=sk - sq,
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if mask is None:
+        kernel = base
+    else:
+        # (B, Sk) padding mask, one (1, bk) key block per (batch, ki) —
+        # the head axis folds away in the index map (bh // h)
+        def kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, acc, m_s,
+                   l_s):
+            base(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
+                 mask_ref=m_ref)
+
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, qi, ki, h=h: (bh // h, ki)))
+        operands.append(mask.astype(jnp.float32))
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -200,44 +225,50 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)  # lse (BH,Sq,1) → (B,H,Sq)
 
 
-def _flash_fwd_jnp(q, k, v, scale, causal, block_k):
+def _flash_fwd_jnp(q, k, v, scale, causal, block_k, mask=None):
     """Blockwise online-softmax forward in pure JAX (lax.scan over KV blocks).
 
     Same math as the Pallas kernel; used off-TPU and anywhere Pallas can't run.
-    Returns (out, lse)."""
+    ``mask``: optional (B, Sk) padding mask. Returns (out, lse)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bk = min(block_k, sk)
     nk = sk // bk
     kb = jnp.moveaxis(k.reshape(b, h, nk, bk, d), 2, 0)  # (nk, b,h,bk,d)
     vb = jnp.moveaxis(v.reshape(b, h, nk, bk, d), 2, 0)
+    mb = None if mask is None else jnp.moveaxis(
+        (mask > 0).reshape(b, nk, bk), 1, 0)             # (nk, b, bk)
     qf = q.astype(jnp.float32)
     q_pos = jnp.arange(sq) + (sk - sq)
 
     def body(carry, inp):
         m, l, acc, j = carry
-        kj, vj = inp
+        kj, vj = inp[0], inp[1]
+        kv_mask = None if mb is None else inp[2][:, None, None, :]
         kp = j * bk + jnp.arange(bk) if causal else None
         m, l, acc = online_softmax_update(
             qf, kj, vj, m, l, acc, scale,
-            q_pos=q_pos if causal else None, k_pos=kp)
+            q_pos=q_pos if causal else None, k_pos=kp, kv_mask=kv_mask)
         return (m, l, acc, j + 1), None
 
     m0 = jnp.full((b, h, sq), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     a0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    (m, l, acc, _), _ = lax.scan(body, (m0, l0, a0, jnp.int32(0)), (kb, vb))
+    seqs = (kb, vb) if mb is None else (kb, vb, mb)
+    (m, l, acc, _), _ = lax.scan(body, (m0, l0, a0, jnp.int32(0)), seqs)
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / safe_l[..., None]).astype(q.dtype)
     return out, m + jnp.log(safe_l)
 
 
-def _flash_bwd(scale, causal, block_k, res, do):
-    """Flash-attention backward: blockwise recomputation over KV blocks."""
+def _flash_bwd(scale, causal, block_k, res, do, mask=None):
+    """Flash-attention backward: blockwise recomputation over KV blocks.
+    ``mask``: optional (B, Sk) padding mask, reapplied to the recomputed
+    scores exactly as in the forward."""
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -247,17 +278,21 @@ def _flash_bwd(scale, causal, block_k, res, do):
     delta = jnp.sum(dof * of, axis=-1)  # (b,h,sq)
     kb = jnp.moveaxis(k.reshape(b, h, nk, bk, d), 2, 0)
     vb = jnp.moveaxis(v.reshape(b, h, nk, bk, d), 2, 0)
+    mb = None if mask is None else jnp.moveaxis(
+        (mask > 0).reshape(b, nk, bk), 1, 0)             # (nk, b, bk)
     q_pos = jnp.arange(sq) + (sk - sq)
 
     def body(carry, inp):
         dq, j = carry
-        kj, vj = inp
+        kj, vj = inp[0], inp[1]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32)) * scale
         if causal:
             k_pos = j * bk + jnp.arange(bk)
             s = jnp.where(k_pos[None, None, None, :] <= q_pos[None, None, :, None], s, _NEG_BIG)
+        if mb is not None:
+            s = jnp.where(inp[2][:, None, None, :], s, _NEG_BIG)
         p = jnp.exp(s - lse[..., None])
-        if causal:
+        if causal or mb is not None:
             # fully-masked rows have s == lse == -1e30 → exp(0) = 1; their
             # forward output is zeroed, so their gradient mass must be too
             p = jnp.where(s <= _NEG_BIG / 2, 0.0, p)
@@ -269,7 +304,8 @@ def _flash_bwd(scale, causal, block_k, res, do):
         return (dq, j + 1), (dk_j, dv_j)
 
     dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    (dq, _), (dkb, dvb) = lax.scan(body, (dq0, jnp.int32(0)), (kb, vb))
+    seqs = (kb, vb) if mb is None else (kb, vb, mb)
+    (dq, _), (dkb, dvb) = lax.scan(body, (dq0, jnp.int32(0)), seqs)
     dk = jnp.moveaxis(dkb, 0, 2).reshape(b, h, sk, d)
     dv = jnp.moveaxis(dvb, 0, 2).reshape(b, h, sk, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
@@ -301,6 +337,47 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, use_pallas, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# -- padding-masked variant (the r14 gap burn-down: nn/transformer.py used
+# to force the exact path for any masked batch) ------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q, k, v, mask, scale, causal, block_q, block_k,
+                  use_pallas):
+    o, _ = _flash_masked_fwd_dispatch(q, k, v, mask, scale, causal, block_q,
+                                      block_k, use_pallas)
+    return o
+
+
+def _flash_masked_fwd_dispatch(q, k, v, mask, scale, causal, block_q,
+                               block_k, use_pallas):
+    if use_pallas == "interpret":
+        return _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                                 True, mask=mask)
+    if use_pallas and jax.default_backend() == "tpu":
+        return _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                                 False, mask=mask)
+    return _flash_fwd_jnp(q, k, v, scale, causal, block_k, mask=mask)
+
+
+def _flash_masked_vjp_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                          use_pallas):
+    o, lse = _flash_masked_fwd_dispatch(q, k, v, mask, scale, causal,
+                                        block_q, block_k, use_pallas)
+    return o, (q, k, v, o, lse, mask)
+
+
+def _flash_masked_vjp_bwd(scale, causal, block_q, block_k, use_pallas, res,
+                          do):
+    q, k, v, o, lse, mask = res
+    dq, dk, dv = _flash_bwd(scale, causal, block_k, (q, k, v, o, lse), do,
+                            mask=mask)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_flash_masked.defvjp(_flash_masked_vjp_fwd, _flash_masked_vjp_bwd)
+
+
 # Measured crossover on the real chip (BASELINE.md round-3 table; fwd+bwd,
 # bf16, BERT-base head geometry, token count held constant): flash/naive
 # speedup by seq — 128: 1.00, 512: 0.70 (one 512-token block degenerates to
@@ -312,11 +389,13 @@ FLASH_MIN_SEQ = 1024
 def resolve_flash(flash, seq_q, seq_k, mask=None) -> bool:
     """Auto-dispatch rule for the attention layers: ``flash`` may be True,
     False, or "auto" (pick the Pallas path when the measured crossover says
-    it wins — TPU backend, no padding mask, seq >= FLASH_MIN_SEQ)."""
+    it wins — TPU backend, seq >= FLASH_MIN_SEQ). A (B, Tk) PADDING mask is
+    flash-eligible since r14 (the kernel masks key blocks in-place); full
+    [B, 1|H, Tq, Tk] attention masks still force the exact path."""
     if flash not in (True, False, "auto"):
         raise ValueError(
             f"flash must be True, False, or 'auto'; got {flash!r}")
-    if mask is not None:
+    if mask is not None and jnp.asarray(mask).ndim != 2:
         return False
     if flash == "auto":
         return (jax.default_backend() == "tpu"
@@ -334,20 +413,35 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     use_pallas=True,
+    mask=None,
 ):
     """Memory-efficient attention: [B,H,S,D] → [B,H,S,D], O(S) memory.
 
     Pallas kernel on TPU (``use_pallas="interpret"`` forces the interpreter for
-    CPU tests), blockwise lax.scan elsewhere. Sequence lengths must divide the
-    effective block sizes; callers fall back to ``dot_product_attention``
-    otherwise (the nn layers do this automatically).
+    CPU tests), blockwise lax.scan elsewhere. ``mask``: optional (B, Sk)
+    PADDING mask (1 = attend) applied to key blocks inside the kernel —
+    masked-vs-exact equivalence is pinned in tests/test_kernels.py. Sequence
+    lengths must divide the effective block sizes; callers fall back to
+    ``dot_product_attention`` otherwise (the nn layers do this
+    automatically).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     sq, sk = q.shape[2], k.shape[2]
     bq, bk = min(block_q, sq), min(block_k, sk)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.ndim != 2:
+            raise ValueError(
+                "flash_attention mask must be a (B, Sk) padding mask; full "
+                f"attention masks take the exact path (got ndim {mask.ndim})")
     if sq % bq or sk % bk:
-        return dot_product_attention(q, k, v, scale=scale, causal=causal)
+        amask = None if mask is None else mask[:, None, None, :]
+        return dot_product_attention(q, k, v, mask=amask, scale=scale,
+                                     causal=causal)
+    if mask is not None:
+        return _flash_masked(q, k, v, mask.astype(jnp.float32),
+                             float(scale), bool(causal), bq, bk, use_pallas)
     return _flash(q, k, v, float(scale), bool(causal), bq, bk, use_pallas)
 
 
@@ -393,7 +487,8 @@ def multi_head_dot_product_attention(
     k = _split_heads(keys @ Wk, n_heads)
     v = _split_heads(values @ Wv, n_heads)
     if resolve_flash(flash, q.shape[2], k.shape[2], mask):
-        o = flash_attention(q, k, v, scale=scale, causal=causal)
+        pmask = None if mask is None else jnp.asarray(mask)
+        o = flash_attention(q, k, v, scale=scale, causal=causal, mask=pmask)
     else:
         amask = None
         if mask is not None:

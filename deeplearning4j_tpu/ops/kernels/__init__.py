@@ -1,0 +1,96 @@
+"""Hot-path Pallas kernel engine: dispatch seam + conv/LSTM kernels.
+
+ROADMAP item 2 ("custom Pallas/Mosaic kernels for the conv + LSTM +
+attention hot paths") following the cuDNN (arXiv:1410.0759) / TVM
+(arXiv:1802.04799) playbook: hand-tiled primitives behind a framework-level
+dispatch seam, so the framework code never hard-codes a vendor path. The
+seam is the SAME exact-or-kernel pattern ``ops/attention.py`` established
+for flash attention, generalized:
+
+- ``kernel_impl``: ``"auto" | "exact" | "pallas"``. ``auto`` picks the
+  Pallas kernel only where it can win (TPU backend, supported
+  layout/dtype); ``exact`` always takes the XLA-HLO reference path;
+  ``pallas`` forces the kernel — on a non-TPU backend it runs the Pallas
+  INTERPRETER (bit-faithful to the kernel's block program), which is how
+  the correctness suite (tests/test_kernels.py) proves kernel==exact on
+  CPU containers.
+- Resolution order: explicit ``impl_scope(...)`` context (the nets stamp
+  their conf's ``kernel_impl`` here around every trace) > the
+  ``DL4J_TPU_KERNEL_IMPL`` env knob > ``"auto"``.
+
+Every kernel is gated by equivalence proofs against the exact path
+(docs/KERNELS.md lists the tolerances); CPU containers cannot RANK the
+kernels against XLA:TPU's convs — they can only prove value/grad
+equivalence — so the flagship default stays ``auto`` until a real-chip
+sweep says otherwise (the r6 honesty convention).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+from typing import Optional
+
+import jax
+
+_VALID = ("auto", "exact", "pallas")
+
+# trace-time override (MultiLayerNetwork/ComputationGraph stamp their conf
+# knob here around every forward/loss trace); None = fall through to env
+_impl_override: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dl4j_kernel_impl", default=None)
+
+
+def validate_impl(impl: Optional[str]) -> Optional[str]:
+    if impl is not None and impl not in _VALID:
+        raise ValueError(
+            f"kernel_impl must be one of {_VALID}, got {impl!r}")
+    return impl
+
+
+@contextlib.contextmanager
+def impl_scope(impl: Optional[str]):
+    """Pin the kernel dispatch for the dynamic extent (trace time). ``None``
+    leaves the ambient resolution (env knob / auto) in place."""
+    validate_impl(impl)
+    tok = _impl_override.set(impl) if impl is not None else None
+    try:
+        yield
+    finally:
+        if tok is not None:
+            _impl_override.reset(tok)
+
+
+def resolve_impl() -> str:
+    """Effective kernel_impl: scope override > DL4J_TPU_KERNEL_IMPL > auto."""
+    impl = _impl_override.get()
+    if impl is None:
+        impl = os.environ.get("DL4J_TPU_KERNEL_IMPL") or "auto"
+    if impl not in _VALID:
+        raise ValueError(
+            f"DL4J_TPU_KERNEL_IMPL must be one of {_VALID}, got {impl!r}")
+    return impl
+
+
+def dispatch(supported: bool) -> Optional[str]:
+    """The one dispatch rule. Returns ``None`` (take the exact path),
+    ``"pallas"`` (compiled kernel), or ``"interpret"`` (Pallas interpreter —
+    the forced-``pallas`` path on non-TPU backends, for correctness tests).
+
+    ``supported``: whether the call site's geometry/dtype has a kernel
+    (callers compute this — e.g. conv requires NHWC + HWIO + f32/bf16)."""
+    if not supported:
+        return None
+    impl = resolve_impl()
+    if impl == "exact":
+        return None
+    on_tpu = jax.default_backend() == "tpu"
+    if impl == "auto":
+        # CPU cannot rank the kernels (docs/KERNELS.md honesty note): auto
+        # only ever engages the compiled kernel on the real chip
+        return "pallas" if on_tpu else None
+    return "pallas" if on_tpu else "interpret"
+
+
+from deeplearning4j_tpu.ops.kernels import conv, lstm  # noqa: E402,F401

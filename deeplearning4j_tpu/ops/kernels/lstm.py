@@ -1,0 +1,188 @@
+"""Fused LSTM cell Pallas kernel + the scan-fused sequence path.
+
+Reference parity: the cuDNN LSTM kernel the source framework's
+CudnnLSTMHelper dispatches to (path-cite, mount empty) — one fused kernel
+per step doing the recurrent matmul and the whole gate/elementwise block,
+instead of separate GEMM + pointwise launches.
+
+TPU-native shape (docs/KERNELS.md):
+
+- The input projection ``x @ W + b`` for ALL timesteps stays hoisted out of
+  the scan as one big MXU matmul (the r1 design — nn/recurrent.py); the
+  kernel fuses what remains on the critical path: ``z = xp_t + h @ U``
+  (the (B,H)x(H,4H) recurrent product) plus the sigmoid/tanh gate block and
+  the c/h state update, in ONE Pallas program — the per-step HLO the exact
+  path leaves as matmul + 10 pointwise ops becomes a single kernel with the
+  gate math running on the VPU while the MXU product's tiles drain.
+- The sequence path is the same ``lax.scan`` the exact path uses, with the
+  fused cell as the body — XLA still sees one compiled loop (TBPTT
+  segments and masks compose unchanged).
+- Backward is a hand-written VJP from the saved (xp, h, c, U) residuals —
+  the standard LSTM adjoint, written once in jnp so XLA fuses it; the scan
+  transposes it into BPTT automatically.
+
+Gate order is a static parameter: nn/recurrent.py's layers split z as
+[i, f, o, g]; the ONNX-semantics ops/rnn.py ``lstm_layer`` splits as
+[i, o, f, g]. Only the default sigmoid/tanh activation pair has a kernel —
+exotic activations take the exact path (dispatch gate in
+:func:`supports`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_F32 = jnp.float32
+ORDER_IFOG: Tuple[str, ...] = ("i", "f", "o", "g")   # DL4J layer order
+ORDER_IOFG: Tuple[str, ...] = ("i", "o", "f", "g")   # ONNX lstm_layer order
+
+
+def fits_vmem(xp, u) -> bool:
+    """The cell kernel takes xp (B,4H), h, c (B,H), U (H,4H) as whole
+    unblocked VMEM operands plus the fp32 z/gates working set — same
+    honesty guard as conv's fits_vmem: oversized cells stay on the exact
+    path instead of faulting the chip (H-blocked tiling is the known next
+    step if the real-chip sweep wants bigger cells)."""
+    from deeplearning4j_tpu.ops.kernels.conv import VMEM_BUDGET_BYTES
+
+    b, four_h = xp.shape
+    h = four_h // 4
+    itemsize = jnp.dtype(xp.dtype).itemsize
+    operands = (b * four_h + 2 * b * h + h * four_h) * itemsize
+    working = (b * four_h * 2 + 2 * b * h) * 4        # fp32 z, gates, c/h
+    return operands + working <= VMEM_BUDGET_BYTES
+
+
+def supports(xp, u, gate_activation: str, activation: str) -> bool:
+    """Kernel gate: default sigmoid/tanh cell, f32/bf16, (B,4H)x(H,4H),
+    VMEM-sized."""
+    if gate_activation.lower() != "sigmoid" or activation.lower() != "tanh":
+        return False
+    if xp.dtype not in (jnp.float32, jnp.bfloat16) or u.dtype != xp.dtype:
+        return False
+    if xp.ndim != 2 or u.ndim != 2:
+        return False
+    h = u.shape[0]
+    if u.shape[1] != 4 * h or xp.shape[1] != 4 * h:
+        return False
+    if jax.default_backend() == "tpu" and h % 128:
+        return False  # compiled Mosaic wants lane-aligned H; exact otherwise
+    return fits_vmem(xp, u)
+
+
+def _gates(z, h, order):
+    """Slice z (..., 4H) into the i/f/o/g roles per the static order."""
+    idx = {role: order.index(role) for role in ("i", "f", "o", "g")}
+    pick = lambda r: lax.slice_in_dim(z, idx[r] * h, (idx[r] + 1) * h,  # noqa: E731
+                                      axis=z.ndim - 1)
+    return pick("i"), pick("f"), pick("o"), pick("g")
+
+
+def _cell_kernel(xp_ref, h_ref, c_ref, u_ref, ho_ref, co_ref, *, hidden,
+                 order):
+    z = xp_ref[...].astype(_F32) + lax.dot_general(
+        h_ref[...].astype(_F32), u_ref[...].astype(_F32),
+        (((1,), (0,)), ((), ())), preferred_element_type=_F32)
+    zi, zf, zo, zg = _gates(z, hidden, order)
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    o = jax.nn.sigmoid(zo)
+    g = jnp.tanh(zg)
+    c_new = f * c_ref[...].astype(_F32) + i * g
+    ho_ref[...] = (o * jnp.tanh(c_new)).astype(ho_ref.dtype)
+    co_ref[...] = c_new.astype(co_ref.dtype)
+
+
+def _cell_pallas(xp, h, c, u, order, interpret):
+    from jax.experimental import pallas as pl
+
+    b, hidden = h.shape
+    kernel = functools.partial(_cell_kernel, hidden=hidden, order=order)
+    return pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((b, hidden), xp.dtype),
+                   jax.ShapeDtypeStruct((b, hidden), xp.dtype)],
+        interpret=interpret,
+    )(xp, h, c, u)
+
+
+def _cell_exact(xp, h, c, u, order):
+    """Same math in plain jnp (fp32 accumulation) — the VJP recompute body
+    and the non-TPU fallback inside lstm_cell_fused."""
+    z = xp.astype(_F32) + h.astype(_F32) @ u.astype(_F32)
+    zi, zf, zo, zg = _gates(z, h.shape[-1], order)
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    o = jax.nn.sigmoid(zo)
+    g = jnp.tanh(zg)
+    c_new = f * c.astype(_F32) + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new, (i, f, o, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def lstm_cell_fused(xp, h, c, u, order, mode):
+    """One LSTM step: ``xp`` (B, 4H) pre-projected input (+ bias), ``h``/
+    ``c`` (B, H), ``u`` (H, 4H). Returns (h_new, c_new) in xp's dtype.
+    ``mode``: "pallas" | "interpret" (see kernels.dispatch)."""
+    h_new, c_new = _cell_fwd_impl(xp, h, c, u, order, mode)
+    return h_new, c_new
+
+
+def _cell_fwd_impl(xp, h, c, u, order, mode):
+    if mode == "interpret":
+        return _cell_pallas(xp, h, c, u, order, True)
+    if mode == "pallas" and jax.default_backend() == "tpu":
+        return _cell_pallas(xp, h, c, u, order, False)
+    h_new, c_new, _ = _cell_exact(xp, h, c, u, order)
+    return h_new.astype(xp.dtype), c_new.astype(xp.dtype)
+
+
+def _cell_vjp_fwd(xp, h, c, u, order, mode):
+    out = _cell_fwd_impl(xp, h, c, u, order, mode)
+    return out, (xp, h, c, u)
+
+
+def _cell_vjp_bwd(order, mode, res, cts):
+    """The LSTM adjoint from recomputed gates (one fused elementwise block
+    + two matmuls — XLA fuses it; the scan transpose turns it into BPTT)."""
+    xp, h, c, u = res
+    dh, dc = (t.astype(_F32) for t in cts)
+    _h_new, c_new, (i, f, o, g) = _cell_exact(xp, h, c, u, order)
+    tc = jnp.tanh(c_new)
+    d_o = dh * tc * o * (1.0 - o)
+    dct = dc + dh * o * (1.0 - tc * tc)
+    d_f = dct * c.astype(_F32) * f * (1.0 - f)
+    d_i = dct * g * i * (1.0 - i)
+    d_g = dct * i * (1.0 - g * g)
+    parts = {"i": d_i, "f": d_f, "o": d_o, "g": d_g}
+    dz = jnp.concatenate([parts[r] for r in order], axis=-1)   # (B, 4H)
+    dxp = dz.astype(xp.dtype)
+    dh_prev = (dz @ u.astype(_F32).T).astype(h.dtype)
+    dc_prev = (dct * f).astype(c.dtype)
+    du = (h.astype(_F32).T @ dz).astype(u.dtype)
+    return dxp, dh_prev, dc_prev, du
+
+
+lstm_cell_fused.defvjp(_cell_vjp_fwd, _cell_vjp_bwd)
+
+
+def lstm_sequence_fused(xp, h0, c0, u, order=ORDER_IFOG, mode="pallas"):
+    """Whole-sequence fused path: ``xp`` (T, B, 4H) time-major pre-projected
+    inputs, states (B, H). One ``lax.scan`` whose body is the fused cell.
+    Returns (ys (T, B, H), (h_fin, c_fin)). Mask/TBPTT handling stays with
+    the callers (nn/recurrent.py wraps the step, ops/rnn.py masks the
+    outputs) so the kernel path and the exact path share that logic."""
+
+    def body(carry, xt):
+        h, c = carry
+        h_new, c_new = lstm_cell_fused(xt, h, c, u, order, mode)
+        return (h_new, c_new), h_new
+
+    (h_fin, c_fin), ys = lax.scan(body, (h0, c0), xp)
+    return ys, (h_fin, c_fin)

@@ -73,8 +73,32 @@ def conv2d(
 
     x: [N,H,W,C] (NHWC) or [N,C,H,W] (NCHW); w: [kH,kW,Cin/groups,Cout] (HWIO).
     Reference: libnd4j generic/nn/convo/conv2d.cpp (+ cudnn/conv2d.cu fast path);
-    here a single ``convolution`` HLO on the MXU.
+    here a single ``convolution`` HLO on the MXU — or the hand-tiled Pallas
+    kernel engine (ops/kernels/conv.py) when the ``kernel_impl`` dispatch
+    seam selects it (docs/KERNELS.md): NHWC f32/bf16 geometries with full
+    stride/dilation/groups support, custom VJP running the Pallas
+    input/filter-gradient kernels, proven fwd/grad-equivalent to this exact
+    path in tests/test_kernels.py.
     """
+    from deeplearning4j_tpu.ops import kernels as _kern
+    from deeplearning4j_tpu.ops.kernels import conv as _kconv
+
+    if _kconv.supports(jnp.asarray(x), jnp.asarray(w), data_format,
+                       feature_group_count, preferred_element_type):
+        strides_p, dil_p = _pair(strides), _pair(dilation)
+        pads = _kconv.resolve_padding(
+            padding, (x.shape[1], x.shape[2]), (w.shape[0], w.shape[1]),
+            strides_p, dil_p)
+        mode = _kern.dispatch(_kconv.fits_vmem(
+            x.shape, w.shape, pads, feature_group_count,
+            jnp.dtype(x.dtype).itemsize))
+        if mode is not None:
+            out = _kconv.conv2d_pallas(x, w, strides_p, pads, dil_p,
+                                       feature_group_count,
+                                       mode == "interpret")
+            if b is not None:
+                out = out + b.reshape(1, 1, 1, -1).astype(out.dtype)
+            return checkpoint_name(out, _CONV_OUT)
     dn = lax.conv_dimension_numbers(
         x.shape,
         w.shape,

@@ -94,12 +94,42 @@ def lstm_layer(x, W, R, b=None, seq_lens=None, h0=None, c0=None, *,
     f_g = _act(gate_activation)
     f_c = _act(activation)
     outs, hs, cs = [], [], []
+    from deeplearning4j_tpu.ops import kernels as _kern
+    from deeplearning4j_tpu.ops.kernels import lstm as _klstm
+
     for d, reverse in enumerate(_directions(direction)):
         Wd, Rd = W[d].T, R[d].T           # (I,4H), (H,4H)
         bi, br = _split_b(b[d] if b is not None else None, 4, h)
         bias = (bi + br).astype(x.dtype)
         hd = jnp.zeros((B, h), x.dtype) if h0 is None else h0[d].astype(x.dtype)
         cd = jnp.zeros((B, h), x.dtype) if c0 is None else c0[d].astype(x.dtype)
+
+        # kernel-engine dispatch (docs/KERNELS.md): hoist the input
+        # projection out of the scan (one MXU matmul for all T) and run the
+        # recurrent matmul + gate block as the fused Pallas cell. ONNX gate
+        # order i,o,f,c maps to the kernel's static ORDER_IOFG.
+        Rd_x = jnp.asarray(Rd, x.dtype)
+        mode = _kern.dispatch(_klstm.supports(
+            jnp.zeros((B, 4 * h), x.dtype), Rd_x,
+            gate_activation, activation))
+        if mode is not None:
+            xp_all = x @ jnp.asarray(Wd, x.dtype) + bias   # (T, B, 4H)
+
+            def step(carry, xp_t, Rd_x=Rd_x):
+                hp, cp = carry
+                xt, t = xp_t
+                h_new, c_new = _klstm.lstm_cell_fused(
+                    xt, hp, cp, Rd_x, _klstm.ORDER_IOFG, mode)
+                c_new = _mask_step(c_new, cp, t, seq_lens)
+                h_new = _mask_step(h_new, hp, t, seq_lens)
+                return (h_new, c_new), h_new
+
+            (hd, cd), ys = _scan_dir(step, xp_all, (hd, cd), seq_lens,
+                                     reverse)
+            outs.append(ys)
+            hs.append(hd)
+            cs.append(cd)
+            continue
 
         def step(carry, xt_t, Wd=Wd, Rd=Rd, bias=bias):
             hp, cp = carry

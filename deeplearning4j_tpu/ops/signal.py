@@ -110,6 +110,58 @@ def stft(signal, window=None, *, frame_length, frame_step, onesided=True):
         else jnp.fft.fft(frames.astype(jnp.complex64), axis=-1)
 
 
+@op("mel_weight_matrix", "signal", differentiable=False)
+def mel_weight_matrix(num_mel_bins, dft_length, sample_rate,
+                      lower_edge_hertz, upper_edge_hertz,
+                      dtype=jnp.float32):
+    """Mel filterbank matrix, ONNX ``MelWeightMatrix`` semantics (opset 17;
+    the r7 WAIVED.md row burned down — ROADMAP item 5 scenario sweep).
+
+    Output: [dft_length // 2 + 1, num_mel_bins] triangular filters whose
+    center frequencies are uniform on the HTK mel scale
+    (``mel = 2595 * log10(1 + hz / 700)``) between the lower/upper edges,
+    with the spec's integer-bin rounding
+    (``bin = ((dft_length + 1) * hz) // sample_rate``). Computed host-side
+    in numpy — it is a 5-scalar-input CONSTANT generator (the importer
+    folds it), not device math."""
+    num_mel_bins = int(num_mel_bins)
+    dft_length = int(dft_length)
+    sample_rate = int(sample_rate)
+    if num_mel_bins < 1 or dft_length < 1 or sample_rate < 1:
+        raise ValueError(
+            "mel_weight_matrix: num_mel_bins, dft_length and sample_rate "
+            "must be positive")
+    num_spectrogram_bins = dft_length // 2 + 1
+    # num_mel_bins + 2 mel-uniform edge points (ONNX reference semantics:
+    # the step divides by the POINT count, and bins round by floor-divide)
+    points = np.arange(num_mel_bins + 2, dtype=np.float64)
+    low_mel = 2595.0 * np.log10(1.0 + float(lower_edge_hertz) / 700.0)
+    high_mel = 2595.0 * np.log10(1.0 + float(upper_edge_hertz) / 700.0)
+    mel_step = (high_mel - low_mel) / points.shape[0]
+    hz = 700.0 * (np.power(10.0, (points * mel_step + low_mel) / 2595.0)
+                  - 1.0)
+    bins = (((dft_length + 1) * hz) // sample_rate).astype(np.int64)
+    # scratch taller than the output: the spec's bin formula can land past
+    # the last spectrogram bin (e.g. upper edge at Nyquist x2); those rows
+    # are sliced away, matching the reference's output[:bins] truncation
+    height = max(num_spectrogram_bins, int(bins.max()) + 1)
+    out = np.zeros((height, num_mel_bins), np.float64)
+    for i in range(num_mel_bins):
+        lo, center, hi = bins[i], bins[i + 1], bins[i + 2]
+        if center == lo:
+            out[center, i] = 1.0
+        else:
+            for j in range(lo, center + 1):
+                out[j, i] = (j - lo) / float(center - lo)
+        if hi > center:
+            for j in range(center, hi):
+                out[j, i] = (hi - j) / float(hi - center)
+    # host numpy out (like ctc_beam_search_decoder): this is ETL-time
+    # constant prep, and numpy keeps the requested output_datatype even
+    # when the backend runs with x64 disabled
+    return out[:num_spectrogram_bins].astype(np.dtype(dtype))
+
+
 @op("complex_pack", "signal", differentiable=False)
 def complex_pack(x):
     """(..., 2) real/imag pairs -> complex (the ONNX DFT tensor layout)."""

@@ -23,7 +23,13 @@ that takes the parameter and returns the updated parameter directly.
 
 from __future__ import annotations
 
+import dataclasses
+import json
+from typing import Any, List, Tuple
+
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.ops.registry import op
@@ -33,6 +39,125 @@ def _single(updater, grad, state, iteration):
     """Run an nn.updaters rule on one tensor; states are passed positionally."""
     update, new_state = updater.apply(grad, state, iteration)
     return update, new_state
+
+
+# ---------------------------------------------------------------------------
+# Fused update buffers (docs/KERNELS.md#fused-optimizer-apply)
+#
+# The reference's UpdaterBlock machinery (BaseMultiLayerUpdater.java,
+# path-cite) flattens contiguous same-rule parameter views and calls ONE
+# native updater op per block instead of one per tensor — this is the same
+# idea expressed functionally: the param pytree flattens into dtype-grouped
+# contiguous 1-D buffers, each (updater rule, dtype) group's math runs ONCE
+# over its buffer inside the already-donated train step, and the result
+# slices back into leaves. Elementwise updater math is position-independent,
+# so the fused trajectory is BIT-identical to the per-leaf walk for fp32
+# groups (asserted in tests/test_kernels.py); sub-fp32 groups deliberately
+# diverge upward — they accumulate in an fp32 master buffer (mixed-precision
+# training, arXiv:1710.03740).
+#
+# Buffers pad to a multiple of _GROUP_PAD elements so ZeRO
+# (parallel/gspmd.zero_shardings) can shard the flat dimension across any
+# mesh that divides it — the padded tail updates like real elements and is
+# simply never read back.
+# ---------------------------------------------------------------------------
+
+_GROUP_PAD = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafRef:
+    """One parameter leaf's place inside a fused group buffer."""
+
+    coll_key: Any          # layer index (MLN) or node name (CG)
+    leaf_idx: int          # index into the collection's tree_leaves order
+    shape: Tuple[int, ...]
+    offset: int            # element offset into the group buffer
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape or (1,)))
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamGroup:
+    """One (updater rule, dtype) fused buffer: metadata only, no arrays."""
+
+    updater: Any
+    dtype: Any             # the PARAM storage dtype of every leaf in here
+    leaves: Tuple[LeafRef, ...]
+    total: int             # padded buffer length (multiple of _GROUP_PAD)
+
+    @property
+    def needs_master(self) -> bool:
+        """Sub-fp32 param groups carry an fp32 master buffer in the
+        optimizer state (fp32 groups' master IS the param buffer)."""
+        return jnp.dtype(self.dtype) != jnp.dtype(jnp.float32)
+
+
+def updater_signature(updater) -> str:
+    """Stable grouping key for an updater config (same rule + same
+    hyperparams + same schedule -> same group)."""
+    return json.dumps(updater.to_dict(), sort_keys=True, default=repr)
+
+
+def build_groups(keyed_params, keyed_updaters) -> List[ParamGroup]:
+    """``keyed_params``: ordered [(coll_key, param_tree)];
+    ``keyed_updaters``: {coll_key: updater}. Groups every float leaf by
+    (updater signature, dtype); non-float leaves (none exist today) would
+    stay on the per-leaf path and are rejected loudly instead."""
+    buckets: dict = {}
+    order: list = []
+    for coll_key, tree in keyed_params:
+        updater = keyed_updaters[coll_key]
+        for leaf_idx, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            dt = jnp.dtype(leaf.dtype)
+            if not jnp.issubdtype(dt, jnp.floating):
+                raise ValueError(
+                    f"fused updater: non-float param leaf {coll_key}/"
+                    f"{leaf_idx} ({dt}) has no fused rule")
+            gkey = (updater_signature(updater), str(dt))
+            if gkey not in buckets:
+                buckets[gkey] = (updater, dt, [])
+                order.append(gkey)
+            buckets[gkey][2].append(
+                (coll_key, leaf_idx, tuple(int(d) for d in leaf.shape)))
+    groups = []
+    for gkey in order:
+        updater, dt, entries = buckets[gkey]
+        refs, offset = [], 0
+        for coll_key, leaf_idx, shape in entries:
+            refs.append(LeafRef(coll_key, leaf_idx, shape, offset))
+            offset += int(np.prod(shape or (1,)))
+        total = -(-max(offset, 1) // _GROUP_PAD) * _GROUP_PAD
+        groups.append(ParamGroup(updater, dt, tuple(refs), total))
+    return groups
+
+
+def flatten_group(group: ParamGroup, leaves_by_key, cast_dtype=None):
+    """Concatenate the group's leaves into one padded 1-D buffer."""
+    parts = [leaves_by_key[r.coll_key][r.leaf_idx].reshape(-1)
+             for r in group.leaves]
+    used = sum(p.shape[0] for p in parts)
+    if cast_dtype is not None:
+        parts = [p.astype(cast_dtype) for p in parts]
+    pad = group.total - used
+    if pad:
+        parts.append(jnp.zeros((pad,), parts[0].dtype))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def unflatten_group(group: ParamGroup, buf, out, cast_dtype=None):
+    """Slice the buffer back into leaves, writing into
+    ``out[coll_key][leaf_idx]`` (a dict of mutable leaf lists)."""
+    from jax import lax
+
+    for r in group.leaves:
+        leaf = lax.slice_in_dim(buf, r.offset, r.offset + r.size, axis=0)
+        if cast_dtype is not None:
+            leaf = leaf.astype(cast_dtype)
+        out[r.coll_key][r.leaf_idx] = leaf.reshape(r.shape)
+    return out
 
 
 @op("sgd_updater", "updater", aliases=("sgdUpdater",))

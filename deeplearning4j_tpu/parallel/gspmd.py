@@ -185,7 +185,22 @@ def make_lane_tbptt_value_and_grad(model) -> Callable:
 
 def apply_updaters(model, params, grads, opt_states, iteration):
     """One updater application over the model's per-layer updaters — the
-    shared tail of every sharded step (MLN list / CG dict keyed)."""
+    shared tail of every sharded step (MLN list / CG dict keyed). A model
+    built with ``fused_update`` routes through its FusedUpdateEngine: the
+    flat per-(rule, dtype) buffers are exactly what ZeRO shards
+    (zero_shardings on the 1-D padded dimension), so the partitioner emits
+    reduce-scatter(grad buffer) -> sharded fused update ->
+    all-gather(params) with no extra plumbing."""
+    engine = getattr(model, "_fused", None)
+    if engine is not None:
+        if engine.loss_scale != "none":
+            raise NotImplementedError(
+                "loss_scale under ParallelWrapper is not wired: the lane "
+                "value-and-grad computes unscaled gradients, so the fused "
+                "unscale would corrupt them — run loss scaling on the "
+                "single-host fit path, or keep loss_scale='none' here")
+        with cmod.optimizer_scope():
+            return engine.apply(params, grads, opt_states, iteration)
     is_graph = isinstance(model._updaters, dict)
     updaters = model._updaters
     if is_graph:

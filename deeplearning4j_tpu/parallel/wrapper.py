@@ -527,7 +527,8 @@ class ParallelWrapper:
         report = _cm.CostReport(
             rows=rows, totals=totals, batch=b,
             params_total=model.num_params(), source=source, model=str(name),
-            peak_flops=_cm.peak_flops_from_env(),
+            peak_flops=_cm.peak_flops_from_env(
+                getattr(self.model.conf, "compute_dtype", None)),
             devices=self.mesh.n_devices)
         if publish:
             _cm.publish_report(str(name), report)

@@ -695,13 +695,58 @@ class CostRow:
         }
 
 
-def peak_flops_from_env() -> Optional[float]:
-    """DL4J_TPU_PEAK_FLOPS (config.py): the chip's peak FLOP/s for the
-    compute dtype in use — e.g. 1.97e14 for a v5e chip in bf16. Unset or
-    unparsable → no MFU is reported."""
+# canonical dtype keys for the per-dtype peak table; every alias a conf
+# compute_dtype or an env author might spell maps to one of these
+_PEAK_DTYPE_ALIASES = {
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp32": "fp32", "f32": "fp32", "float32": "fp32",
+    "fp16": "fp16", "f16": "fp16", "float16": "fp16",
+    "int8": "int8", "i8": "int8",
+    "fp64": "fp64", "f64": "fp64", "float64": "fp64",
+}
+
+
+def _canon_peak_dtype(dtype) -> Optional[str]:
+    if dtype is None:
+        return None
+    return _PEAK_DTYPE_ALIASES.get(str(dtype).strip().lower())
+
+
+def peak_flops_from_env(dtype=None) -> Optional[float]:
+    """DL4J_TPU_PEAK_FLOPS (config.py): the chip's peak FLOP/s. Accepts a
+    bare number (``1.97e14``) or a per-dtype table
+    (``bf16=1.97e14,fp32=9.85e13`` — TPU peaks differ ~2x by dtype, so a
+    bf16 run must not compute MFU against the fp32 roof). ``dtype`` is the
+    run's compute dtype ("bfloat16"/"float32"/... — aliases normalize);
+    with a table and no matching entry (or no dtype given) nothing is
+    guessed and no MFU is reported. Unset or unparsable → None."""
     v = os.environ.get("DL4J_TPU_PEAK_FLOPS")
     if not v or not v.strip():
         return None
+    v = v.strip()
+    if "=" in v:
+        table = {}
+        for part in v.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            ck = _canon_peak_dtype(key)
+            try:
+                f = float(val)
+            except ValueError:
+                continue
+            if ck is not None and f > 0:
+                table[ck] = f
+        # no dtype: a single-entry table is unambiguous; otherwise fall
+        # back to the fp32 entry (the historical bare-number meaning). An
+        # UNKNOWN dtype never guesses — no MFU beats a wrong MFU.
+        if dtype is None:
+            if len(table) == 1:
+                return next(iter(table.values()))
+            return table.get("fp32")
+        ck = _canon_peak_dtype(dtype)
+        return None if ck is None else table.get(ck)
     try:
         f = float(v)
     except ValueError:
@@ -760,11 +805,36 @@ class CostReport:
     @property
     def mfu(self) -> Optional[float]:
         """Model FLOPs utilization: achieved FLOP/s over the configured
-        peak (DL4J_TPU_PEAK_FLOPS). None unless both are known."""
+        peak (DL4J_TPU_PEAK_FLOPS — per-dtype aware: cost_report() passes
+        its conf's compute dtype into peak_flops_from_env). None unless
+        both are known."""
         a = self.achieved_flops_per_sec
         if a is None or not self.peak_flops:
             return None
         return a / self.peak_flops
+
+    @property
+    def optimizer_update_share(self) -> Optional[float]:
+        """Fraction of attributed per-step device time spent in the
+        optimizer update phase (the ``(optimizer)`` row from the
+        ``opt:update`` scope) — the number the fused donated apply
+        (docs/KERNELS.md#fused-optimizer-apply) is built to shrink; gated
+        as ``optimizer_update_ms_share`` in benchmarks/regression_gate.py.
+        None without a profiled run (``profile=True``)."""
+        total = 0.0
+        opt = 0.0
+        seen = False
+        for r in self.rows:
+            t = r.device_time_s
+            if t is None:
+                continue
+            seen = True
+            total += t
+            if r.layer == OPTIMIZER_ROW:
+                opt += t
+        if not seen or total <= 0.0:
+            return None
+        return opt / total
 
     def to_dict(self) -> dict:
         return {
@@ -783,6 +853,7 @@ class CostReport:
             "achieved_flops_per_sec": self.achieved_flops_per_sec,
             "peak_flops": self.peak_flops,
             "model_flops_utilization": self.mfu,
+            "optimizer_update_share": self.optimizer_update_share,
             "layers": [r.to_dict() for r in self.rows],
         }
 
@@ -831,6 +902,11 @@ class CostReport:
             lines.append(f"  MFU {100.0 * self.mfu:.2f}% of peak "
                          f"{fmt(self.peak_flops)}FLOP/s "
                          "(DL4J_TPU_PEAK_FLOPS)")
+        share = self.optimizer_update_share
+        if share is not None:
+            lines.append(
+                f"  optimizer update phase: {100.0 * share:.2f}% of "
+                "attributed device time")
         return "\n".join(lines)
 
 

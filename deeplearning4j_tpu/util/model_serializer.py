@@ -145,6 +145,13 @@ class ModelSerializer:
             net.states = _refill(net.states, _loadz(zf.read(_STATE)))
             if load_updater and meta.get("has_updater_state") and _UPDATER in zf.namelist():
                 net.opt_states = _refill(net.opt_states, _loadz(zf.read(_UPDATER)))
+            elif getattr(net, "_fused", None) is not None:
+                # fused engine invariant (nn/updaters.py): the resident
+                # master buffers were built from init()'s random params —
+                # resync them to the LOADED params, or the first fit() step
+                # would snap the trained weights back to random init
+                net.opt_states = net._fused.resync_masters(
+                    net.params, net.opt_states)
             net.iteration = meta["iteration"]
             net.epoch = meta["epoch"]
             net._rng_key = jax.numpy.asarray(

@@ -256,8 +256,12 @@ class TestFlashAutoDispatch:
 
     def test_resolve_flash_rules(self):
         rf = A.resolve_flash
-        # masks always force the exact path
-        assert rf(True, 4096, 4096, mask=object()) is False
+        # full [B,1|H,Tq,Tk] attention masks force the exact path; (B,Tk)
+        # PADDING masks are flash-eligible since r14 (the kernel masks key
+        # blocks in-place — equivalence pinned in tests/test_kernels.py)
+        assert rf(True, 4096, 4096,
+                  mask=jnp.ones((2, 1, 4096, 4096))) is False
+        assert rf(True, 4096, 4096, mask=jnp.ones((2, 4096))) is True
         # explicit booleans are respected
         assert rf(True, 128, 128) is True
         assert rf(False, 4096, 4096) is False

@@ -384,6 +384,7 @@ OVERRIDES = {
         XN, -jnp.ones(6), jnp.ones(6)),
     "compare_and_bitpack": lambda f: f(XN.reshape(3, 8), 0.0),
     # round-5: signal / sampler / loss ops backing the ONNX rule expansion
+    "mel_weight_matrix": lambda f: f(4, 16, 8192, 0.0, 4096.0),
     "hann_window": lambda f: f(8),
     "hamming_window": lambda f: f(8),
     "blackman_window": lambda f: f(8),
