@@ -105,6 +105,15 @@ JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/test_kernels.py -q
 
+echo "== step: Compression smoke (conservation + t->0 identity + wire ratio) =="
+# ISSUE 10: the encoded gradient all-reduce on 8 virtual devices —
+# error-feedback conservation bit-exact, threshold->0 fit bit-identical to
+# the uncompressed deterministic lane path, wire-bytes counter > 0 and
+# sparse ratio < 0.1 once the adaptive threshold reaches its target band.
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/compression_smoke.py
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

@@ -68,6 +68,10 @@ LOWER_BETTER = {
     # kernel engine (ISSUE 9): the update phase's fraction of attributed
     # device time — the fused donated optimizer apply must keep it down
     "optimizer_update_ms_share",
+    # encoded gradient collectives (ISSUE 10): one worker's encoded
+    # all-reduce payload vs its dense fp32 gradient on the 25M-param DP
+    # workload — the wire math is deterministic, so this band is tight
+    "encoded_allreduce_wire_bytes_ratio",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

@@ -70,6 +70,14 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   the optimizer apply runs over dtype-grouped contiguous buffers in the
   donated train step instead of walking the param tree per leaf
   (docs/KERNELS.md#fused-optimizer-apply).
+- ``DL4J_TPU_GRAD_COMPRESSION`` — default ``grad_compression`` for new
+  configs ("none" | "threshold" | "bitmap" | "onebit" —
+  parallel/compression.py, docs/DISTRIBUTED.md#gradient-compression):
+  ParallelWrapper then runs the encoded gradient all-reduce — per-worker
+  encode(grad + error-feedback residual), all-reduce of the quantized
+  payload, dense decode before the update. The reference's
+  EncodedGradientsAccumulator threshold/bitmap wire machinery, collapsed
+  into the one jit-compiled GSPMD step.
 """
 
 from __future__ import annotations
@@ -120,6 +128,10 @@ class Environment:
         self.default_kernel_impl = (
             os.environ.get("DL4J_TPU_KERNEL_IMPL") or None)
         self.default_fused_update = _env_bool("DL4J_TPU_FUSED_UPDATE")
+        # encoded gradient collectives default (parallel/compression.py);
+        # validated by the conf Builder so a typo fails at config build
+        self.default_grad_compression = (
+            os.environ.get("DL4J_TPU_GRAD_COMPRESSION") or None)
         self.etl_workers = _env_int("DL4J_TPU_ETL_WORKERS", 0, floor=0)
         self.default_buckets = os.environ.get("DL4J_TPU_BUCKETS") or None
         self.compile_cache_dir = (

@@ -88,6 +88,11 @@ class ComputationGraphConfiguration:
     loss_scale: str = "none"
     loss_scale_value: float = 2.0 ** 15
     loss_scale_growth: int = 2000
+    # Encoded gradient collectives (parallel/compression.py): same knobs as
+    # MultiLayerConfiguration.
+    grad_compression: str = "none"
+    grad_compression_threshold: float = 1e-3
+    grad_compression_target: float = 1e-3
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -114,6 +119,9 @@ class ComputationGraphConfiguration:
                 "loss_scale": self.loss_scale,
                 "loss_scale_value": self.loss_scale_value,
                 "loss_scale_growth": self.loss_scale_growth,
+                "grad_compression": self.grad_compression,
+                "grad_compression_threshold": self.grad_compression_threshold,
+                "grad_compression_target": self.grad_compression_target,
                 "nodes": [
                     {
                         "name": n.name,
@@ -163,6 +171,10 @@ class ComputationGraphConfiguration:
             loss_scale=d.get("loss_scale", "none"),
             loss_scale_value=d.get("loss_scale_value", 2.0 ** 15),
             loss_scale_growth=d.get("loss_scale_growth", 2000),
+            grad_compression=d.get("grad_compression", "none"),
+            grad_compression_threshold=d.get("grad_compression_threshold",
+                                             1e-3),
+            grad_compression_target=d.get("grad_compression_target", 1e-3),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -284,6 +296,11 @@ class GraphBuilder:
             loss_scale=getattr(self._p, "_loss_scale", "none"),
             loss_scale_value=getattr(self._p, "_loss_scale_value", 2.0 ** 15),
             loss_scale_growth=getattr(self._p, "_loss_scale_growth", 2000),
+            grad_compression=getattr(self._p, "_grad_compression", "none"),
+            grad_compression_threshold=getattr(
+                self._p, "_grad_compression_threshold", 1e-3),
+            grad_compression_target=getattr(
+                self._p, "_grad_compression_target", 1e-3),
         )
 
 

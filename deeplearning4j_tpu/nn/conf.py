@@ -83,6 +83,14 @@ class MultiLayerConfiguration:
     loss_scale: str = "none"
     loss_scale_value: float = 2.0 ** 15
     loss_scale_growth: int = 2000
+    # Encoded gradient collectives for the DP hot path
+    # (parallel/compression.py, docs/DISTRIBUTED.md#gradient-compression):
+    # "none" | "threshold" | "bitmap" | "onebit". ParallelWrapper then runs
+    # per-worker encode(grad + residual) → all-reduce(quantized) → decode →
+    # update, with the error-feedback residual resident in donated state.
+    grad_compression: str = "none"
+    grad_compression_threshold: float = 1e-3  # initial (adaptive) threshold
+    grad_compression_target: float = 1e-3     # target transmitted fraction
 
     def to_json(self) -> str:
         return json.dumps(
@@ -104,6 +112,9 @@ class MultiLayerConfiguration:
                 "loss_scale": self.loss_scale,
                 "loss_scale_value": self.loss_scale_value,
                 "loss_scale_growth": self.loss_scale_growth,
+                "grad_compression": self.grad_compression,
+                "grad_compression_threshold": self.grad_compression_threshold,
+                "grad_compression_target": self.grad_compression_target,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -141,6 +152,10 @@ class MultiLayerConfiguration:
             loss_scale=d.get("loss_scale", "none"),
             loss_scale_value=d.get("loss_scale_value", 2.0 ** 15),
             loss_scale_growth=d.get("loss_scale_growth", 2000),
+            grad_compression=d.get("grad_compression", "none"),
+            grad_compression_threshold=d.get("grad_compression_threshold",
+                                             1e-3),
+            grad_compression_target=d.get("grad_compression_target", 1e-3),
         )
 
 
@@ -205,6 +220,18 @@ class Builder:
         self._loss_scale = "none"
         self._loss_scale_value = 2.0 ** 15
         self._loss_scale_growth = 2000
+        # encoded gradient collectives (parallel/compression.py): env
+        # default validated here so a typo'd DL4J_TPU_GRAD_COMPRESSION
+        # fails at config build, not at the first sharded step's trace
+        from deeplearning4j_tpu.parallel.compression import validate_scheme
+
+        try:
+            self._grad_compression = validate_scheme(
+                env.default_grad_compression) or "none"
+        except ValueError as e:
+            raise ValueError(f"DL4J_TPU_GRAD_COMPRESSION: {e}") from None
+        self._grad_compression_threshold = 1e-3
+        self._grad_compression_target = 1e-3
         if env.default_buckets:
             from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 
@@ -351,6 +378,24 @@ class Builder:
         self._loss_scale_growth = int(growth_interval)
         return self
 
+    def grad_compression(self, scheme: str, threshold: float = 1e-3,
+                         target_sparsity: float = 1e-3) -> "Builder":
+        """Encoded gradient collectives for data-parallel fits
+        (docs/DISTRIBUTED.md#gradient-compression): "none" | "threshold" |
+        "bitmap" | "onebit". ParallelWrapper then threshold-encodes each
+        worker's (gradient + error-feedback residual), all-reduces the
+        quantized payload, and decodes before the update — the residual
+        lives worker-sharded in donated state. ``threshold`` seeds the
+        adaptive threshold (snapped to a power of two at encode time;
+        <= 0 pins the exact identity encode), ``target_sparsity`` is the
+        transmitted fraction the threshold drifts toward."""
+        from deeplearning4j_tpu.parallel.compression import validate_scheme
+
+        self._grad_compression = validate_scheme(scheme) or "none"
+        self._grad_compression_threshold = float(threshold)
+        self._grad_compression_target = float(target_sparsity)
+        return self
+
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
@@ -427,4 +472,7 @@ class ListBuilder:
             loss_scale=self._p._loss_scale,
             loss_scale_value=self._p._loss_scale_value,
             loss_scale_growth=self._p._loss_scale_growth,
+            grad_compression=self._p._grad_compression,
+            grad_compression_threshold=self._p._grad_compression_threshold,
+            grad_compression_target=self._p._grad_compression_target,
         )

@@ -416,24 +416,36 @@ class FusedUpdateEngine:
         return wrapped
 
     # ------------------------------------------------------------------ apply
+    def flatten_grads(self, grads):
+        """The ONLY per-step flatten: gradients, into one fp32 padded 1-D
+        buffer per (rule, dtype) group. Params/moments stay resident as
+        flat buffers in the donated state (docstring). Split out so the
+        compressed all-reduce (parallel/compression.py) can encode the
+        FLAT buffers — the exact arrays ZeRO reduce-scatters — instead of
+        per-leaf trees. No unscaling here: :meth:`apply_flat` owns the
+        loss-scale policy, wherever the buffers travelled in between."""
+        from deeplearning4j_tpu.ops import updater_ops as uo
+
+        leaves_g = self._leaves(grads)
+        return [uo.flatten_group(g, leaves_g, cast_dtype=jnp.float32)
+                for g in self.groups]
+
     def apply(self, params, grads, state, iteration, epoch=0):
         """One fused optimizer step. Returns (new_params, new_state) with
         new_params in the caller's collection type (list/dict)."""
+        return self.apply_flat(params, self.flatten_grads(grads), state,
+                               iteration, epoch)
+
+    def apply_flat(self, params, g_bufs, state, iteration, epoch=0):
+        """:meth:`apply` body over pre-flattened group buffers (the
+        compressed-DP entry point: decode output IS the flat buffer)."""
         from deeplearning4j_tpu.ops import updater_ops as uo
 
         leaves_p = self._leaves(params)
-        leaves_g = self._leaves(grads)
         scale = self.current_scale(state)
         inv_scale = None if scale is None else (1.0 / scale)
-
-        # the ONLY per-step flatten: gradients. Params/moments stay
-        # resident as flat buffers in the donated state (docstring).
-        g_bufs = []
-        for g in self.groups:
-            buf = uo.flatten_group(g, leaves_g, cast_dtype=jnp.float32)
-            if inv_scale is not None:
-                buf = buf * inv_scale.astype(buf.dtype)
-            g_bufs.append(buf)
+        if inv_scale is not None:
+            g_bufs = [buf * inv_scale.astype(buf.dtype) for buf in g_bufs]
 
         finite = None
         if self.loss_scale == "dynamic":

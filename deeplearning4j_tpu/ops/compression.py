@@ -22,15 +22,90 @@ import numpy as np
 from deeplearning4j_tpu.ops.registry import op
 
 
+@op("pow2_floor", "compression")
+def pow2_floor(t):
+    """Largest power of two <= ``t`` (t > 0), exactly, via frexp/ldexp bit
+    manipulation — no transcendental rounding.
+
+    Why the encoders snap thresholds to powers of two: for a power-of-two
+    q = ±2^k and any float32 c with 2^k < |c| < 2^(k+23), the subtraction
+    ``c - q`` is EXACT (c and q share a common ulp grid and the result fits
+    in 24 bits), so ``q + (c - q) == c`` bit-for-bit. That is what makes the
+    error-feedback conservation invariant (transmitted + residual == carried)
+    provable as exact equality instead of to-1-ulp (tests/test_compression.py)
+    — an arbitrary threshold loses up to 1 ulp per transmitted element per
+    step, silently, forever."""
+    t = jnp.asarray(t, jnp.float32)
+    _, e = jnp.frexp(jnp.maximum(t, jnp.float32(np.finfo(np.float32).tiny)))
+    return jnp.ldexp(jnp.ones((), jnp.float32), e - 1)
+
+
 @op("threshold_encode", "compression", aliases=("encode_threshold",))
 def threshold_encode(g, threshold):
     """→ (quantized, residual): quantized = ±threshold where |g| > threshold,
     else 0; residual = g - quantized (kept locally, added to the next step's
-    gradient — error-feedback SGD, the accumulator's ResidualPostProcessor)."""
+    gradient — error-feedback SGD, the accumulator's ResidualPostProcessor).
+
+    Reference-parity op: the threshold is used EXACTLY as given, so the
+    round trip conserves only to ~1 ulp per transmitted element. The DP
+    hot path's encoder (:func:`threshold_encode_exact`) snaps to a power of
+    two instead, making conservation bit-exact."""
     t = jnp.asarray(threshold, g.dtype)
     mask = jnp.abs(g) > t
     quantized = jnp.where(mask, jnp.sign(g) * t, jnp.zeros_like(g))
     return quantized, g - quantized
+
+
+@op("threshold_encode_exact", "compression")
+def threshold_encode_exact(g, threshold):
+    """Conservation-exact threshold encode for the compressed all-reduce
+    (parallel/compression.py): the working threshold is snapped to
+    ``pow2_floor(threshold)`` so ``quantized + residual == g`` holds
+    BIT-EXACTLY for every element with |g| < t·2^23 (see :func:`pow2_floor`).
+
+    Conservation is UNCONDITIONAL: an element beyond the exact-subtraction
+    range (|g| >= t·2^23 — 8.4 million times the threshold, where fp32
+    cannot hold ``g - t`` exactly) is simply not transmitted this step; it
+    stays whole in the residual while the adaptive threshold climbs toward
+    it. ``threshold <= 0`` is the exact identity encode — everything
+    transmits at full precision (quantized = g, residual = 0), the t→0
+    limit the bit-identity tests pin against the uncompressed path."""
+    t = jnp.asarray(threshold, jnp.float32)
+    t_eff = pow2_floor(t).astype(g.dtype)
+    live = t > 0
+    a = jnp.abs(g)
+    mask = jnp.logical_and(
+        jnp.logical_and(a > t_eff, a < t_eff * (2.0 ** 23)), live)
+    # +-t via SELECT, not sign(g)*t: a multiply feeding the residual
+    # subtract is an LLVM FMA-contraction candidate, and contraction is
+    # fusion-context/shape dependent — it broke bit-identity across mesh
+    # sizes (the r12 discovery, docs/DISTRIBUTED.md). Selects cannot
+    # contract.
+    signed = jnp.where(g < 0, -t_eff, t_eff)
+    quantized = jnp.where(mask, signed,
+                          jnp.where(live, jnp.zeros_like(g), g))
+    return quantized, g - quantized
+
+
+@op("onebit_encode", "compression")
+def onebit_encode(g, scale=None):
+    """Seide/Strom-style 1-bit sign quantization with error feedback:
+    transmit ``sign(g) * s`` for every |g| >= s, where ``s`` is the
+    power-of-two floor of mean(|g|) (per tensor, derived each step — no
+    adaptive state). Entries below the scale stay wholly in the residual so
+    the conservation invariant remains bit-exact (transmitting a magnitude
+    LARGER than the element would need more mantissa bits than fp32 has for
+    the residual). → (quantized, residual, scale)."""
+    if scale is None:
+        scale = jnp.mean(jnp.abs(g))
+    s = pow2_floor(scale).astype(g.dtype)
+    a = jnp.abs(g)
+    mask = jnp.logical_and(a >= s, a < s * (2.0 ** 23))
+    # select, not sign(g)*s — same FMA-contraction hazard as above
+    signed = jnp.where(g < 0, -s, s)
+    quantized = jnp.where(mask, jnp.broadcast_to(signed, g.shape),
+                          jnp.zeros_like(g))
+    return quantized, g - quantized, s
 
 
 @op("threshold_decode", "compression", aliases=("decode_threshold",))

@@ -20,7 +20,9 @@ from deeplearning4j_tpu.parallel.accumulator import (  # noqa: F401
     EncodedGradientsAccumulator,
     FixedThresholdAlgorithm,
     ResidualClippingPostProcessor,
+    TargetSparsityThresholdAlgorithm,
 )
+from deeplearning4j_tpu.parallel.compression import GradCompressor  # noqa: F401
 from deeplearning4j_tpu.parallel.masters import (  # noqa: F401
     ParameterAveragingTrainingMaster,
     SharedTrainingMaster,

@@ -62,6 +62,32 @@ class AdaptiveThresholdAlgorithm:
 
 
 @dataclasses.dataclass(frozen=True)
+class TargetSparsityThresholdAlgorithm:
+    """TargetSparsityThresholdAlgorithm.java parity: proportional control —
+    every step the threshold moves by a factor derived from how far the
+    observed transmitted fraction sits from ``target_ratio`` (the adaptive
+    algorithm above only reacts outside a 3x dead band; this one always
+    corrects, which converges tighter at the cost of more threshold
+    churn). The DP-hot-path wrapper default stays Adaptive (the
+    reference's default); plug this one into SharedTrainingMaster via
+    ``EncodedGradientsAccumulator(threshold_algorithm=...)``."""
+
+    initial: float = 1e-3
+    target_ratio: float = 1e-3
+    gain: float = 1.05
+    min_threshold: float = 1e-8
+    max_threshold: float = 1.0
+
+    def init_state(self):
+        return jnp.asarray(self.initial, jnp.float32)
+
+    def update(self, t, sparsity_ratio):
+        up = sparsity_ratio > self.target_ratio
+        t = jnp.where(up, t * self.gain, t / self.gain)
+        return jnp.clip(t, self.min_threshold, self.max_threshold)
+
+
+@dataclasses.dataclass(frozen=True)
 class ResidualClippingPostProcessor:
     """ResidualClippingPostProcessor.java parity: every ``frequency`` steps,
     clip the residual to ±``max_multiplier``·threshold so stale error can't

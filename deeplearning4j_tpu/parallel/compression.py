@@ -1,0 +1,271 @@
+"""Encoded gradient collectives for the DP hot path (docs/DISTRIBUTED.md).
+
+The source paper's signature distributed feature is
+``EncodedGradientsAccumulator`` — threshold/bitmap-encoded gradient sharing
+with error-feedback residuals (SURVEY.md §2.2 J16, §3.4). r12 reproduced it
+inside ``SharedTrainingMaster``'s vmapped lane; this module brings it to the
+DEFAULT ``ParallelWrapper`` DP path: the ONE jit-compiled GSPMD step runs
+
+    per-worker encode(grad + residual) → all-reduce(quantized) → decode
+    → update
+
+with the residual and the adaptive threshold living as worker-sharded
+RESIDENT donated state — the same invariant as the fused update engine's
+master buffers (docs/KERNELS.md): only the encode output moves per step;
+the residual never leaves its worker.
+
+Schemes (``grad_compression`` knob, env ``DL4J_TPU_GRAD_COMPRESSION``):
+
+- ``threshold`` — Strom-style threshold quantization: transmit ±t for
+  |carried| > t, sparse int32 wire format (4 B/transmitted element). The
+  threshold adapts toward ``target_sparsity`` (AdaptiveThresholdAlgorithm
+  semantics) and is snapped to a power of two at encode time, which makes
+  the error-feedback conservation invariant BIT-EXACT
+  (ops/compression.pow2_floor has the numerics argument).
+- ``bitmap`` — the same quantized values on libnd4j's dense 2-bit bitmap
+  wire format (16 codes per int32): nnz-independent ~1/16 ratio.
+- ``onebit`` — Seide/Strom 1-bit sign quantization: per-tensor
+  power-of-two scale from mean |carried| each step (no adaptive state),
+  bitmap wire format + one scale word per tensor.
+- ``none`` — off (the uncompressed partitioner-inserted all-reduce).
+
+``threshold <= 0`` is the exact identity encode (everything transmits at
+full precision, residual stays zero) — proven bit-identical to the
+uncompressed deterministic lane path in tests/test_compression.py.
+
+Hierarchical two-level mode (``hosts > 1``): the worker lanes factor as
+(hosts, lanes_per_host); the intra-host combine stays FULL-PRECISION (the
+ICI reduce-scatter r12 built — cheap bandwidth), and only the per-host
+partial gradient is encoded and exchanged across the ``hosts`` axis — the
+DCN seam whose control plane r7 bootstrapped. With power-of-two factors the
+grouped pairwise-tree association equals the flat tree, so ``hosts`` does
+not change the t→0 identity. Wire accounting then prices the CROSS-HOST
+payload only (that is the scarce link).
+
+CPU-backend honesty (the r6 convention): this container cannot measure DCN
+wall-clock — what CPU proves is the conservation invariant, the t→0
+bit-identity, the deterministic wire-bytes ratio, and convergence parity;
+the wire-bytes accounting computes what the encoded transport ships, it is
+not a packet capture. Rankings belong to real hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.compression import (
+    onebit_encode,
+    threshold_encode_exact,
+)
+from deeplearning4j_tpu.parallel import gspmd
+
+SCHEMES = ("none", "threshold", "bitmap", "onebit")
+
+
+def validate_scheme(scheme: Optional[str]) -> Optional[str]:
+    """None passes through (defer to conf/env); anything else must be one
+    of SCHEMES — fail at construction, not at trace time."""
+    if scheme is None:
+        return None
+    if scheme not in SCHEMES:
+        raise ValueError(
+            f"grad_compression must be one of {SCHEMES}, got {scheme!r}")
+    return scheme
+
+
+def resolve_scheme(explicit: Optional[str], conf) -> str:
+    """Wrapper-arg > conf.grad_compression > DL4J_TPU_GRAD_COMPRESSION env
+    default (already folded into new confs by nn/conf.py) > 'none'."""
+    if explicit is not None:
+        return validate_scheme(explicit)
+    from_conf = getattr(conf, "grad_compression", None) or "none"
+    return validate_scheme(from_conf)
+
+
+def _tmap(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def sparse_wire_bytes(n_leaves: int, nnz, workers):
+    """ONE participant's sparse threshold-format payload: one int32 per
+    transmitted element (sign folded into the index sign bit —
+    ops/compression.sparse_pack) plus a per-leaf (length, threshold)
+    header. The single definition of the wire format's byte math, shared
+    by GradCompressor and SharedTrainingMaster's gauges."""
+    return (nnz / jnp.asarray(float(workers), jnp.float32)) * 4.0 \
+        + 8.0 * float(n_leaves)
+
+
+@dataclasses.dataclass(frozen=True)
+class GradCompressor:
+    """Pure-function encode → combine → decode core of the compressed
+    all-reduce. Stateless itself; the residual/threshold live in the step's
+    donated state (``init_state`` builds them, ``encode_combine`` threads
+    them). Everything is jittable and vmap-free — the worker axis is the
+    leading dimension of every array, exactly how the wrapper's lane
+    machinery stacks it."""
+
+    scheme: str = "threshold"
+    initial_threshold: float = 1e-3
+    #: desired fraction of transmitted elements (threshold/bitmap adapt
+    #: toward it with the AdaptiveThresholdAlgorithm rule: ×decay when
+    #: >3x target, ÷decay when <target/3)
+    target_sparsity: float = 1e-3
+    decay: float = 1.2
+    min_threshold: float = 1e-8
+    max_threshold: float = 1.0
+    #: >1 = hierarchical two-level mode: intra-host full-precision combine
+    #: over lanes_per_host, encode only across the ``hosts`` axis
+    hosts: int = 1
+
+    def __post_init__(self):
+        if self.scheme not in SCHEMES or self.scheme == "none":
+            raise ValueError(f"GradCompressor needs an active scheme "
+                             f"(threshold|bitmap|onebit), got {self.scheme!r}")
+        if self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+
+    # ------------------------------------------------------------------ state
+    def exchange_axis(self, replicas: int) -> int:
+        """How many participants exchange encoded payloads: the hosts axis
+        in hierarchical mode, every worker lane otherwise."""
+        if self.hosts > 1:
+            if replicas % self.hosts:
+                raise ValueError(
+                    f"hierarchical compression needs hosts ({self.hosts}) "
+                    f"to divide the lane count ({replicas})")
+            return self.hosts
+        return replicas
+
+    def init_state(self, grads_template, replicas: int):
+        """Residual (zeros, stacked over the exchange axis) + threshold
+        scalar. ``grads_template``: ONE worker's gradient pytree (or the
+        fused engine's list of flat group buffers) — leaf shapes without
+        the worker axis."""
+        w = self.exchange_axis(replicas)
+        residual = _tmap(
+            lambda g: jnp.zeros((w,) + tuple(np.shape(g)),
+                                jnp.asarray(g).dtype), grads_template)
+        return {"residual": residual,
+                "threshold": jnp.asarray(self.initial_threshold, jnp.float32)}
+
+    def state_matches(self, state, grads_template, replicas: int) -> bool:
+        """Whether a restored/migrated state tree fits this compressor's
+        shapes (lane-count and scheme changes make it unusable)."""
+        try:
+            want = self.init_state(grads_template, replicas)
+        except ValueError:
+            return False
+        ws = jax.tree_util.tree_structure(want)
+        hs = jax.tree_util.tree_structure(state)
+        if ws != hs:
+            return False
+        return all(tuple(np.shape(a)) == tuple(np.shape(b))
+                   for a, b in zip(jax.tree_util.tree_leaves(want),
+                                   jax.tree_util.tree_leaves(state)))
+
+    # ----------------------------------------------------------------- encode
+    def _encode_leaf(self, carried, threshold):
+        if self.scheme in ("threshold", "bitmap"):
+            return threshold_encode_exact(carried, threshold)
+        # onebit: per-(worker, tensor) scale from mean |carried|, derived
+        # each step; keep the worker axis, reduce everything else
+        axes = tuple(range(1, carried.ndim))
+        s = jnp.mean(jnp.abs(carried), axis=axes, keepdims=True) \
+            if axes else jnp.abs(carried)
+        q, r, _ = onebit_encode(carried, s)
+        return q, r
+
+    def encode_combine(self, stacked_grads, state, inv):
+        """One compressed exchange: per-worker error-feedback encode, the
+        deterministic pairwise-tree combine of the quantized payloads (the
+        all-reduce), dense decode, weighted-mean normalization by ``inv``.
+
+        ``stacked_grads``: pytree of (R, ...) lane-stacked (weight-scaled)
+        gradients. Returns ``(combined, new_state, stats)`` where
+        ``combined`` matches the uncompressed combine's tree structure and
+        ``stats`` carries the deterministic wire-bytes accounting
+        (device scalars — fetch at window cadence, not per step)."""
+        leaves = jax.tree_util.tree_leaves(stacked_grads)
+        if not leaves:
+            raise ValueError("encode_combine: empty gradient tree")
+        replicas = int(leaves[0].shape[0])
+        w = self.exchange_axis(replicas)
+        if w != replicas:
+            local = replicas // w
+            # intra-host FULL-PRECISION combine (the ICI leg): grouped
+            # pairwise tree — with pow2 factors the association equals the
+            # flat pairwise tree, preserving the t→0 identity
+            contrib = _tmap(
+                lambda v: jax.vmap(gspmd.pairwise_sum)(
+                    v.reshape((w, local) + v.shape[1:])), stacked_grads)
+        else:
+            contrib = stacked_grads
+        carried = _tmap(lambda g, r: g + r, contrib, state["residual"])
+        t = state["threshold"]
+        enc = _tmap(lambda c: self._encode_leaf(c, t), carried)
+        is_pair = lambda x: isinstance(x, tuple)  # noqa: E731
+        quant = jax.tree_util.tree_map(lambda x: x[0], enc, is_leaf=is_pair)
+        new_res = jax.tree_util.tree_map(lambda x: x[1], enc,
+                                         is_leaf=is_pair)
+
+        q_leaves = jax.tree_util.tree_leaves(quant)
+        nnz = sum(jnp.sum(q != 0).astype(jnp.float32) for q in q_leaves)
+        elems = sum(int(np.prod(q.shape[1:] or (1,))) for q in q_leaves)
+        sparsity = nnz / jnp.asarray(float(w * elems), jnp.float32)
+        new_t = self._update_threshold(t, sparsity)
+
+        combined = _tmap(
+            lambda v: gspmd.pairwise_sum(v) * inv.astype(v.dtype), quant)
+        stats = self._wire_stats(q_leaves, nnz, w, t)
+        return combined, {"residual": new_res, "threshold": new_t}, stats
+
+    def _update_threshold(self, t, sparsity):
+        if self.scheme == "onebit":
+            return t  # scale derives per step; no adaptive state
+        too_dense = sparsity > self.target_sparsity * 3.0
+        too_sparse = sparsity < self.target_sparsity / 3.0
+        adapted = jnp.where(
+            too_dense, t * self.decay,
+            jnp.where(too_sparse, t / self.decay, t))
+        adapted = jnp.clip(adapted, self.min_threshold, self.max_threshold)
+        # t <= 0 is the pinned identity mode: never adapt out of it
+        return jnp.where(t > 0, adapted, t)
+
+    # ------------------------------------------------------------ wire bytes
+    def _wire_stats(self, q_leaves, nnz, workers, t):
+        """Deterministic accounting of ONE participant's encoded payload vs
+        its dense fp32 payload (what the r6 convention lets CPU claim: the
+        byte math, not the wall clock)."""
+        n_leaves = float(len(q_leaves))
+        dense = float(sum(
+            int(np.prod(q.shape[1:] or (1,)))
+            * jnp.dtype(q.dtype).itemsize for q in q_leaves))
+        elems = float(sum(int(np.prod(q.shape[1:] or (1,)))
+                          for q in q_leaves))
+        if self.scheme == "threshold":
+            wire = sparse_wire_bytes(len(q_leaves), nnz, workers)
+            # identity mode ships dense fp32
+            wire = jnp.where(t > 0, wire, dense)
+        else:
+            # 2-bit bitmap: 16 codes per int32 word, one scale/threshold
+            # word per leaf (onebit ships its per-tensor scale the same way)
+            words = float(sum(-(-int(np.prod(q.shape[1:] or (1,))) // 16)
+                              for q in q_leaves))
+            wire = jnp.asarray(words * 4.0 + 4.0 * n_leaves, jnp.float32)
+            if self.scheme == "bitmap":
+                wire = jnp.where(t > 0, wire, dense)
+        wire = jnp.asarray(wire, jnp.float32)
+        return {
+            "wire_bytes": wire,
+            "dense_bytes": jnp.asarray(dense, jnp.float32),
+            "ratio": wire / jnp.asarray(dense, jnp.float32),
+            "nnz": nnz,
+            "elements": jnp.asarray(elems, jnp.float32),
+            "workers": jnp.asarray(float(workers), jnp.float32),
+        }

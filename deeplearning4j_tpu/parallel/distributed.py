@@ -109,6 +109,18 @@ def global_mesh(data: int = -1, model: int = 1, seq: int = 1) -> TrainingMesh:
     return TrainingMesh(data=data, model=model, seq=seq, devices=devices)
 
 
+def host_count() -> int:
+    """Process/host count with a single-process fallback — the default
+    ``hosts`` factor for the hierarchical compressed all-reduce
+    (parallel/compression.py): intra-host combines stay full-precision
+    over ICI, only the cross-host exchange is encoded (the DCN seam this
+    module bootstraps)."""
+    try:
+        return int(jax.process_count())
+    except RuntimeError:
+        return 1
+
+
 def is_coordinator() -> bool:
     """True on the process that should write checkpoints/logs (driver
     parity: the Spark master's save/report role in §3.4)."""

@@ -710,6 +710,18 @@ class ElasticTrainer:
             "resumed_from": self.resumed_from,
             "drained": self.drained,
         }
+        comp = getattr(self.wrapper, "_compressor", None) \
+            if self.wrapper is not None else None
+        if comp is not None:
+            # encoded-collectives surface (docs/DISTRIBUTED.md#gradient-
+            # compression): scheme + whether the residual state a regroup/
+            # resume must migrate is currently resident. Stats stay
+            # device-side here — /healthz must never force a sync.
+            out["grad_compression"] = {
+                "scheme": comp.scheme,
+                "hosts": comp.hosts,
+                "residual_resident": self.wrapper._comp_state is not None,
+            }
         if self.membership is not None:
             out["membership"] = self.membership.status()
         else:

@@ -110,8 +110,32 @@ def combine_states(stacked_states):
 # ---------------------------------------------------------------------------
 
 
+def _lane_scaled(model) -> bool:
+    """Whether this model's lane bodies must run under loss scaling: the
+    fused engine owns the policy (nn/updaters.py) — lane gradients then
+    come out ``scale`` x true and the fused apply unscales them
+    (the satellite closing parallel/gspmd.py's old NotImplementedError)."""
+    engine = getattr(model, "_fused", None)
+    return engine is not None and engine.loss_scale != "none"
+
+
+def _lane_value_and_grad(loss_fn, scaled, args, scale):
+    """Shared AD tail of every lane body: plain value_and_grad, or the
+    ``wrap_scaled`` variant whose gradients are ``scale`` x true while the
+    reported loss stays unscaled (ONE trace shape either way — the same
+    contract as the single-host step in nn/multilayer.py)."""
+    if not scaled:
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(*args)
+        return loss, new_states, grads
+    (_, (new_states, loss)), grads = jax.value_and_grad(
+        upd.FusedUpdateEngine.wrap_scaled(loss_fn, scale), has_aux=True
+    )(*args)
+    return loss, new_states, grads
+
+
 def make_lane_value_and_grad(model) -> Callable:
-    """fn(params, states, x, y, key, weights, fm, lm) ->
+    """fn(params, states, x, y, key, weights, fm, lm, scale) ->
     ((loss, weight_sum), (new_states, grads)) for ONE lane.
 
     Works for MultiLayerNetwork (list-keyed params, single input) and
@@ -119,23 +143,27 @@ def make_lane_value_and_grad(model) -> Callable:
     lists zip with the graph's declared input/output order, exactly like
     ``make_step_fn``). ``weight_sum`` is the lane's loss-weight mass — the
     wrapper's combine stage recombines lane means into the global weighted
-    mean with it."""
+    mean with it. ``scale``: the loss-scale multiplier when the model's
+    fused engine has a scaling policy (the wrapper threads
+    ``engine.current_scale(opt_states)``; pass None otherwise) — gradients
+    then come out scaled and the fused apply unscales at update time."""
     is_graph = isinstance(model._updaters, dict)
+    scaled = _lane_scaled(model)
     if is_graph:
         layer_names = [n.name for n in model.topo if n.is_layer]
         in_names = list(model.conf.inputs)
         out_names = list(model.conf.outputs)
 
-        def lane(params, states, x, y, key, weights, fm, lm):
+        def lane(params, states, x, y, key, weights, fm, lm, scale=None):
             subkeys = jax.random.split(key, len(layer_names))
             keys = dict(zip(layer_names, subkeys))
             feed = (dict(zip(in_names, x)) if isinstance(x, (list, tuple))
                     else {in_names[0]: x})
             labs = (dict(zip(out_names, y)) if isinstance(y, (list, tuple))
                     else {out_names[0]: y})
-            (loss, new_states), grads = jax.value_and_grad(
-                model._loss, has_aux=True)(
-                params, states, feed, labs, keys, weights, fm, lm)
+            loss, new_states, grads = _lane_value_and_grad(
+                model._loss, scaled,
+                (params, states, feed, labs, keys, weights, fm, lm), scale)
             wsum = jnp.sum(weights) if weights is not None \
                 else jnp.asarray(1.0, jnp.float32)
             return (loss, wsum), (new_states, grads)
@@ -144,11 +172,11 @@ def make_lane_value_and_grad(model) -> Callable:
 
     n_layers = len(model.layers)
 
-    def lane(params, states, x, y, key, weights, fm, lm):
+    def lane(params, states, x, y, key, weights, fm, lm, scale=None):
         keys = list(jax.random.split(key, n_layers))
-        (loss, new_states), grads = jax.value_and_grad(
-            model._loss, has_aux=True)(
-            params, states, x, y, keys, weights, fm, lm)
+        loss, new_states, grads = _lane_value_and_grad(
+            model._loss, scaled,
+            (params, states, x, y, keys, weights, fm, lm), scale)
         wsum = jnp.sum(weights) if weights is not None \
             else jnp.asarray(1.0, jnp.float32)
         return (loss, wsum), (new_states, grads)
@@ -159,23 +187,32 @@ def make_lane_value_and_grad(model) -> Callable:
 def make_lane_tbptt_value_and_grad(model) -> Callable:
     """TBPTT-segment variant (MultiLayerNetwork only): carries in/out, one
     update per segment — the lane body of the wrapper's sharded
-    ``doTruncatedBPTT``."""
+    ``doTruncatedBPTT``. Loss scaling threads through exactly like
+    :func:`make_lane_value_and_grad`."""
     if isinstance(model._updaters, dict):
         raise NotImplementedError(
             "sharded TBPTT is implemented for MultiLayerNetwork; fit the "
             "ComputationGraph through its own fit() or without tbptt_length")
     n_layers = len(model.layers)
+    scaled = _lane_scaled(model)
 
     def seg_loss(params, states, carries, x, y, keys, weights, fm, lm):
         loss, (new_states, new_carries) = model._loss_body(
             params, states, carries, x, y, keys, weights, fm, lm)
         return loss, (new_states, new_carries)
 
-    def lane(params, states, carries, x, y, key, weights, fm, lm):
+    def lane(params, states, carries, x, y, key, weights, fm, lm,
+             scale=None):
         keys = list(jax.random.split(key, n_layers))
-        (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-            seg_loss, has_aux=True)(
-            params, states, carries, x, y, keys, weights, fm, lm)
+        args = (params, states, carries, x, y, keys, weights, fm, lm)
+        if scaled:
+            (_, ((new_states, new_carries), loss)), grads = \
+                jax.value_and_grad(
+                    upd.FusedUpdateEngine.wrap_scaled(seg_loss, scale),
+                    has_aux=True)(*args)
+        else:
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                seg_loss, has_aux=True)(*args)
         wsum = jnp.sum(weights) if weights is not None \
             else jnp.asarray(1.0, jnp.float32)
         return (loss, wsum), (new_states, new_carries, grads)
@@ -183,22 +220,29 @@ def make_lane_tbptt_value_and_grad(model) -> Callable:
     return lane
 
 
-def apply_updaters(model, params, grads, opt_states, iteration):
+def apply_updaters(model, params, grads, opt_states, iteration,
+                   scaled_grads: bool = False):
     """One updater application over the model's per-layer updaters — the
     shared tail of every sharded step (MLN list / CG dict keyed). A model
     built with ``fused_update`` routes through its FusedUpdateEngine: the
     flat per-(rule, dtype) buffers are exactly what ZeRO shards
     (zero_shardings on the 1-D padded dimension), so the partitioner emits
     reduce-scatter(grad buffer) -> sharded fused update ->
-    all-gather(params) with no extra plumbing."""
+    all-gather(params) with no extra plumbing.
+
+    ``scaled_grads``: the caller scaled its lane losses (the wrapper's
+    lane builders under a loss_scale policy), so the engine's unscale at
+    apply time is CORRECT; callers that compute unscaled gradients (the
+    Spark-facade masters) leave it False and a scaling policy fails loudly
+    instead of silently double-unscaling."""
     engine = getattr(model, "_fused", None)
     if engine is not None:
-        if engine.loss_scale != "none":
+        if engine.loss_scale != "none" and not scaled_grads:
             raise NotImplementedError(
-                "loss_scale under ParallelWrapper is not wired: the lane "
+                "loss_scale under this master is not wired: its lane "
                 "value-and-grad computes unscaled gradients, so the fused "
-                "unscale would corrupt them — run loss scaling on the "
-                "single-host fit path, or keep loss_scale='none' here")
+                "unscale would corrupt them — use ParallelWrapper (which "
+                "scales the lane loss), or keep loss_scale='none' here")
         with cmod.optimizer_scope():
             return engine.apply(params, grads, opt_states, iteration)
     is_graph = isinstance(model._updaters, dict)
@@ -218,6 +262,21 @@ def apply_updaters(model, params, grads, opt_states, iteration):
             new_params[k] = p
             new_opts[k] = s
     return new_params, new_opts
+
+
+def apply_updaters_flat(model, params, grad_bufs, opt_states, iteration):
+    """:func:`apply_updaters` over PRE-FLATTENED fused group buffers — the
+    compressed all-reduce path (parallel/compression.py): the per-lane
+    gradients flatten once per step, the encode/all-reduce/decode chain runs
+    on the flat buffers (what ZeRO reduce-scatters), and the decode output
+    feeds the fused update directly — no per-leaf round trip."""
+    engine = getattr(model, "_fused", None)
+    if engine is None:
+        raise ValueError(
+            "apply_updaters_flat needs a fused_update model — only the "
+            "FusedUpdateEngine defines the flat buffer layout")
+    with cmod.optimizer_scope():
+        return engine.apply_flat(params, grad_bufs, opt_states, iteration)
 
 
 # ---------------------------------------------------------------------------

@@ -162,6 +162,9 @@ class SharedTrainingMaster:
         self.accumulator = accumulator or EncodedGradientsAccumulator()
         self.initial_threshold = threshold
         self._step = None
+        #: last step's wire accounting (device scalars; same convention as
+        #: ParallelWrapper.compression_stats)
+        self.last_stats = None
 
     def _build(self, model):
         acc = self.accumulator
@@ -190,8 +193,25 @@ class SharedTrainingMaster:
                 model, params, shared, opts, iteration)
             # non-trainable state (batchnorm stats) kept consistent by mean
             new_states = gspmd.combine_states(states_l)
+            # deterministic wire accounting (ONE byte-math definition,
+            # shared with the wrapper's compressed path): one worker's
+            # sparse threshold payload vs its dense fp32 payload
+            from deeplearning4j_tpu.parallel.compression import (
+                sparse_wire_bytes)
+
+            q_leaves = jax.tree_util.tree_leaves(quant_l)
+            workers = float(q_leaves[0].shape[0]) if q_leaves else 1.0
+            nnz = sum(jnp.sum(q != 0).astype(jnp.float32)
+                      for q in q_leaves)
+            dense = float(sum(
+                int(np.prod(q.shape[1:] or (1,)))
+                * jnp.dtype(q.dtype).itemsize for q in q_leaves))
+            wire = sparse_wire_bytes(len(q_leaves), nnz, workers)
+            stats = {"wire_bytes": wire,
+                     "dense_bytes": jnp.asarray(dense, jnp.float32),
+                     "ratio": wire / jnp.asarray(dense, jnp.float32)}
             return (new_params, new_states, new_opts, new_res, new_thr,
-                    gspmd.pairwise_mean(loss_l))
+                    gspmd.pairwise_mean(loss_l), stats)
 
         self._step = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
@@ -218,12 +238,20 @@ class SharedTrainingMaster:
                     extras=_batch_masks(ds, model))
                 model._rng_key, sub = jax.random.split(model._rng_key)
                 keys = jax.device_put(jax.random.split(sub, n), shard)
-                params, states, opts, residual, threshold, loss = self._step(
+                (params, states, opts, residual, threshold, loss,
+                 self.last_stats) = self._step(
                     params, states, opts, residual, threshold,
                     jnp.asarray(model.iteration), x, y, keys, w, fm, lm)
                 model.iteration += 1
                 model.score_value = float(loss)
                 tm.counter("train.steps_total", model="shared_master")
+                if tm.enabled():
+                    tm.gauge("parallel.allreduce_wire_bytes",
+                             float(self.last_stats["wire_bytes"]),
+                             source="shared_master")
+                    tm.gauge("parallel.allreduce_compression_ratio",
+                             float(self.last_stats["ratio"]),
+                             source="shared_master")
                 for lst in model.listeners:
                     lst.iteration_done(model, model.iteration, model.epoch)
             # epoch-boundary state sync-back: params here are complete

@@ -180,6 +180,24 @@ class TrainingMesh:
             out.append(placed)
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def dcn_hosts(self) -> int:
+        """The DCN factor of the 'data' axis: how many process (host)
+        groups the data-parallel workers span. ``jax.devices()`` orders
+        devices by process, and the mesh grid reshapes that order as
+        (data, model, seq) — so on a multi-host pod the OUTER factor of
+        the data axis IS the host dimension, which is what the
+        hierarchical compressed all-reduce treats as the expensive seam
+        (``ParallelWrapper(compression_hosts="auto")`` —
+        docs/DISTRIBUTED.md#gradient-compression). Single-process (and any
+        layout where the process count does not divide the data axis):
+        1, i.e. no DCN seam to compress differently."""
+        from deeplearning4j_tpu.parallel.distributed import host_count
+
+        n = host_count()
+        if n > 1 and self.data % n == 0:
+            return int(n)
+        return 1
+
     def layout_signature(self, extra=None) -> str:
         """Stable layout key for compile-cache / AOT-export keying
         (parallel/gspmd.py:layout_signature)."""

@@ -11,8 +11,11 @@ batch sharded over the mesh 'data' axis and params replicated — GSPMD inserts
 one fused gradient ``all-reduce`` over ICI per step. Synchronous averaging
 every iteration (the reference's averaging mode with frequency=1) is exact
 here and costs one collective; the async/compressed machinery existed to hide
-slow interconnects that ICI does not have (threshold compression survives as
-an opt-in for DCN in parallel.compression).
+slow interconnects that ICI does not have. The encoded-gradient machinery
+survives as the ``grad_compression`` knob (parallel/compression.py,
+docs/DISTRIBUTED.md#gradient-compression): per-worker error-feedback
+encode → all-reduce(quantized) → decode inside the lane-decomposed step,
+for the DCN-bound regimes where wire bytes are the scarce resource.
 """
 
 from __future__ import annotations
@@ -77,7 +80,13 @@ class ParallelWrapper:
     def __init__(self, model, workers: Optional[int] = None,
                  mesh: Optional[TrainingMesh] = None, prefetch: int = 2,
                  skew_every: int = 10, zero_optimizer: bool = True,
-                 deterministic: bool = False, replicas: Optional[int] = None):
+                 deterministic: bool = False, replicas: Optional[int] = None,
+                 grad_compression=None,
+                 compression_threshold: Optional[float] = None,
+                 compression_target_sparsity: Optional[float] = None,
+                 compression_hosts: Optional[int] = None):
+        from deeplearning4j_tpu.parallel import compression as _comp
+
         self.model = model
         if mesh is None:
             devices = jax.devices()[: workers or len(jax.devices())]
@@ -94,15 +103,65 @@ class ParallelWrapper:
         # lane count: fixed at construction so a fit is reproducible across
         # device counts (pass the same replicas on every topology)
         self.replicas = int(replicas if replicas is not None else mesh.data)
+        # Encoded gradient collectives (docs/DISTRIBUTED.md#gradient-
+        # compression): grad_compression is a scheme name
+        # (none|threshold|bitmap|onebit), a prebuilt GradCompressor, or
+        # None (defer to conf.grad_compression, which folds in the
+        # DL4J_TPU_GRAD_COMPRESSION env default). An active scheme routes
+        # the step through the lane decomposition — per-worker gradients
+        # are what the error-feedback encode needs, and the lane path's
+        # deterministic combine is what makes the t→0 bit-identity and the
+        # wire-ratio tests exact.
+        if isinstance(grad_compression, _comp.GradCompressor):
+            self._compressor = grad_compression
+        else:
+            scheme = _comp.resolve_scheme(grad_compression, model.conf)
+            if scheme == "none":
+                self._compressor = None
+            else:
+                conf = model.conf
+                hosts = compression_hosts
+                if hosts in (None, "auto"):
+                    hosts = self.mesh.dcn_hosts() \
+                        if hosts == "auto" else 1
+                self._compressor = _comp.GradCompressor(
+                    scheme=scheme,
+                    initial_threshold=(
+                        compression_threshold
+                        if compression_threshold is not None
+                        else getattr(conf, "grad_compression_threshold",
+                                     1e-3)),
+                    target_sparsity=(
+                        compression_target_sparsity
+                        if compression_target_sparsity is not None
+                        else getattr(conf, "grad_compression_target", 1e-3)),
+                    hosts=int(hosts))
+        if self._compressor is not None:
+            self._compressor.exchange_axis(self.replicas)  # fail fast
+            engine = getattr(model, "_fused", None)
+            if engine is not None and engine.loss_scale == "dynamic":
+                raise ValueError(
+                    "grad_compression with loss_scale='dynamic' is not "
+                    "supported: the residual accumulates in scaled units, "
+                    "so a scale change mid-run would silently re-weight "
+                    "the carried error — use loss_scale='static' (the "
+                    "residual then lives consistently in scaled units) or "
+                    "compression 'none'")
+        #: compression forces the lane-decomposed step (per-worker grads)
+        self._uses_lanes = bool(deterministic or self._compressor)
         self._sharded_step = None
         self._tbptt_step = None
         self._zero_specs = None
         self._param_specs = self._state_specs = self._opt_specs = None
+        self._comp_state = None
+        self._comp_specs = None
+        self._comp_stats = None
+        self._stage_jits = None
         self.layout: dict = {}
 
     def _build(self):
         model = self.model
-        if model._train_step is None and not self.deterministic:
+        if model._train_step is None and not self._uses_lanes:
             raise ValueError("model must be init()ed first")
         if not model.params:
             raise ValueError("model must be init()ed first")
@@ -142,9 +201,78 @@ class ParallelWrapper:
                                    spec_of, model.opt_states))
         else:
             self._param_specs = self._state_specs = self._opt_specs = None
-        self._sharded_step = (self._build_lane_step() if self.deterministic
+        if self._compressor is not None:
+            self._place_compression_state()
+        self._sharded_step = (self._build_lane_step() if self._uses_lanes
                               else self._build_fast_step())
         self._publish_layout()
+
+    # ------------------------------------------------- compression state
+    def _comp_template(self):
+        """ONE worker's gradient template: the fused engine's flat group
+        buffers when the model fuses its update (the encode then runs on
+        exactly what ZeRO reduce-scatters), the param-shaped tree
+        otherwise."""
+        model = self.model
+        engine = getattr(model, "_fused", None)
+        if engine is not None:
+            return [np.zeros((g.total,), np.float32) for g in engine.groups]
+        f32 = lambda p: np.zeros(np.shape(p), np.float32)  # noqa: E731
+        if isinstance(model._updaters, dict):
+            return {k: jax.tree_util.tree_map(f32, v)
+                    for k, v in model.params.items()}
+        return [jax.tree_util.tree_map(f32, p) for p in model.params]
+
+    def _place_compression_state(self):
+        """Adopt (checkpoint-restored / reshard-migrated) or initialize the
+        residual + threshold, place them on the mesh (residual sharded over
+        'data' when the exchange axis divides it — worker-sharded RESIDENT
+        state, the fused-master invariant), and pin the layout specs the
+        step re-asserts every iteration."""
+        comp = self._compressor
+        template = self._comp_template()
+        prior = getattr(self.model, "_grad_comp_state", None)
+        if prior is not None and not comp.state_matches(
+                prior, template, self.replicas):
+            raise ValueError(
+                "restored grad-compression state does not match this "
+                "wrapper's layout (scheme/replicas/hosts changed between "
+                "runs?) — clear model._grad_comp_state to reinitialize, "
+                "losing the carried residual")
+        state = prior if prior is not None \
+            else comp.init_state(template, self.replicas)
+        if self.mesh.n_devices > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            d = self.mesh.data
+
+            def spec_of(leaf):
+                shape = np.shape(leaf)
+                if shape and shape[0] % d == 0:
+                    return NamedSharding(
+                        self.mesh.mesh,
+                        P("data", *([None] * (len(shape) - 1))))
+                return self.mesh.replicated()
+
+            self._comp_specs = jax.tree_util.tree_map(spec_of, state)
+            state = gspmd.place_tree(state, self._comp_specs)
+        else:
+            self._comp_specs = None
+            state = jax.tree_util.tree_map(jnp.asarray, state)
+        self._comp_state = state
+        self.model._grad_comp_state = state
+
+    def _adopt_compression_state(self):
+        """Re-place the model-side compression state when someone swapped
+        it from outside the step loop — a checkpoint restore
+        (util/checkpoint.py sets ``model._grad_comp_state``) or a rollback.
+        Identity-checked per step: free when nothing changed."""
+        if self._compressor is None:
+            return
+        if getattr(self.model, "_grad_comp_state", None) is self._comp_state:
+            return
+        self._place_compression_state()
 
     def _build_fast_step(self):
         # The model's own step function (weighted variant for exact ragged-
@@ -189,6 +317,11 @@ class ParallelWrapper:
     # every mesh size.
     def _lane_combine_fns(self):
         sspecs = self._state_specs
+        comp = self._compressor
+        cspecs = self._comp_specs
+        model = self.model
+        engine = getattr(model, "_fused", None)
+        comp_flat = comp is not None and engine is not None
 
         def combine(loss_s, s_l, states_l, scaled_g):
             total = gspmd.pairwise_sum(s_l)
@@ -202,15 +335,43 @@ class ParallelWrapper:
                 new_states = gspmd.constrain_tree(new_states, sspecs)
             return loss, grads, new_states
 
-        model = self.model
+        def combine_compressed(loss_s, s_l, states_l, scaled_g, comp_state):
+            """The combine stage with the encoded exchange spliced in
+            where the cross-lane gradient sum used to be: per-worker
+            error-feedback encode → deterministic pairwise all-reduce of
+            the quantized payloads → dense decode → weighted-mean
+            normalization. With the fused engine, the per-lane gradients
+            flatten FIRST (vmapped) so the encode runs on the flat
+            per-(rule, dtype) buffers ZeRO reduce-scatters."""
+            total = gspmd.pairwise_sum(s_l)
+            inv = 1.0 / jnp.where(total == 0.0, 1.0, total)
+            payload = (jax.vmap(engine.flatten_grads)(scaled_g)
+                       if comp_flat else scaled_g)
+            grads, new_comp, stats = comp.encode_combine(
+                payload, comp_state, inv)
+            loss = gspmd.pairwise_sum(loss_s) * inv
+            new_states = gspmd.combine_states(states_l)
+            if sspecs is not None:
+                new_states = gspmd.constrain_tree(new_states, sspecs)
+            if cspecs is not None:
+                new_comp = gspmd.constrain_tree(new_comp, cspecs)
+            return loss, grads, new_states, new_comp, stats
+
         zspecs = self._zero_specs
         pspecs = self._param_specs
 
         def update(params, opts, grads, iteration):
             if zspecs is not None:
                 opts = gspmd.constrain_tree(opts, zspecs)
-            new_params, new_opts = gspmd.apply_updaters(
-                model, params, grads, opts, iteration)
+            if comp_flat:
+                # decode output IS the flat buffer list — feed the fused
+                # update directly, no per-leaf round trip
+                new_params, new_opts = gspmd.apply_updaters_flat(
+                    model, params, grads, opts, iteration)
+            else:
+                new_params, new_opts = gspmd.apply_updaters(
+                    model, params, grads, opts, iteration,
+                    scaled_grads=True)
             # pin the output layout to the input layout (see _build): the
             # updated params must come back replicated even though the
             # ZeRO-sharded moments fed the update
@@ -220,7 +381,9 @@ class ParallelWrapper:
                 new_opts = gspmd.constrain_tree(new_opts, zspecs)
             return new_params, new_opts
 
-        return jax.jit(combine), jax.jit(update, donate_argnums=(0, 1))
+        j_combine = (jax.jit(combine_compressed, donate_argnums=(4,))
+                     if comp is not None else jax.jit(combine))
+        return j_combine, jax.jit(update, donate_argnums=(0, 1))
 
     @staticmethod
     def _lane_scale(loss_l, s_l, grads_l):
@@ -231,30 +394,56 @@ class ParallelWrapper:
                 s_l.shape + (1,) * (t.ndim - 1)).astype(t.dtype), grads_l)
         return loss_l * s_l, scale
 
+    def _loss_scale_arg(self):
+        """The loss-scale multiplier the lane stage multiplies into the
+        loss this step (None when the model has no scaling policy): read
+        from the CURRENT opt state so the dynamic automaton's value is the
+        one this step's gradients are scaled by — the fused apply unscales
+        with the same state."""
+        engine = getattr(self.model, "_fused", None)
+        if engine is None or engine.loss_scale == "none":
+            return None
+        return engine.current_scale(self.model.opt_states)
+
+    def _run_compressed_combine(self, j_combine, combine_args):
+        """Thread the resident compression state through the combine jit
+        and keep both wrapper- and model-side references current (the
+        model-side one is what checkpoints carry — util/checkpoint.py)."""
+        loss, grads, new_states, self._comp_state, self._comp_stats = \
+            j_combine(*combine_args, self._comp_state)
+        self.model._grad_comp_state = self._comp_state
+        return loss, grads, new_states
+
     def _build_lane_step(self):
         model = self.model
         lane_vg = gspmd.make_lane_value_and_grad(model)
+        compressed = self._compressor is not None
 
-        def lanes(params, states, x, y, keys, w):
+        def lanes(params, states, x, y, keys, w, scale):
             # the SAME vmapped program on every topology: on one device it
             # executes unpartitioned, on N the lane axis is sharded — the
             # per-lane values are identical either way (pinned exceptions:
             # conv filter grads and >=1024-wide gemm contractions, whose
             # XLA:CPU lowering is fold-dependent; docs/DISTRIBUTED.md)
             (loss_l, s_l), (states_l, grads_l) = jax.vmap(
-                lane_vg, in_axes=(None, None, 0, 0, 0, 0, None, None)
-            )(params, states, x, y, keys, w, None, None)
+                lane_vg, in_axes=(None, None, 0, 0, 0, 0, None, None, None)
+            )(params, states, x, y, keys, w, None, None, scale)
             loss_s, scaled = self._lane_scale(loss_l, s_l, grads_l)
             return loss_s, s_l, states_l, scaled
 
         j_lanes = jax.jit(lanes)
         j_combine, j_update = self._lane_combine_fns()
+        self._stage_jits = (j_lanes, j_combine, j_update)
 
         def step(params, states, opts, iteration, x, y, keys, w):
-            loss_s, s_l, states_l, scaled = j_lanes(params, states, x, y,
-                                                    keys, w)
-            loss, grads, new_states = j_combine(loss_s, s_l, states_l,
-                                                scaled)
+            loss_s, s_l, states_l, scaled = j_lanes(
+                params, states, x, y, keys, w, self._loss_scale_arg())
+            if compressed:
+                loss, grads, new_states = self._run_compressed_combine(
+                    j_combine, (loss_s, s_l, states_l, scaled))
+            else:
+                loss, grads, new_states = j_combine(loss_s, s_l, states_l,
+                                                    scaled)
             new_params, new_opts = j_update(params, opts, grads, iteration)
             return new_params, new_states, new_opts, loss
 
@@ -263,11 +452,12 @@ class ParallelWrapper:
     def _build_tbptt_step(self):
         model = self.model
         lane_vg = gspmd.make_lane_tbptt_value_and_grad(model)
+        compressed = self._compressor is not None
 
-        def lanes(params, states, carries, x, y, keys, w, fm, lm):
+        def lanes(params, states, carries, x, y, keys, w, fm, lm, scale):
             (loss_l, s_l), (states_l, carries_l, grads_l) = jax.vmap(
-                lane_vg, in_axes=(None, None, 0, 0, 0, 0, 0, 0, 0)
-            )(params, states, carries, x, y, keys, w, fm, lm)
+                lane_vg, in_axes=(None, None, 0, 0, 0, 0, 0, 0, 0, None)
+            )(params, states, carries, x, y, keys, w, fm, lm, scale)
             loss_s, scaled = self._lane_scale(loss_l, s_l, grads_l)
             return loss_s, s_l, states_l, carries_l, scaled
 
@@ -277,9 +467,14 @@ class ParallelWrapper:
         def step(params, states, opts, carries, iteration, x, y, keys, w,
                  fm, lm):
             loss_s, s_l, states_l, carries_l, scaled = j_lanes(
-                params, states, carries, x, y, keys, w, fm, lm)
-            loss, grads, new_states = j_combine(loss_s, s_l, states_l,
-                                                scaled)
+                params, states, carries, x, y, keys, w, fm, lm,
+                self._loss_scale_arg())
+            if compressed:
+                loss, grads, new_states = self._run_compressed_combine(
+                    j_combine, (loss_s, s_l, states_l, scaled))
+            else:
+                loss, grads, new_states = j_combine(loss_s, s_l, states_l,
+                                                    scaled)
             new_params, new_opts = j_update(params, opts, grads, iteration)
             return new_params, new_states, new_opts, carries_l, loss
 
@@ -299,8 +494,9 @@ class ParallelWrapper:
 
         if self._sharded_step is None:
             self._build()
+        self._adopt_compression_state()
         model = self.model
-        if (self.deterministic
+        if (self._uses_lanes
                 and getattr(model.conf, "tbptt_length", None)
                 and not isinstance(model._updaters, dict)
                 and np.ndim(ds.features) == 3 and np.ndim(ds.labels) == 3
@@ -308,7 +504,7 @@ class ParallelWrapper:
             return self._step_batch_tbptt(ds)
         x, y, w = self._shard(ds.features, ds.labels)
         model._rng_key, sub = jax.random.split(model._rng_key)
-        key_arg = self._lane_keys(sub) if self.deterministic else sub
+        key_arg = self._lane_keys(sub) if self._uses_lanes else sub
         t0 = _time.time_ns()
         with tm.span("parallel.step", iteration=model.iteration,
                      replicas=self.mesh.data):
@@ -324,6 +520,7 @@ class ParallelWrapper:
         if (self.skew_every and tm.enabled()
                 and model.iteration % self.skew_every == 0):
             self._probe_replica_skew(loss, t0)
+            self._publish_compression_stats()
         for lst in model.listeners:
             lst.iteration_done(model, model.iteration, model.epoch)
         return loss
@@ -391,9 +588,35 @@ class ParallelWrapper:
         return self.model
 
     def _shard(self, x, y):
-        if self.deterministic:
+        if self._uses_lanes:
             return self.mesh.pad_lane_batch(x, y, self.replicas)
         return self.mesh.pad_shard_batch(x, y)
+
+    # --------------------------------------------------- compression stats
+    def compression_stats(self) -> Optional[dict]:
+        """Latest step's deterministic wire accounting as plain floats
+        (one host sync — window-cadence material, not per-step), also
+        pushed to the ``parallel.allreduce_*`` telemetry gauges. None when
+        compression is off or no compressed step ran yet."""
+        if self._comp_stats is None:
+            return None
+        stats = {k: float(v) for k, v in self._comp_stats.items()}
+        thr = self._comp_state.get("threshold") \
+            if self._comp_state is not None else None
+        if thr is not None:
+            stats["threshold"] = float(jax.device_get(thr))
+        if tm.enabled():
+            tm.gauge("parallel.allreduce_wire_bytes", stats["wire_bytes"])
+            tm.gauge("parallel.allreduce_dense_bytes", stats["dense_bytes"])
+            tm.gauge("parallel.allreduce_compression_ratio", stats["ratio"])
+            tm.counter("parallel.allreduce_wire_bytes_total",
+                       value=stats["wire_bytes"])
+            tm.counter("parallel.allreduce_exchanges_total")
+        return stats
+
+    def _publish_compression_stats(self):
+        if self._comp_stats is not None and tm.enabled():
+            self.compression_stats()
 
     # ------------------------------------------------------- layout plumbing
     def _publish_layout(self):
@@ -408,13 +631,22 @@ class ParallelWrapper:
         tm.gauge("parallel.zero_state_sharded_fraction", frac)
         tm.gauge("parallel.opt_state_bytes_per_device",
                  self.opt_state_bytes_per_device())
+        comp = self._compressor
         self.layout = {
             "signature": mesh.layout_signature(
                 extra=(self.zero_optimizer, self.deterministic,
-                       self.replicas)),
+                       self.replicas,
+                       (comp.scheme, comp.hosts) if comp else None)),
             "params": gspmd.describe_shardings(self.model.params),
             "opt_states": gspmd.describe_shardings(self.model.opt_states),
         }
+        if comp is not None:
+            tm.gauge("parallel.grad_compression_hosts", comp.hosts)
+            self.layout["grad_compression"] = {
+                "scheme": comp.scheme, "hosts": comp.hosts,
+                "residual": gspmd.describe_shardings(
+                    self._comp_state["residual"]),
+            }
 
     def opt_state_bytes_per_device(self) -> int:
         """Bytes of optimizer state ONE device holds — the ZeRO memory
@@ -436,6 +668,15 @@ class ParallelWrapper:
         model.states = jax.tree_util.tree_map(np.asarray, model.states)
         model.opt_states = jax.tree_util.tree_map(np.asarray,
                                                   model.opt_states)
+        if self._comp_state is not None:
+            # residual/threshold migrate with the regroup: the lane count
+            # is fixed at construction, so the worker-stacked shapes are
+            # mesh-independent and the re-placed fit continues the SAME
+            # error-feedback trajectory (trajectory-exact regroup —
+            # tests/test_compression.py)
+            model._grad_comp_state = jax.tree_util.tree_map(
+                np.asarray, self._comp_state)
+            self._comp_state = None
         if mesh is None:
             # re-derive from the CURRENT device view (after worker loss the
             # survivors), keeping the model/seq factors when they still fit
@@ -452,6 +693,7 @@ class ParallelWrapper:
         self._sharded_step = None
         self._tbptt_step = None
         self._zero_specs = None
+        self._comp_specs = None
         self._build()
         tm.counter("parallel.reshards_total")
         return self
@@ -468,12 +710,12 @@ class ParallelWrapper:
         from deeplearning4j_tpu.util import cost_model as _cm
 
         model = self.model
-        if self.deterministic:
-            raise NotImplementedError(
-                "cost_report targets the default GSPMD step; build a "
-                "non-deterministic wrapper for cost analysis")
         if self._sharded_step is None:
             self._build()
+        if self._uses_lanes:
+            return self._cost_report_lanes(
+                batch_size=batch_size, shape=shape, dtype=dtype, name=name,
+                publish=publish)
         conf = model.conf
         if shape is None:
             if getattr(conf, "input_shape", None) is None:
@@ -529,6 +771,112 @@ class ParallelWrapper:
             params_total=model.num_params(), source=source, model=str(name),
             peak_flops=_cm.peak_flops_from_env(
                 getattr(self.model.conf, "compute_dtype", None)),
+            devices=self.mesh.n_devices)
+        if publish:
+            _cm.publish_report(str(name), report)
+        return report
+
+    def _cost_report_lanes(self, batch_size=None, *, shape=None,
+                           dtype=jnp.float32, name: str = "parallel",
+                           publish: bool = True):
+        """Cost report for the LANE-DECOMPOSED step (deterministic mode and
+        the compressed-DP path): the step is deliberately staged as three
+        jit programs (lanes / combine / update — the FMA-contraction
+        determinism note above), so the report lowers ALL THREE with the
+        fit-time shapes/shardings, sums their per-device totals, and merges
+        their per-layer attributions — the lanes program carries the
+        ``layer:*`` scopes, the update program the ``(optimizer)`` row, the
+        combine (and encode, when compressing) lands in ``(untagged)``."""
+        from deeplearning4j_tpu.util import cost_model as _cm
+
+        model = self.model
+        conf = model.conf
+        if shape is None:
+            if getattr(conf, "input_shape", None) is None:
+                raise ValueError("cost_report() needs shape= or "
+                                 "conf.input_shape")
+            shape = ((int(batch_size or 8 * self.mesh.data),)
+                     + tuple(conf.input_shape))
+        shape = tuple(int(d) for d in shape)
+        b, R = shape[0], self.replicas
+        if b % R:
+            raise ValueError(f"global batch {b} must divide the lane count "
+                             f"({R})")
+
+        def struct(t):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    jnp.shape(a), jnp.asarray(a).dtype,
+                    sharding=getattr(a, "sharding", None)), t)
+
+        lane_shape = (R, b // R) + tuple(shape[1:])
+        lsh = (self.mesh.spec("data", *([None] * (len(lane_shape) - 1)))
+               if self.mesh.n_devices > 1 else None)
+        x_s = jax.ShapeDtypeStruct(lane_shape, dtype, sharding=lsh)
+        y_shape = (R, b // R) + tuple(model._output_shape)
+        y_s = jax.ShapeDtypeStruct(
+            y_shape, jnp.float32,
+            sharding=(self.mesh.spec("data", *([None] * (len(y_shape) - 1)))
+                      if self.mesh.n_devices > 1 else None))
+        w_s = jax.ShapeDtypeStruct(
+            (R, b // R), jnp.float32,
+            sharding=(self.mesh.spec("data", None)
+                      if self.mesh.n_devices > 1 else None))
+        keys_s = struct(self._lane_keys(jax.random.PRNGKey(0)))
+        scale = self._loss_scale_arg()
+        scale_s = None if scale is None else struct(scale)
+        p_s, s_s, o_s = (struct(model.params), struct(model.states),
+                         struct(model.opt_states))
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+
+        j_lanes, j_combine, j_update = self._stage_jits
+        lanes_args = (p_s, s_s, x_s, y_s, keys_s, w_s, scale_s)
+        lanes_out = jax.eval_shape(j_lanes, *lanes_args)
+        if self._compressor is not None:
+            comb_args = tuple(lanes_out) + (struct(self._comp_state),)
+            _loss, grads_s = jax.eval_shape(j_combine, *comb_args)[:2]
+        else:
+            comb_args = tuple(lanes_out)
+            _loss, grads_s, _st = jax.eval_shape(j_combine, *comb_args)
+        upd_args = (p_s, o_s, grads_s, it_s)
+
+        params_by_tag = {}
+        if hasattr(model, "_layer_tags"):
+            params_by_tag = {
+                t: int(sum(int(np.prod(l.shape))
+                           for l in jax.tree_util.tree_leaves(p)))
+                for t, p in zip(model._layer_tags, model.params)}
+        totals: dict = {}
+        merged: Optional[_cm.HloAttribution] = None
+        source = "analytic"
+        try:
+            for fn, args in ((j_lanes, lanes_args), (j_combine, comb_args),
+                             (j_update, upd_args)):
+                compiled = fn.lower(*args).compile()
+                for k, v in _cm.compiled_totals(compiled).items():
+                    totals[k] = totals.get(k, 0.0) + v
+                att = _cm.attribute_hlo(_cm.compiled_text(compiled))
+                if merged is None:
+                    merged = att
+                else:
+                    for key, costs in att.by_layer.items():
+                        dst = merged.by_layer.setdefault(key, {})
+                        for ck, cv in costs.items():
+                            dst[ck] = dst.get(ck, 0.0) + cv
+                    merged.flops_total += att.flops_total
+                    merged.transcendentals_total += att.transcendentals_total
+                    merged.bytes_total += att.bytes_total
+                    merged.inst_map.update(att.inst_map)
+            source = "xla"
+        except _cm.CostAnalysisUnavailable:
+            totals, merged = {}, None
+        rows = (_cm.rows_from_attribution(merged, params_by_tag, None)
+                if merged is not None else [])
+        report = _cm.CostReport(
+            rows=rows, totals=totals, batch=b,
+            params_total=model.num_params(), source=source, model=str(name),
+            peak_flops=_cm.peak_flops_from_env(
+                getattr(conf, "compute_dtype", None)),
             devices=self.mesh.n_devices)
         if publish:
             _cm.publish_report(str(name), report)
@@ -602,25 +950,45 @@ class ParallelWrapper:
             raise ValueError("warmup() needs label_shape")
         zeros = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda a: jnp.zeros(a.shape, a.dtype), t)
+        # the compressed step donates (and advances) the resident
+        # residual/threshold through self._comp_state: park the REAL state
+        # and run warmup on a shadow copy, so priming executables never
+        # perturbs the error-feedback trajectory
+        real_comp = self._comp_state
+        real_stats = self._comp_stats
         primed = 0
-        for b in batch_sizes:
-            x = np_.zeros((int(b),) + in_shape, np_.float32)
-            y = np_.zeros((int(b),) + out_shape, np_.float32)
-            xs, ys, w = self._shard(x, y)
-            # shadow state, same shardings as the real one (params/states
-            # replicated, optimizer state ZeRO-sharded when enabled — the
-            # warm executable must match the fit-time layout, which is part
-            # of jit's dispatch key and the persistent compile-cache key)
-            p = self.mesh.replicate(zeros(model.params), keep_existing=False)
-            s = self.mesh.replicate(zeros(model.states), keep_existing=False)
-            o = zeros(model.opt_states)
-            o = (gspmd.place_tree(o, self._zero_specs)
-                 if self._zero_specs is not None
-                 else self.mesh.replicate(o, keep_existing=False))
-            key = (self._lane_keys(jax.random.PRNGKey(0))
-                   if self.deterministic else jax.random.PRNGKey(0))
-            self._sharded_step(p, s, o, jnp.asarray(0), xs, ys, key, w)
-            primed += 1
+        try:
+            for b in batch_sizes:
+                x = np_.zeros((int(b),) + in_shape, np_.float32)
+                y = np_.zeros((int(b),) + out_shape, np_.float32)
+                xs, ys, w = self._shard(x, y)
+                # shadow state, same shardings as the real one (params/
+                # states replicated, optimizer state ZeRO-sharded when
+                # enabled — the warm executable must match the fit-time
+                # layout, which is part of jit's dispatch key and the
+                # persistent compile-cache key)
+                p = self.mesh.replicate(zeros(model.params),
+                                        keep_existing=False)
+                s = self.mesh.replicate(zeros(model.states),
+                                        keep_existing=False)
+                o = zeros(model.opt_states)
+                o = (gspmd.place_tree(o, self._zero_specs)
+                     if self._zero_specs is not None
+                     else self.mesh.replicate(o, keep_existing=False))
+                if real_comp is not None:
+                    shadow = zeros(real_comp)
+                    if self._comp_specs is not None:
+                        shadow = gspmd.place_tree(shadow, self._comp_specs)
+                    self._comp_state = shadow
+                key = (self._lane_keys(jax.random.PRNGKey(0))
+                       if self._uses_lanes else jax.random.PRNGKey(0))
+                self._sharded_step(p, s, o, jnp.asarray(0), xs, ys, key, w)
+                primed += 1
+        finally:
+            self._comp_state = real_comp
+            self._comp_stats = real_stats
+            if real_comp is not None:
+                self.model._grad_comp_state = real_comp
         return primed
 
 

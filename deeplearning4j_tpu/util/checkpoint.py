@@ -51,7 +51,54 @@ from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.faults import RetryPolicy
 
 _META_FILE = "elastic_meta.json"
+_COMP_FILE = "grad_comp.npz"
 _TMP_PREFIX = ".tmp-"
+
+
+def _tree_spec(tree, arrays: list):
+    """JSON-able spec of a nested dict/list/None pytree with array leaves
+    (the shape of the gradient-compression state — residual tree +
+    threshold). Leaves append to ``arrays`` and are referenced by index."""
+    if tree is None:
+        return {"t": "none"}
+    if isinstance(tree, dict):
+        return {"t": "dict",
+                "items": [[k, _tree_spec(v, arrays)]
+                          for k, v in tree.items()]}
+    if isinstance(tree, (list, tuple)):
+        return {"t": "list" if isinstance(tree, list) else "tuple",
+                "items": [_tree_spec(v, arrays) for v in tree]}
+    arrays.append(np.asarray(tree))
+    return {"t": "arr", "i": len(arrays) - 1}
+
+
+def _tree_unspec(spec, arrays):
+    if spec["t"] == "none":
+        return None
+    if spec["t"] == "dict":
+        return {k: _tree_unspec(v, arrays) for k, v in spec["items"]}
+    if spec["t"] in ("list", "tuple"):
+        items = [_tree_unspec(v, arrays) for v in spec["items"]]
+        return items if spec["t"] == "list" else tuple(items)
+    return arrays[spec["i"]]
+
+
+def save_tree_npz(path: str, tree) -> None:
+    """One-file (npz) serialization of a dict/list-structured pytree — the
+    gradient-compression sidecar format (residual + threshold ride next to
+    the orbax state inside the SAME atomic checkpoint commit)."""
+    arrays: list = []
+    spec = _tree_spec(tree, arrays)
+    np.savez(path, __spec__=np.frombuffer(
+        json.dumps(spec).encode(), dtype=np.uint8),
+        **{f"a{i}": a for i, a in enumerate(arrays)})
+
+
+def load_tree_npz(path: str):
+    with np.load(path) as z:
+        spec = json.loads(bytes(z["__spec__"].tobytes()).decode())
+        arrays = {int(k[1:]): z[k] for k in z.files if k != "__spec__"}
+    return _tree_unspec(spec, [arrays[i] for i in range(len(arrays))])
 
 #: checkpoint I/O default: a couple of quick retries, bounded overall
 _IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.2, max_delay=2.0,
@@ -100,7 +147,8 @@ class ShardedCheckpointer:
         return jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)),
                                       state)
 
-    def _commit(self, step: int, state: dict, extra_meta: Optional[dict]):
+    def _commit(self, step: int, state: dict, extra_meta: Optional[dict],
+                comp_state=None):
         """Write to .tmp-<step>, fsync-equivalent via orbax, then atomically
         rename into place and rotate keep-N. Runs under the retry policy."""
         import orbax.checkpoint as ocp
@@ -157,6 +205,12 @@ class ShardedCheckpointer:
                 close = getattr(ckptr, "close", None)
                 if close:
                     close()
+            if comp_state is not None:
+                # gradient-compression sidecar (parallel/compression.py):
+                # the error-feedback residual + adaptive threshold commit
+                # ATOMICALLY with the params they pair with — a resumed
+                # compressed fit continues the exact trajectory
+                save_tree_npz(os.path.join(tmp, _COMP_FILE), comp_state)
             write_meta(tmp)
             os.replace(tmp, final)  # THE commit point
 
@@ -179,13 +233,16 @@ class ShardedCheckpointer:
         the caller's next train step overlaps the checkpoint I/O."""
         self.wait_until_finished()  # one in-flight save at a time
         state = self._host_snapshot(self._state(model))
+        comp = getattr(model, "_grad_comp_state", None)
+        if comp is not None:
+            comp = self._host_snapshot(comp)
         if block:
-            self._commit(step, state, extra_meta)
+            self._commit(step, state, extra_meta, comp_state=comp)
             return
 
         def run():
             try:
-                self._commit(step, state, extra_meta)
+                self._commit(step, state, extra_meta, comp_state=comp)
             except BaseException as e:  # noqa: BLE001 — crosses the thread
                 with self._lock:
                     self._pending_error = e
@@ -308,6 +365,16 @@ class ShardedCheckpointer:
             import jax.numpy as jnp
 
             model._rng_key = jnp.asarray(restored["meta"]["rng_key"])
+        # gradient-compression sidecar: restore the error-feedback residual
+        # + threshold alongside the params (ParallelWrapper re-adopts the
+        # model-side tree on its next step — parallel/wrapper.py). A
+        # checkpoint WITHOUT the sidecar resets any live compression state:
+        # the restored params never saw that residual.
+        comp_path = os.path.join(path, _COMP_FILE)
+        if os.path.exists(comp_path):
+            model._grad_comp_state = load_tree_npz(comp_path)
+        elif getattr(model, "_grad_comp_state", None) is not None:
+            model._grad_comp_state = None
         return model
 
     def restore_latest_good(self, model) -> Optional[int]:

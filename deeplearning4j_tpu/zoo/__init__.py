@@ -10,6 +10,7 @@ ModelSerializer restore for locally saved weights instead.
 """
 
 from deeplearning4j_tpu.zoo.bert import Bert  # noqa: F401
+from deeplearning4j_tpu.zoo.unet import DiffusionUNet  # noqa: F401
 from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet,
     Darknet19,

@@ -1,0 +1,123 @@
+"""Diffusion U-Net zoo workload (ROADMAP item 5).
+
+A DDPM-style noise-prediction U-Net on the ComputationGraph DSL: conv-heavy
+encoder/decoder with skip connections (MergeVertex concat, the U-Net paper's
+copy-and-crop collapsed to same-size concat at SAME padding), stride-2 conv
+downsampling, Upsampling2D decoder, and a sinusoidal-free timestep
+conditioning path — a 2-layer MLP embedding of the scalar diffusion step,
+broadcast-added onto the bottleneck feature map (ReshapeVertex to (1,1,E) +
+ElementWiseVertex add). The head is a 1x1 conv predicting the per-pixel
+noise, trained with plain MSE (the DDPM simple loss).
+
+Why it exists here: the zoo's conv workloads were all classification heads —
+this one stresses (a) the per-layer conv cost model on a DAG whose FLOPs are
+split across resolutions (util/cost_model.py rows must still reconcile), and
+(b) the compressed-DP path end-to-end on a conv topology with
+multi-megabyte gradients (tests/test_zoo_unet.py fits it through
+``ParallelWrapper(grad_compression="threshold")``).
+
+Reference framing: the reference zoo ships UNet.java (segmentation); the
+diffusion variant differs only in the conditioning path and the regression
+head — the encoder/decoder skeleton is UNet.java's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from deeplearning4j_tpu.nn import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    LossLayer,
+    Upsampling2D,
+)
+from deeplearning4j_tpu.nn.vertices import (
+    ElementWiseVertex,
+    MergeVertex,
+    ReshapeVertex,
+)
+from deeplearning4j_tpu.zoo.models import ZooModel
+
+
+@dataclasses.dataclass
+class DiffusionUNet(ZooModel):
+    """Noise-prediction U-Net: ``fit([image, timestep], [noise])``.
+
+    ``image``: (H, W, C) NHWC, ``timestep``: (1,) scalar diffusion step
+    (normalize to [0, 1] on the host), label: (H, W, C) noise target.
+    ``depth`` downsamplings halve the resolution each level (H, W must be
+    divisible by 2**depth); channels grow ``base_channels * 2**level``.
+    """
+
+    input_shape: Tuple[int, int, int] = (32, 32, 3)
+    base_channels: int = 16
+    depth: int = 2
+    time_embed: int = 0  # 0 = base_channels * 2**depth (bottleneck width)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        if h % (2 ** self.depth) or w % (2 ** self.depth):
+            raise ValueError(
+                f"input {h}x{w} not divisible by 2**depth={2 ** self.depth}")
+        gb = (self._builder().graph_builder()
+              .add_inputs("image", "timestep"))
+
+        def conv_block(name, inp, n_out, stride=(1, 1)):
+            gb.add_layer(f"{name}_conv",
+                         ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                          stride=stride, padding="SAME",
+                                          has_bias=False), inp)
+            gb.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                         f"{name}_bn")
+            return f"{name}_relu"
+
+        # ---------------------------------------------------------- encoder
+        x = conv_block("stem", "image", self.base_channels)
+        skips = []
+        ch = self.base_channels
+        for lvl in range(self.depth):
+            x = conv_block(f"enc{lvl}_a", x, ch)
+            skips.append((x, ch))
+            ch *= 2
+            # stride-2 conv downsample (the reference UNet's pool, as conv)
+            x = conv_block(f"enc{lvl}_down", x, ch, stride=(2, 2))
+
+        # ------------------------------------------- bottleneck + time MLP
+        x = conv_block("mid_a", x, ch)
+        emb = self.time_embed or ch
+        gb.add_layer("t_embed1", DenseLayer(n_in=1, n_out=emb,
+                                            activation="relu"), "timestep")
+        gb.add_layer("t_embed2", DenseLayer(n_in=emb, n_out=ch,
+                                            activation="identity"),
+                     "t_embed1")
+        gb.add_vertex("t_map", ReshapeVertex(new_shape=(1, 1, ch)),
+                      "t_embed2")
+        # broadcast-add the (B,1,1,ch) embedding onto the (B,h,w,ch) map;
+        # ElementWiseVertex's output shape follows its FIRST input
+        gb.add_vertex("mid_cond", ElementWiseVertex(op="add"), x, "t_map")
+        x = conv_block("mid_b", "mid_cond", ch)
+
+        # ---------------------------------------------------------- decoder
+        for lvl in reversed(range(self.depth)):
+            skip, skip_ch = skips[lvl]
+            gb.add_layer(f"dec{lvl}_up", Upsampling2D(size=2), x)
+            gb.add_vertex(f"dec{lvl}_cat", MergeVertex(), f"dec{lvl}_up",
+                          skip)
+            ch //= 2
+            x = conv_block(f"dec{lvl}_a", f"dec{lvl}_cat", ch)
+            x = conv_block(f"dec{lvl}_b", x, ch)
+
+        # 1x1 conv noise head + DDPM simple (MSE) loss
+        gb.add_layer("noise", ConvolutionLayer(n_out=c, kernel_size=(1, 1),
+                                               padding="SAME",
+                                               activation="identity"), x)
+        gb.add_layer("loss", LossLayer(loss="mse"), "noise")
+        gb.set_outputs("loss")
+        gb.set_input_types(InputType.convolutional(h, w, c),
+                           InputType.feed_forward(1))
+        return gb.build()

@@ -130,10 +130,91 @@ def _elastic(shared_dir, pid, world, sigkill_at=None):
     }), flush=True)
 
 
+def _elastic_compress(shared_dir, pid, world, sigkill_at=None):
+    """``--elastic-compress`` mode: one member of a supervised elastic pod
+    whose data plane is the COMPRESSED ParallelWrapper DP step
+    (parallel/compression.py). Proves the residual/threshold state rides
+    the elastic machinery: a SIGKILLed peer's loss regroups the survivor
+    (whose wrapper re-shards with its residual migrated in place), and the
+    final checkpoint carries the residual EXACTLY (bit-compared against a
+    fresh restore before reporting)."""
+    import os
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel import (ElasticTrainer, FileMembership,
+                                             ParallelWrapper, TrainingMesh)
+    from deeplearning4j_tpu.util.faults import SIGKILL_HOST, get_injector
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.05))
+                .grad_compression("threshold", threshold=1e-3)
+                .list()
+                .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=4, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        return MultiLayerNetwork(conf).init()
+
+    net = build_net()
+    pw = ParallelWrapper(net, mesh=TrainingMesh(data=len(jax.devices())),
+                         replicas=4, skew_every=0)
+    rng = np.random.default_rng(0)  # same data recipe on every member
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    it = ArrayDataSetIterator(xs, ys, batch=8)  # 8 batches / epoch
+
+    if sigkill_at is not None:
+        get_injector().inject(SIGKILL_HOST, at_step=sigkill_at)
+    membership = FileMembership(
+        os.path.join(shared_dir, "membership"), process_id=pid,
+        world_size=world, heartbeat_interval=0.3, miss_threshold=8,
+        barrier_timeout=90.0, log_fn=None)
+    trainer = ElasticTrainer(
+        pw, os.path.join(shared_dir, f"ckpt-{pid}"), checkpoint_every=4,
+        membership=membership, log_fn=None)
+    trainer.fit(it, epochs=3)
+
+    # checkpoint-resume carries the residual exactly: restore the FINAL
+    # checkpoint into a fresh net and bit-compare the compression state
+    net2 = build_net()
+    trainer.ckpt.restore(net2)
+    live = jax.tree_util.tree_leaves(net._grad_comp_state)
+    restored = jax.tree_util.tree_leaves(net2._grad_comp_state)
+    residual_exact = (
+        len(live) == len(restored)
+        and all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(live, restored))
+        and any(np.asarray(a).any() for a in live))  # non-trivial residual
+
+    view = membership.view
+    stats = pw.compression_stats()
+    print(json.dumps({
+        "pid": pid,
+        "state": trainer.state,
+        "iteration": net.iteration,
+        "epoch": net.epoch,
+        "world_final": view.world if view else None,
+        "members_final": list(view.members) if view else None,
+        "regroups": membership.regroups,
+        "score_finite": bool(np.isfinite(float(net.score_value))),
+        "residual_exact": bool(residual_exact),
+        "wire_bytes": stats["wire_bytes"] if stats else None,
+        "threshold": stats["threshold"] if stats else None,
+    }), flush=True)
+
+
 def main():
     if sys.argv[1] == "--elastic":
         _elastic(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
                  int(sys.argv[5]) if len(sys.argv) > 5 else None)
+        return
+    if sys.argv[1] == "--elastic-compress":
+        _elastic_compress(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                          int(sys.argv[5]) if len(sys.argv) > 5 else None)
         return
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     distributed.initialize(coordinator=coordinator, num_processes=nproc,

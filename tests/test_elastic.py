@@ -525,6 +525,42 @@ class TestFaultRecoveryPaths:
         # to all 8 for the remaining epochs
         assert r["iteration"] == 4 + 8 + 8
 
+    def test_sigkill_with_grad_compression_migrates_residual(self, tmp_path):
+        """Elastic × compression (ISSUE 10 satellite): same 2-process
+        SIGKILL scenario, but the data plane is the COMPRESSED
+        ParallelWrapper step — the survivor regroups with its
+        error-feedback residual/threshold migrated through reshard (the
+        iteration trace proves it kept training), and the final checkpoint
+        carries the residual EXACTLY (bit-compared in-process against a
+        fresh restore)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        env.pop("XLA_FLAGS", None)
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_dist_worker.py")
+        d = str(tmp_path / "pod")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, "--elastic-compress", d, str(pid), "2"]
+            + (["2"] if pid == 1 else []),  # pid 1 SIGKILLs itself at step 2
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        out0, err0 = procs[0].communicate(timeout=240)
+        out1, _ = procs[1].communicate(timeout=240)
+        assert procs[1].returncode == -signal.SIGKILL
+        assert not out1.strip()
+        assert procs[0].returncode == 0, err0[-1500:]
+        r = json.loads([l for l in out0.splitlines()
+                        if l.startswith("{")][-1])
+        assert r["state"] == "completed"
+        assert r["world_final"] == 1 and r["members_final"] == [0]
+        assert r["regroups"] >= 1
+        assert r["epoch"] == 3 and r["score_finite"]
+        assert r["iteration"] == 4 + 8 + 8  # same trace as the plain leg
+        assert r["residual_exact"], r  # checkpoint carried the residual
+        assert r["wire_bytes"] and r["wire_bytes"] > 0
+        assert r["threshold"] and r["threshold"] > 0
+
 
 def _slow_double(v):
     time.sleep(0.005)  # keep workers alive long enough to be killed

@@ -244,6 +244,9 @@ OVERRIDES = {
     "leakyrelu": lambda f: f(XN),
     "threshold_encode": lambda f: f(XN, 0.1),
     "threshold_decode": lambda f: f(XN),
+    "threshold_encode_exact": lambda f: f(XN, 0.1),
+    "onebit_encode": lambda f: f(XN),
+    "pow2_floor": lambda f: f(0.3),
     "bitmap_encode": lambda f: f(XN, 0.1),
     "bitmap_decode": lambda f: None,  # needs encode output; covered in test_distributed
     "lstm_layer": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((1, 8, 4)) * 0.1,
