@@ -1,0 +1,166 @@
+"""CI smoke for the autotuning subsystem (ISSUE 11, docs/AUTOTUNE.md).
+
+Runs on 8 virtual CPU devices and proves the machinery end-to-end, the
+way the first real-TPU session will use it — nothing mocked, every
+assertion on the real measurement/persistence/dispatch path:
+
+1. **Cold sweep** (subprocess, ``benchmarks/autotune.py``): the conv tile
+   space sweeps with a PLANTED-SLOW exact candidate (per-call sleep) and
+   a PLANTED-WRONG tile candidate (outputs perturbed). Asserts the slow
+   plant demonstrably LOSES (winner is a pallas tile), the wrong plant is
+   REJECTED by the equivalence gate, and winners persist to the database.
+2. **Deterministic DB**: a second cold sweep (same seed, fresh dir)
+   produces the same key files, the same candidate-set digests, and the
+   same winner impl.
+3. **Warm process**: re-running the sweep against the populated database
+   measures NOTHING (``tuning.measurements_total == 0``, every space
+   ``warm``) and returns the identical winner — the cross-process
+   contract.
+4. **Trace-time dispatch**: with ``DL4J_TPU_TUNING_DB`` armed, in-process
+   ``kernel_impl=auto`` conv resolution consults the database
+   (``tuning.hits_total`` > 0), runs the tuned winner, and matches the
+   exact path within the documented seam tolerance.
+
+Exit 0 on success; any assertion failure exits non-zero (the CI legs in
+.github/workflows/ci.yml + .github/ci_local.sh run this file directly).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AUTOTUNE = os.path.join(REPO, "benchmarks", "autotune.py")
+
+# the planted-wrong label must name a real candidate of the default CPU
+# conv contexts (oh=16 -> tiles 1,2,4,8 all enumerate)
+PLANT_WRONG = "pallas:rt=1"
+
+
+def run_sweep(db_dir, extra=()):
+    env = dict(os.environ)
+    env.pop("DL4J_TPU_TUNING_DB", None)   # --db is authoritative here
+    cmd = [sys.executable, AUTOTUNE, "--db", db_dir,
+           "--spaces", "conv2d_tiles", "--seed", "0",
+           "--min-window", "0.02", "--json", *extra]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=600)
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr)
+        raise AssertionError(f"autotune.py failed rc={proc.returncode}")
+    line = [ln for ln in proc.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def key_files(db_dir):
+    return sorted(f for f in os.listdir(db_dir) if f.endswith(".json"))
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="dl4j-autotune-smoke.")
+    db_a = os.path.join(work, "db_a")
+    db_b = os.path.join(work, "db_b")
+    plants = ["--plant-slow", "exact:0.03", "--plant-wrong", PLANT_WRONG]
+
+    # -- 1. cold sweep with planted slow + planted wrong ------------------
+    rep_a = run_sweep(db_a, plants)
+    assert rep_a["spaces"], "no spaces swept"
+    for row in rep_a["spaces"]:
+        assert "error" not in row, row
+        assert row["status"] == "measured", row
+        # the planted-slow exact candidate must LOSE to a pallas tile
+        assert row["winner"]["impl"] == "pallas", row["winner"]
+        assert row["winner"]["label"] != "exact", row["winner"]
+        # the planted-wrong tile must be rejected by the equivalence gate
+        assert row["rejected"] >= 1, row
+    c = rep_a["counters"]
+    assert c.get("tuning.measurements_total", 0) > 0, c
+    assert c.get("tuning.equivalence_rejects_total", 0) >= len(
+        rep_a["spaces"]), c
+    print(f"[1] cold sweep: {len(rep_a['spaces'])} contexts measured, "
+          f"planted-slow lost, planted-wrong rejected "
+          f"({c['tuning.measurements_total']:g} measurements, "
+          f"{c['tuning.equivalence_rejects_total']:g} rejects)")
+
+    # -- 2. deterministic database ---------------------------------------
+    rep_b = run_sweep(db_b, plants)
+    assert key_files(db_a) == key_files(db_b), (
+        key_files(db_a), key_files(db_b))
+    for ra, rb in zip(rep_a["spaces"], rep_b["spaces"]):
+        assert ra["sig"] == rb["sig"]
+        assert ra["winner"]["impl"] == rb["winner"]["impl"]
+    digests_a = sorted(json.load(open(os.path.join(db_a, f)))
+                       ["candidates_digest"] for f in key_files(db_a))
+    digests_b = sorted(json.load(open(os.path.join(db_b, f)))
+                       ["candidates_digest"] for f in key_files(db_b))
+    assert digests_a == digests_b
+    print(f"[2] deterministic DB: {len(key_files(db_a))} identical keys + "
+          "candidate digests across independent cold sweeps")
+
+    # -- 3. warm process measures nothing --------------------------------
+    rep_w = run_sweep(db_a, plants)
+    cw = rep_w["counters"]
+    assert cw.get("tuning.measurements_total", 0) == 0, cw
+    assert all(r["status"] == "warm" for r in rep_w["spaces"]), \
+        [r["status"] for r in rep_w["spaces"]]
+    assert cw.get("tuning.hits_total", 0) >= len(rep_w["spaces"]), cw
+    for ra, rw in zip(rep_a["spaces"], rep_w["spaces"]):
+        assert ra["winner"] == rw["winner"], (ra["winner"], rw["winner"])
+    print(f"[3] warm process: 0 measurements, "
+          f"{cw['tuning.hits_total']:g} database hits, winners identical")
+
+    # -- 4. trace-time auto dispatch consults the database ----------------
+    os.environ["DL4J_TPU_TUNING_DB"] = db_a
+    import jax
+
+    # force CPU like the sibling smokes: the env var alone does not win
+    # over this image's preset platform (see tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu import tuning
+    from deeplearning4j_tpu.ops import kernels as K
+    from deeplearning4j_tpu.ops import nn as nnops
+    from deeplearning4j_tpu.util import telemetry as tm
+
+    rng = np.random.default_rng(0)
+    # the first default CPU conv context's geometry (tuning/space.py)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 3, 8, 16)) * 0.1, jnp.float32)
+    tele = tm.get_telemetry()
+    h0 = tele.counters.get(("tuning.hits_total", ()), 0)
+    out = nnops.conv2d(x, w)                      # kernel_impl=auto
+    h1 = tele.counters.get(("tuning.hits_total", ()), 0)
+    assert h1 > h0, (h0, h1)
+    with K.impl_scope("exact"):
+        exact = nnops.conv2d(x, w)
+    err = float(jnp.max(jnp.abs(out - exact)))
+    assert err < 2e-4, err
+    status = tuning.current_status()
+    assert status["entries"] >= 2, status
+    print(f"[4] auto dispatch: resolved through the DB "
+          f"(hits {h0:g}->{h1:g}), tuned output matches exact "
+          f"(max diff {err:.2e}); /healthz section: "
+          f"{status['entries']} entries")
+
+    shutil.rmtree(work, ignore_errors=True)
+    print("autotune smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,10 @@ LOWER_BETTER = {
     # all-reduce payload vs its dense fp32 gradient on the 25M-param DP
     # workload — the wire math is deterministic, so this band is tight
     "encoded_allreduce_wire_bytes_ratio",
+    # autotuning subsystem (ISSUE 11): what consulting the tuning
+    # database costs kernel_impl=auto dispatch at trace time — one
+    # signature build + one in-memory-cached lookup; target ≤ 1.05x
+    "autotune_dispatch_overhead",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

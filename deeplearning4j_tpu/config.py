@@ -70,6 +70,15 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   the optimizer apply runs over dtype-grouped contiguous buffers in the
   donated train step instead of walking the param tree per leaf
   (docs/KERNELS.md#fused-optimizer-apply).
+- ``DL4J_TPU_TUNING_DB`` — directory of the persistent autotuning
+  database (tuning/database.py, docs/AUTOTUNE.md): measured winners keyed
+  by (op, shape-signature, dtype, backend, topology), written by
+  ``benchmarks/autotune.py`` sweeps and consulted at trace time by
+  ``kernel_impl=auto`` dispatch (conv/LSTM impl + tile parameters) and by
+  conf-time knob defaulting (an unset ``remat_policy`` takes the measured
+  winner). Every entry is equivalence-gated before commit — the r6
+  honesty convention made executable. Empty/unset = off (auto keeps its
+  honest prior: compiled kernels only on the real chip).
 - ``DL4J_TPU_GRAD_COMPRESSION`` — default ``grad_compression`` for new
   configs ("none" | "threshold" | "bitmap" | "onebit" —
   parallel/compression.py, docs/DISTRIBUTED.md#gradient-compression):
@@ -132,6 +141,9 @@ class Environment:
         # validated by the conf Builder so a typo fails at config build
         self.default_grad_compression = (
             os.environ.get("DL4J_TPU_GRAD_COMPRESSION") or None)
+        # autotuning database (tuning/database.py; the authoritative read
+        # is database_dir() — surfaced here so crash dumps show the knob)
+        self.tuning_db_dir = os.environ.get("DL4J_TPU_TUNING_DB") or None
         self.etl_workers = _env_int("DL4J_TPU_ETL_WORKERS", 0, floor=0)
         self.default_buckets = os.environ.get("DL4J_TPU_BUCKETS") or None
         self.compile_cache_dir = (

@@ -207,6 +207,26 @@ class Builder:
                 xla_tuning.resolve_policy(self._remat_policy)
             except ValueError as e:
                 raise ValueError(f"DL4J_TPU_REMAT_POLICY: {e}") from None
+        if self._remat_policy is None:
+            # conf-time knob defaulting through the tuning database
+            # (docs/AUTOTUNE.md): when the user/env left remat_policy
+            # unset AND DL4J_TPU_TUNING_DB holds a measured winner for
+            # this backend/topology, the deferred default flips to the
+            # committed evidence. Explicit .remat_policy(...) and the env
+            # knob always win; no database armed costs one global read.
+            from deeplearning4j_tpu.tuning import database as _tdb
+
+            if _tdb.database_dir() is not None:
+                tuned = _tdb.conf_default("remat_policy")
+                if tuned is not None:
+                    from deeplearning4j_tpu.util import xla_tuning
+
+                    try:
+                        xla_tuning.resolve_policy(tuned)
+                        self._remat_policy = tuned
+                    except ValueError:
+                        pass  # a stale DB names an unregistered policy:
+                        #       keep the safe default, never crash a build
         self._stage_barriers = False
         self._sync_every = env.default_sync_every
         self._batch_buckets = None

@@ -148,13 +148,24 @@ class LSTM(BaseRecurrentLayer):
         from deeplearning4j_tpu.ops import kernels as _kern
         from deeplearning4j_tpu.ops.kernels import lstm as _klstm
 
-        mode = _kern.dispatch(_klstm.supports(
-            xp[:, 0] if xp.ndim == 3 else xp, U,
-            self.gate_activation, self.activation))
+        xp0 = xp[:, 0] if xp.ndim == 3 else xp
+        mode, tuned = _kern.dispatch(
+            _klstm.supports(xp0, U, self.gate_activation, self.activation),
+            op="lstm_cell",
+            sig=_klstm.shape_signature(xp.shape[0], h),
+            dtype=str(xp.dtype))
+        # tile-aware VMEM guard AFTER dispatch (the conv seam's rule): a
+        # tuned b_tile winner is admitted with the batch block it was
+        # validated with; oversized/stale tiles fall back to exact
+        if mode is not None and not _klstm.fits_vmem(
+                xp0, U, tuned.get("b_tile")):
+            mode = None
         if mode is not None:
+            b_tile = tuned.get("b_tile")
+
             def step(c, xt):
                 h_new, c_new = _klstm.lstm_cell_fused(
-                    xt, c[0], c[1], U, _klstm.ORDER_IFOG, mode)
+                    xt, c[0], c[1], U, _klstm.ORDER_IFOG, mode, b_tile)
                 return (h_new, c_new), h_new
 
             return self._scan(step, carry, xp, mask)

@@ -73,24 +73,56 @@ def resolve_impl() -> str:
     return impl
 
 
-def dispatch(supported: bool) -> Optional[str]:
-    """The one dispatch rule. Returns ``None`` (take the exact path),
-    ``"pallas"`` (compiled kernel), or ``"interpret"`` (Pallas interpreter —
-    the forced-``pallas`` path on non-TPU backends, for correctness tests).
+def dispatch(supported: bool, op: Optional[str] = None,
+             sig: Optional[str] = None, dtype: Optional[str] = None):
+    """The one dispatch rule. Returns ``(mode, params)``: ``mode`` is
+    ``None`` (take the exact path), ``"pallas"`` (compiled kernel), or
+    ``"interpret"`` (Pallas interpreter — the forced-``pallas`` path on
+    non-TPU backends, for correctness tests); ``params`` carries the
+    tuned kernel parameters (e.g. conv ``row_tile``) or ``{}``.
 
     ``supported``: whether the call site's geometry/dtype has a kernel
-    (callers compute this — e.g. conv requires NHWC + HWIO + f32/bf16)."""
+    (callers compute this — e.g. conv requires NHWC + HWIO + f32/bf16).
+
+    ``auto`` resolution consults the tuning database (tuning/database.py,
+    docs/AUTOTUNE.md) when the call site passes its (op, shape-signature,
+    dtype) and ``DL4J_TPU_TUNING_DB`` is armed: a measured winner for the
+    current backend/topology decides impl AND parameters with committed
+    evidence — the cuDNN-style algorithm selection (arXiv:1410.0759)
+    subsumed by search. With no database or no entry, ``auto`` keeps the
+    honest prior: the compiled kernel only on the real chip."""
     if not supported:
-        return None
+        return None, {}
     impl = resolve_impl()
     if impl == "exact":
-        return None
+        return None, {}
     on_tpu = jax.default_backend() == "tpu"
     if impl == "auto":
-        # CPU cannot rank the kernels (docs/KERNELS.md honesty note): auto
-        # only ever engages the compiled kernel on the real chip
-        return "pallas" if on_tpu else None
-    return "pallas" if on_tpu else "interpret"
+        winner = _tuned_winner(op, sig, dtype)
+        if winner is not None:
+            if winner.get("impl") != "pallas":
+                return None, {}
+            params = dict(winner.get("params") or {})
+            return ("pallas" if on_tpu else "interpret"), params
+        # no measured evidence: CPU cannot rank the kernels
+        # (docs/KERNELS.md honesty note) — auto only ever engages the
+        # compiled kernel on the real chip
+        return ("pallas" if on_tpu else None), {}
+    return ("pallas" if on_tpu else "interpret"), {}
+
+
+def _tuned_winner(op, sig, dtype):
+    """Tuning-database consultation for ``auto`` dispatch: the winner
+    record or None. Cheap on the trace path — ``database_dir()`` is one
+    env/global read when no database is armed, and lookups are cached in
+    memory (positive and negative) once one is."""
+    if op is None or sig is None:
+        return None
+    from deeplearning4j_tpu.tuning import database as _tdb
+
+    if _tdb.database_dir() is None:
+        return None
+    return _tdb.resolve(op, sig, dtype or "float32")
 
 
 from deeplearning4j_tpu.ops.kernels import conv, lstm  # noqa: E402,F401

@@ -29,6 +29,19 @@ VMEM sizing: the forward block working set is roughly
 ``bytes(padded image group slice) + bytes(filter) + 4B * OH*OW*Cout_g``;
 :func:`fits_vmem` keeps ``auto`` dispatch honest — oversized feature maps
 stay on the exact path instead of faulting the chip.
+
+Tile parameterization (the autotuner's first search space — ISSUE 11,
+docs/AUTOTUNE.md): ``row_tile`` splits the forward program's output rows
+into blocks of ``row_tile`` rows — a third grid dimension whose block
+computes ``(row_tile*OW, Cg) x (Cg, Og)`` tap products instead of the whole
+``(OH*OW, Cg)`` product, shrinking the fp32 accumulator and changing the
+MXU tile geometry (TVM's schedule knob, arXiv:1802.04799 §4). ``None``
+keeps the historical whole-OH block and is the REGISTERED DEFAULT;
+:func:`valid_row_tiles` + :func:`fits_vmem`'s per-candidate accounting are
+the validated-shape guard the measurement driver consults, so a candidate
+that cannot run (non-dividing tile, VMEM overflow) is never measured. Tile
+winners come from ``benchmarks/autotune.py`` through the tuning database;
+CPU equivalence at non-default tiles is pinned in tests/test_kernels.py.
 """
 
 from __future__ import annotations
@@ -74,8 +87,14 @@ def _out_size(in_size, pad, k, stride, dil):
     return (in_size + pad[0] + pad[1] - eff) // stride + 1
 
 
-def fits_vmem(x_shape, w_shape, pads, groups, itemsize) -> bool:
-    """Whether one (image, group) forward block fits the VMEM budget."""
+def fits_vmem(x_shape, w_shape, pads, groups, itemsize,
+              row_tile=None, strides=(1, 1), dilation=(1, 1)) -> bool:
+    """Whether one (image, group) forward block fits the VMEM budget.
+
+    ``row_tile`` is the candidate output-row tile (None = whole OH): the
+    padded image slice and filter stay resident either way, but the fp32
+    accumulator scales with the tile — the per-candidate half of the
+    validated-shape guard the autotuner consults before measuring."""
     _, h, w, _ = x_shape
     kh, kw, cg, cout = w_shape
     hp = h + pads[0][0] + pads[0][1]
@@ -83,8 +102,51 @@ def fits_vmem(x_shape, w_shape, pads, groups, itemsize) -> bool:
     og = cout // groups
     x_bytes = hp * wp * cg * itemsize
     w_bytes = kh * kw * cg * og * itemsize
-    acc_bytes = 4 * hp * wp * og          # upper bound on OH*OW*Og fp32
+    if row_tile is None:
+        acc_rows = hp                      # upper bound on OH
+    else:
+        sh, dh = strides[0], dilation[0]
+        oh = _out_size(hp, (0, 0), kh, sh, dh)
+        if not valid_row_tile(oh, row_tile):
+            return False
+        acc_rows = row_tile
+    acc_bytes = 4 * acc_rows * wp * og     # fp32 accumulator block
     return x_bytes + w_bytes + 2 * acc_bytes <= VMEM_BUDGET_BYTES
+
+
+def valid_row_tile(oh: int, row_tile) -> bool:
+    """Shape guard for one row-tile candidate: a positive divisor of the
+    output height (Pallas blocks are uniform; a non-dividing tile would
+    write out of bounds). ``None`` (whole-OH) is always valid."""
+    if row_tile is None:
+        return True
+    return isinstance(row_tile, int) and 0 < row_tile <= oh \
+        and oh % row_tile == 0
+
+
+def shape_signature(x_shape, w_shape, strides, padding, dilation,
+                    groups) -> str:
+    """Canonical tuning-database signature for one conv geometry — ONE
+    builder shared by the search space (tuning/space.py) and the ``auto``
+    dispatch site (ops/nn.py), so a measured winner and its trace-time
+    lookup can never drift apart."""
+    def part(v):
+        if isinstance(v, (tuple, list)):
+            return "x".join(str(int(x)) for x in v)
+        return str(v)
+
+    pad = padding if isinstance(padding, str) else part(_pair(padding))
+    return (f"x={part(x_shape)};w={part(w_shape)};s={part(strides)};"
+            f"p={pad};d={part(dilation)};g={int(groups)}")
+
+
+def valid_row_tiles(oh: int, limit: int = 8):
+    """The candidate row tiles for an output height: every divisor of
+    ``oh`` up to ``limit`` distinct values (smallest first), plus ``None``
+    (whole OH, the registered default). This is the enumerable half of the
+    conv tile search space (tuning/space.py)."""
+    divs = [d for d in range(1, oh + 1) if oh % d == 0 and d < oh]
+    return [None] + divs[:limit]
 
 
 def supports(x, w, data_format, feature_group_count,
@@ -133,8 +195,41 @@ def _fwd_kernel(x_ref, w_ref, o_ref, *, oh, ow, kh, kw, sh, sw, dh, dw):
     o_ref[0] = acc.reshape(oh, ow, og).astype(o_ref.dtype)
 
 
-def _fwd_pallas(xp, w, strides, dilation, groups, interpret, out_dtype):
-    """``xp`` is ALREADY padded (N, Hp, Wp, Cin); w (kh, kw, Cg, Cout)."""
+def _fwd_kernel_tiled(x_ref, w_ref, o_ref, *, rt, ow, kh, kw, sh, sw, dh,
+                      dw):
+    """Row-tiled forward block: output rows [t*rt, (t+1)*rt) of one
+    (image, group) — the tap products shrink to (rt*OW, Cg) x (Cg, Og).
+    The padded image stays a whole VMEM block (the strided tap windows of
+    neighbouring row tiles overlap, so input rows cannot be block-split);
+    each tile reads its window through a dynamic row slice."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(2)
+    cg = x_ref.shape[-1]
+    og = o_ref.shape[-1]
+    row0 = t * (rt * sh)                          # first input row of tile
+    win_h = (rt - 1) * sh + 1
+    win_w = (ow - 1) * sw + 1
+    acc = jnp.zeros((rt * ow, og), _F32)
+    for ki in range(kh):
+        for kj in range(kw):
+            win = x_ref[0, pl.dslice(row0 + ki * dh, win_h),
+                        pl.dslice(kj * dw, win_w), :].astype(_F32)
+            patch = lax.slice(win, (0, 0, 0), win.shape, (sh, sw, 1))
+            acc = acc + lax.dot_general(
+                patch.reshape(rt * ow, cg),
+                w_ref[ki, kj].astype(_F32),       # (Cg, Og)
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=_F32,
+            )
+    o_ref[0] = acc.reshape(rt, ow, og).astype(o_ref.dtype)
+
+
+def _fwd_pallas(xp, w, strides, dilation, groups, interpret, out_dtype,
+                row_tile=None):
+    """``xp`` is ALREADY padded (N, Hp, Wp, Cin); w (kh, kw, Cg, Cout).
+    ``row_tile`` selects the tiled program (grid over output-row blocks);
+    ``None`` keeps the historical whole-OH block."""
     from jax.experimental import pallas as pl
 
     n, hp, wp, cin = xp.shape
@@ -144,6 +239,27 @@ def _fwd_pallas(xp, w, strides, dilation, groups, interpret, out_dtype):
     dh, dw = dilation
     oh = _out_size(hp, (0, 0), kh, sh, dh)
     ow = _out_size(wp, (0, 0), kw, sw, dw)
+    if row_tile is not None and row_tile != oh:
+        if not valid_row_tile(oh, row_tile):
+            raise ValueError(
+                f"row_tile {row_tile!r} invalid for output height {oh} "
+                "(must be a positive divisor)")
+        rt = row_tile
+        kernel = functools.partial(
+            _fwd_kernel_tiled, rt=rt, ow=ow, kh=kh, kw=kw, sh=sh, sw=sw,
+            dh=dh, dw=dw)
+        return pl.pallas_call(
+            kernel,
+            grid=(n, groups, oh // rt),
+            in_specs=[
+                pl.BlockSpec((1, hp, wp, cg), lambda i, g, t: (i, 0, 0, g)),
+                pl.BlockSpec((kh, kw, cg, og), lambda i, g, t: (0, 0, 0, g)),
+            ],
+            out_specs=pl.BlockSpec((1, rt, ow, og),
+                                   lambda i, g, t: (i, t, 0, g)),
+            out_shape=jax.ShapeDtypeStruct((n, oh, ow, cout), out_dtype),
+            interpret=interpret,
+        )(xp, w)
     kernel = functools.partial(
         _fwd_kernel, oh=oh, ow=ow, kh=kh, kw=kw, sh=sh, sw=sw, dh=dh, dw=dw)
     return pl.pallas_call(
@@ -267,28 +383,41 @@ def _flip_transpose_w(w, groups):
                                                       groups * cg)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
-def conv2d_pallas(x, w, strides, pads, dilation, groups, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def conv2d_pallas(x, w, strides, pads, dilation, groups, interpret,
+                  row_tile=None):
     """NHWC x HWIO convolution on the Pallas kernels. ``pads`` is the
     explicit ((lo, hi), (lo, hi)) form from :func:`resolve_padding`;
-    ``interpret`` runs the Pallas interpreter (CPU correctness mode)."""
-    return _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret)
+    ``interpret`` runs the Pallas interpreter (CPU correctness mode);
+    ``row_tile`` is the tuned output-row tile for the forward program
+    (None = whole OH — the registered default; winners come from the
+    tuning database through ``auto`` dispatch, docs/AUTOTUNE.md)."""
+    return _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret,
+                          row_tile)
 
 
-def _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret):
+def _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret,
+                   row_tile=None):
     xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
-    return _fwd_pallas(xp, w, strides, dilation, groups, interpret, x.dtype)
+    return _fwd_pallas(xp, w, strides, dilation, groups, interpret, x.dtype,
+                       row_tile)
 
 
-def _conv_vjp_fwd(x, w, strides, pads, dilation, groups, interpret):
-    out = _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret)
+def _conv_vjp_fwd(x, w, strides, pads, dilation, groups, interpret,
+                  row_tile=None):
+    out = _conv_fwd_impl(x, w, strides, pads, dilation, groups, interpret,
+                         row_tile)
     return out, (x, w)
 
 
-def _conv_vjp_bwd(strides, pads, dilation, groups, interpret, res, dy):
+def _conv_vjp_bwd(strides, pads, dilation, groups, interpret, row_tile,
+                  res, dy):
     x, w = res
     kh, kw = w.shape[0], w.shape[1]
-    # input gradient: forward kernel over the stride-dilated dy
+    # input gradient: forward kernel over the stride-dilated dy. The tuned
+    # row_tile applies to the FORWARD product only — the dx conv has a
+    # different output height (the input's), so a forward tile need not
+    # divide it; the gradient programs keep their whole-block schedule.
     dyp = _dy_for_input_grad(dy, (x.shape[1], x.shape[2]), pads, (kh, kw),
                              strides, dilation)
     wt = _flip_transpose_w(w, groups)

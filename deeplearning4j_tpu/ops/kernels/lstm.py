@@ -42,15 +42,23 @@ ORDER_IFOG: Tuple[str, ...] = ("i", "f", "o", "g")   # DL4J layer order
 ORDER_IOFG: Tuple[str, ...] = ("i", "o", "f", "g")   # ONNX lstm_layer order
 
 
-def fits_vmem(xp, u) -> bool:
-    """The cell kernel takes xp (B,4H), h, c (B,H), U (H,4H) as whole
-    unblocked VMEM operands plus the fp32 z/gates working set — same
-    honesty guard as conv's fits_vmem: oversized cells stay on the exact
-    path instead of faulting the chip (H-blocked tiling is the known next
-    step if the real-chip sweep wants bigger cells)."""
+def fits_vmem(xp, u, b_tile=None) -> bool:
+    """Whether one cell program block fits the VMEM budget: xp (B,4H),
+    h, c (B,H) operands plus the fp32 z/gates working set, U (H,4H)
+    always whole (replicated across the batch grid) — same honesty guard
+    as conv's fits_vmem. ``b_tile`` is the candidate batch tile (None =
+    whole B): the batch-axis operands and working set scale with the
+    tile, so a tuned tiled winner is admitted with the block it was
+    validated with — oversized (or stale non-dividing) tiles stay on the
+    exact path instead of faulting the chip (H-blocked tiling is the
+    known next step if the real-chip sweep wants bigger cells)."""
     from deeplearning4j_tpu.ops.kernels.conv import VMEM_BUDGET_BYTES
 
     b, four_h = xp.shape
+    if b_tile is not None:
+        if not valid_b_tile(b, b_tile):
+            return False
+        b = b_tile
     h = four_h // 4
     itemsize = jnp.dtype(xp.dtype).itemsize
     operands = (b * four_h + 2 * b * h + h * four_h) * itemsize
@@ -59,8 +67,11 @@ def fits_vmem(xp, u) -> bool:
 
 
 def supports(xp, u, gate_activation: str, activation: str) -> bool:
-    """Kernel gate: default sigmoid/tanh cell, f32/bf16, (B,4H)x(H,4H),
-    VMEM-sized."""
+    """Kernel GEOMETRY gate: default sigmoid/tanh cell, f32/bf16,
+    (B,4H)x(H,4H). The VMEM guard is separate (:func:`fits_vmem`) and
+    tile-aware — call sites apply it AFTER dispatch with the tuned
+    winner's ``b_tile``, so a committed tiled winner on a cell too large
+    for the whole-batch block stays reachable (the conv seam's rule)."""
     if gate_activation.lower() != "sigmoid" or activation.lower() != "tanh":
         return False
     if xp.dtype not in (jnp.float32, jnp.bfloat16) or u.dtype != xp.dtype:
@@ -72,7 +83,7 @@ def supports(xp, u, gate_activation: str, activation: str) -> bool:
         return False
     if jax.default_backend() == "tpu" and h % 128:
         return False  # compiled Mosaic wants lane-aligned H; exact otherwise
-    return fits_vmem(xp, u)
+    return True
 
 
 def _gates(z, h, order):
@@ -98,11 +109,64 @@ def _cell_kernel(xp_ref, h_ref, c_ref, u_ref, ho_ref, co_ref, *, hidden,
     co_ref[...] = c_new.astype(co_ref.dtype)
 
 
-def _cell_pallas(xp, h, c, u, order, interpret):
+def valid_b_tile(b: int, b_tile) -> bool:
+    """Shape guard for one batch-tile candidate: a positive divisor of the
+    batch (rows are independent, so any divisor is equivalence-safe).
+    ``None`` (whole batch, the registered default) is always valid."""
+    if b_tile is None:
+        return True
+    return isinstance(b_tile, int) and 0 < b_tile <= b and b % b_tile == 0
+
+
+def shape_signature(b: int, h: int) -> str:
+    """Canonical tuning-database signature for one cell geometry (the
+    kernel program depends on (B, H) only — the scan length T does not
+    change the per-step kernel, so winners apply across sequence
+    lengths). Shared by tuning/space.py and the dispatch sites."""
+    return f"b={int(b)};h={int(h)}"
+
+
+def valid_b_tiles(b: int, limit: int = 8):
+    """Candidate batch tiles for the cell kernel: divisors of ``b`` up to
+    ``limit`` distinct values plus ``None`` (whole batch) — the enumerable
+    half of the LSTM tile search space (tuning/space.py)."""
+    divs = [d for d in range(1, b + 1) if b % d == 0 and d < b]
+    return [None] + divs[:limit]
+
+
+def _cell_pallas(xp, h, c, u, order, interpret, b_tile=None):
+    """``b_tile`` blocks the batch axis: grid over B/bt row blocks, each
+    running the (bt, H) x (H, 4H) recurrent product with U replicated —
+    the tuned alternative to the whole-batch single program (None). Rows
+    are independent, so tiling is exactly output-equivalent; the knob
+    trades recurrent-matmul MXU geometry against per-block overhead and
+    is ranked by benchmarks/autotune.py (docs/AUTOTUNE.md)."""
     from jax.experimental import pallas as pl
 
     b, hidden = h.shape
     kernel = functools.partial(_cell_kernel, hidden=hidden, order=order)
+    if b_tile is not None and b_tile != b:
+        if not valid_b_tile(b, b_tile):
+            raise ValueError(
+                f"b_tile {b_tile!r} invalid for batch {b} "
+                "(must be a positive divisor)")
+        bt = b_tile
+        four_h = 4 * hidden
+        return pl.pallas_call(
+            kernel,
+            grid=(b // bt,),
+            in_specs=[
+                pl.BlockSpec((bt, four_h), lambda t: (t, 0)),
+                pl.BlockSpec((bt, hidden), lambda t: (t, 0)),
+                pl.BlockSpec((bt, hidden), lambda t: (t, 0)),
+                pl.BlockSpec((hidden, four_h), lambda t: (0, 0)),
+            ],
+            out_specs=[pl.BlockSpec((bt, hidden), lambda t: (t, 0)),
+                       pl.BlockSpec((bt, hidden), lambda t: (t, 0))],
+            out_shape=[jax.ShapeDtypeStruct((b, hidden), xp.dtype),
+                       jax.ShapeDtypeStruct((b, hidden), xp.dtype)],
+            interpret=interpret,
+        )(xp, h, c, u)
     return pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct((b, hidden), xp.dtype),
@@ -125,30 +189,31 @@ def _cell_exact(xp, h, c, u, order):
     return h_new, c_new, (i, f, o, g)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def lstm_cell_fused(xp, h, c, u, order, mode):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def lstm_cell_fused(xp, h, c, u, order, mode, b_tile=None):
     """One LSTM step: ``xp`` (B, 4H) pre-projected input (+ bias), ``h``/
     ``c`` (B, H), ``u`` (H, 4H). Returns (h_new, c_new) in xp's dtype.
-    ``mode``: "pallas" | "interpret" (see kernels.dispatch)."""
-    h_new, c_new = _cell_fwd_impl(xp, h, c, u, order, mode)
+    ``mode``: "pallas" | "interpret" (see kernels.dispatch); ``b_tile`` is
+    the tuned batch tile for the kernel program (None = whole batch)."""
+    h_new, c_new = _cell_fwd_impl(xp, h, c, u, order, mode, b_tile)
     return h_new, c_new
 
 
-def _cell_fwd_impl(xp, h, c, u, order, mode):
+def _cell_fwd_impl(xp, h, c, u, order, mode, b_tile=None):
     if mode == "interpret":
-        return _cell_pallas(xp, h, c, u, order, True)
+        return _cell_pallas(xp, h, c, u, order, True, b_tile)
     if mode == "pallas" and jax.default_backend() == "tpu":
-        return _cell_pallas(xp, h, c, u, order, False)
+        return _cell_pallas(xp, h, c, u, order, False, b_tile)
     h_new, c_new, _ = _cell_exact(xp, h, c, u, order)
     return h_new.astype(xp.dtype), c_new.astype(xp.dtype)
 
 
-def _cell_vjp_fwd(xp, h, c, u, order, mode):
-    out = _cell_fwd_impl(xp, h, c, u, order, mode)
+def _cell_vjp_fwd(xp, h, c, u, order, mode, b_tile=None):
+    out = _cell_fwd_impl(xp, h, c, u, order, mode, b_tile)
     return out, (xp, h, c, u)
 
 
-def _cell_vjp_bwd(order, mode, res, cts):
+def _cell_vjp_bwd(order, mode, b_tile, res, cts):
     """The LSTM adjoint from recomputed gates (one fused elementwise block
     + two matmuls — XLA fuses it; the scan transpose turns it into BPTT)."""
     xp, h, c, u = res
@@ -172,16 +237,18 @@ def _cell_vjp_bwd(order, mode, res, cts):
 lstm_cell_fused.defvjp(_cell_vjp_fwd, _cell_vjp_bwd)
 
 
-def lstm_sequence_fused(xp, h0, c0, u, order=ORDER_IFOG, mode="pallas"):
+def lstm_sequence_fused(xp, h0, c0, u, order=ORDER_IFOG, mode="pallas",
+                        b_tile=None):
     """Whole-sequence fused path: ``xp`` (T, B, 4H) time-major pre-projected
     inputs, states (B, H). One ``lax.scan`` whose body is the fused cell.
     Returns (ys (T, B, H), (h_fin, c_fin)). Mask/TBPTT handling stays with
     the callers (nn/recurrent.py wraps the step, ops/rnn.py masks the
-    outputs) so the kernel path and the exact path share that logic."""
+    outputs) so the kernel path and the exact path share that logic.
+    ``b_tile`` threads the tuned batch tile into every step's kernel."""
 
     def body(carry, xt):
         h, c = carry
-        h_new, c_new = lstm_cell_fused(xt, h, c, u, order, mode)
+        h_new, c_new = lstm_cell_fused(xt, h, c, u, order, mode, b_tile)
         return (h_new, c_new), h_new
 
     (h_fin, c_fin), ys = lax.scan(body, (h0, c0), xp)

@@ -89,13 +89,30 @@ def conv2d(
         pads = _kconv.resolve_padding(
             padding, (x.shape[1], x.shape[2]), (w.shape[0], w.shape[1]),
             strides_p, dil_p)
-        mode = _kern.dispatch(_kconv.fits_vmem(
-            x.shape, w.shape, pads, feature_group_count,
-            jnp.dtype(x.dtype).itemsize))
+        mode, tuned = _kern.dispatch(
+            True,
+            op="conv2d",
+            sig=_kconv.shape_signature(x.shape, w.shape, strides_p,
+                                       padding, dil_p,
+                                       feature_group_count),
+            dtype=str(x.dtype))
+        # the VMEM guard is tile-aware, AFTER dispatch: a tuned winner is
+        # admitted with the accumulator block it was validated with
+        # (row_tile), the untuned path with the whole-OH block — so a
+        # committed tiled winner on a feature map too large for the
+        # whole-block kernel is reachable, and an oversized (or stale
+        # non-dividing) tile still falls back to the exact path
+        if mode is not None and not _kconv.fits_vmem(
+                x.shape, w.shape, pads, feature_group_count,
+                jnp.dtype(x.dtype).itemsize,
+                row_tile=tuned.get("row_tile"),
+                strides=strides_p, dilation=dil_p):
+            mode = None
         if mode is not None:
             out = _kconv.conv2d_pallas(x, w, strides_p, pads, dil_p,
                                        feature_group_count,
-                                       mode == "interpret")
+                                       mode == "interpret",
+                                       tuned.get("row_tile"))
             if b is not None:
                 out = out + b.reshape(1, 1, 1, -1).astype(out.dtype)
             return checkpoint_name(out, _CONV_OUT)

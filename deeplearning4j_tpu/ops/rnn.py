@@ -109,17 +109,24 @@ def lstm_layer(x, W, R, b=None, seq_lens=None, h0=None, c0=None, *,
         # recurrent matmul + gate block as the fused Pallas cell. ONNX gate
         # order i,o,f,c maps to the kernel's static ORDER_IOFG.
         Rd_x = jnp.asarray(Rd, x.dtype)
-        mode = _kern.dispatch(_klstm.supports(
-            jnp.zeros((B, 4 * h), x.dtype), Rd_x,
-            gate_activation, activation))
+        xp_probe = jnp.zeros((B, 4 * h), x.dtype)
+        mode, tuned = _kern.dispatch(
+            _klstm.supports(xp_probe, Rd_x, gate_activation, activation),
+            op="lstm_cell", sig=_klstm.shape_signature(B, h),
+            dtype=str(x.dtype))
+        # tile-aware VMEM guard AFTER dispatch (the conv seam's rule)
+        if mode is not None and not _klstm.fits_vmem(
+                xp_probe, Rd_x, tuned.get("b_tile")):
+            mode = None
         if mode is not None:
             xp_all = x @ jnp.asarray(Wd, x.dtype) + bias   # (T, B, 4H)
+            b_tile = tuned.get("b_tile")
 
             def step(carry, xp_t, Rd_x=Rd_x):
                 hp, cp = carry
                 xt, t = xp_t
                 h_new, c_new = _klstm.lstm_cell_fused(
-                    xt, hp, cp, Rd_x, _klstm.ORDER_IOFG, mode)
+                    xt, hp, cp, Rd_x, _klstm.ORDER_IOFG, mode, b_tile)
                 c_new = _mask_step(c_new, cp, t, seq_lens)
                 h_new = _mask_step(h_new, hp, t, seq_lens)
                 return (h_new, c_new), h_new

@@ -1,0 +1,37 @@
+"""Autotuning subsystem: searchable knob spaces, an equivalence-gated
+measurement driver, and a persistent tuning database consulted by
+``auto`` dispatch (ROADMAP item 2, docs/AUTOTUNE.md).
+
+Five eras of perf work each ended with "CPU proves equivalence but
+cannot rank" — r6 remat policies, r14 ``kernel_impl=auto`` + Pallas tile
+shapes, r8 bucket sets, r15 ``compression_hosts``, the XLA flag
+candidates. This package is the TVM-style piece (arXiv:1802.04799) that
+closes the loop:
+
+- ``tuning/space.py`` — the **search-space registry**: seams declare
+  their tunable knobs as typed candidate sets with per-candidate
+  validity guards (tile-divides-shape, VMEM fit).
+- ``tuning/measure.py`` — the **measurement driver**: grid/random search
+  + greedy refinement, deterministic seeding, two-point-fit median-of-3
+  timing, and an equivalence gate that refuses to admit any candidate
+  whose value/grad diverges from the exact path (the r6 honesty
+  convention made executable).
+- ``tuning/database.py`` — the **persistent TuningDatabase**: winners
+  keyed by (op, shape-signature, dtype, backend, topology) with atomic
+  checkpoint-style commits and corrupt-entry skip-with-warning; armed by
+  ``DL4J_TPU_TUNING_DB`` and consulted at trace time by ``ops/kernels``
+  ``auto`` resolution and conf-time knob defaulting — the way the r8 AOT
+  store is consulted at compile time.
+
+One command — ``benchmarks/autotune.py`` — sweeps the registered spaces:
+on CPU it proves the machinery end-to-end; on the first real-TPU session
+it harvests the standing hardware debt (ROADMAP).
+"""
+
+from deeplearning4j_tpu.tuning.database import (  # noqa: F401
+    TuningDatabase, TuningKey, conf_default, current_status, database_dir,
+    get_database, resolve, set_database)
+from deeplearning4j_tpu.tuning.measure import MeasurementDriver  # noqa: F401
+from deeplearning4j_tpu.tuning.space import (  # noqa: F401
+    Candidate, MeasureCase, SearchSpace, get_space, measurable_spaces,
+    register_space, space_names)

@@ -581,6 +581,7 @@ def install_default_collectors() -> Telemetry:
         tele.register_collector(_collect_compile_cache)
         tele.register_collector(_collect_elastic)
         tele.register_collector(_collect_serving)
+        tele.register_collector(_collect_tuning)
         _defaults_installed = True
     return tele
 
@@ -665,6 +666,18 @@ def _collect_serving() -> list:
     if mod is None:
         return []
     return mod.collect_metrics()
+
+
+def _collect_tuning() -> list:
+    """Autotuning-database gauges (enabled flag, entry count) at scrape
+    time — import-guarded like elastic/serving, so a process that never
+    tuned pays nothing (docs/AUTOTUNE.md)."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.tuning.database")
+    if mod is None:
+        return []
+    return mod.collect_tuning_gauges()
 
 
 def _after_fork_child():

@@ -217,6 +217,18 @@ class UIServer:
                 body["serving"] = status
         except Exception:
             pass
+        try:
+            import sys
+
+            # autotuning section (docs/AUTOTUNE.md): database dir, entry
+            # count, lookup/hit/measurement counters — same sys.modules
+            # guard, so a liveness probe never imports the tuner
+            _tuning = sys.modules.get("deeplearning4j_tpu.tuning.database")
+            status = _tuning.current_status() if _tuning else {}
+            if status:
+                body["tuning"] = status
+        except Exception:
+            pass
         return json.dumps(body), ok
 
     # ------------------------------------------------------------- rendering

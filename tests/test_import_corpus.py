@@ -83,7 +83,15 @@ _TF_APPS = {
 
 
 class TestTFFullModelCorpus:
-    @pytest.mark.parametrize("name", sorted(_TF_APPS))
+    # tier-1 runtime guard (ISSUE 11 satellite): the two heaviest goldens
+    # (NASNetMobile ~15s, InceptionV3 ~11s) carry the slow mark — eight
+    # cheaper corpus goldens keep the import seam covered in tier-1, and
+    # the full-suite CI leg still runs every model
+    @pytest.mark.parametrize(
+        "name",
+        [pytest.param(n, marks=pytest.mark.slow)
+         if n in ("NASNetMobile", "InceptionV3") else n
+         for n in sorted(_TF_APPS)])
     def test_forward_golden(self, name, rng):
         tf.keras.utils.set_random_seed(7)
         model = _TF_APPS[name]()

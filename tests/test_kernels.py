@@ -69,10 +69,10 @@ class TestDispatch:
         monkeypatch.delenv("DL4J_TPU_KERNEL_IMPL", raising=False)
         if jax.default_backend() == "tpu":
             pytest.skip("auto engages the compiled kernel on TPU")
-        assert K.dispatch(True) is None          # CPU cannot rank kernels
+        assert K.dispatch(True)[0] is None       # CPU cannot rank kernels
         with K.impl_scope("pallas"):
-            assert K.dispatch(True) == "interpret"
-            assert K.dispatch(False) is None     # unsupported geometry
+            assert K.dispatch(True) == ("interpret", {})
+            assert K.dispatch(False)[0] is None  # unsupported geometry
 
     def test_bad_values_raise(self, monkeypatch):
         with pytest.raises(ValueError):
@@ -199,6 +199,44 @@ class TestPallasConv:
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+class TestConvRowTiles:
+    """Tuned row-tile parameterization (ISSUE 11 satellite): equivalence
+    re-proven at two NON-DEFAULT tile points — the autotuner's first
+    search space is real, not declared (docs/AUTOTUNE.md)."""
+
+    @pytest.mark.parametrize("row_tile", [1, 2])
+    def test_tiled_fwd_and_grads_match_exact(self, row_tile):
+        hw, k, s, d, g, cin, cout, pad = _CONV_GRID[1]  # strided, OH=4
+        x = jnp.asarray(R.normal(size=(2,) + hw + (cin,)).astype(np.float32))
+        w = jnp.asarray(
+            (R.normal(size=k + (cin // g, cout)) * 0.3).astype(np.float32))
+        pads = kconv.resolve_padding(pad, hw, k, s, d)
+        ref = _ref_conv(x, w, s, pads, d, g)
+        oh = ref.shape[1]
+        assert kconv.valid_row_tile(oh, row_tile), (oh, row_tile)
+        out = kconv.conv2d_pallas(x, w, s, pads, d, g, True, row_tile)
+        assert _max_err(out, ref) < 2e-5
+
+        f_t = lambda x, w: jnp.sum(jnp.sin(  # noqa: E731
+            kconv.conv2d_pallas(x, w, s, pads, d, g, True, row_tile)))
+        f_r = lambda x, w: jnp.sum(  # noqa: E731
+            jnp.sin(_ref_conv(x, w, s, pads, d, g)))
+        gt = jax.grad(f_t, argnums=(0, 1))(x, w)
+        gr = jax.grad(f_r, argnums=(0, 1))(x, w)
+        assert _max_err(list(gt), list(gr)) < 2e-4
+
+    def test_invalid_tile_raises_and_guard_agrees(self):
+        x = jnp.asarray(R.normal(size=(1, 8, 8, 2)).astype(np.float32))
+        w = jnp.asarray(R.normal(size=(3, 3, 2, 4)).astype(np.float32))
+        pads = kconv.resolve_padding("SAME", (8, 8), (3, 3), (1, 1), (1, 1))
+        assert not kconv.valid_row_tile(8, 3)
+        with pytest.raises(ValueError, match="row_tile"):
+            kconv.conv2d_pallas(x, w, (1, 1), pads, (1, 1), 1, True, 3)
+        # per-candidate VMEM accounting scales with the tile
+        assert None in kconv.valid_row_tiles(8)
+        assert kconv.valid_row_tiles(8)[1:] == [1, 2, 4]
+
+
 def _conv_net(impl, fused=False, updater=None, seed=3):
     from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
                                        NeuralNetConfiguration)
@@ -275,6 +313,33 @@ class TestFusedLstm:
         gk = jax.grad(lk, argnums=(0, 1, 2, 3))(xp, h0, c0, U)
         ge = jax.grad(le, argnums=(0, 1, 2, 3))(xp, h0, c0, U)
         assert _max_err(list(gk), list(ge)) < 2e-4
+
+    @pytest.mark.parametrize("b_tile", [2, 3])
+    def test_batch_tiled_cell_matches_exact(self, b_tile):
+        """Tuned batch-tile parameterization (ISSUE 11 satellite):
+        equivalence re-proven at two NON-DEFAULT tile points, values and
+        gradients, through the whole scan-fused sequence path."""
+        T, B, H = 4, 6, 8
+        xp = jnp.asarray(R.normal(size=(T, B, 4 * H)).astype(np.float32))
+        h0 = jnp.asarray(R.normal(size=(B, H)).astype(np.float32))
+        c0 = jnp.asarray(R.normal(size=(B, H)).astype(np.float32))
+        U = jnp.asarray((R.normal(size=(H, 4 * H)) * 0.3).astype(np.float32))
+        assert klstm.valid_b_tile(B, b_tile)
+        ys, (hf, cf) = klstm.lstm_sequence_fused(
+            xp, h0, c0, U, klstm.ORDER_IFOG, "interpret", b_tile)
+        ye, (he, ce) = self._exact_seq(xp, h0, c0, U)
+        assert _max_err(ys, ye) < 2e-5
+        assert _max_err(cf, ce) < 2e-5
+
+        lk = lambda *a: jnp.sum(jnp.cos(klstm.lstm_sequence_fused(  # noqa
+            *a, klstm.ORDER_IFOG, "interpret", b_tile)[0]))
+        le = lambda *a: jnp.sum(jnp.cos(self._exact_seq(*a)[0]))  # noqa
+        gk = jax.grad(lk, argnums=(0, 1, 2, 3))(xp, h0, c0, U)
+        ge = jax.grad(le, argnums=(0, 1, 2, 3))(xp, h0, c0, U)
+        assert _max_err(list(gk), list(ge)) < 2e-4
+        with pytest.raises(ValueError, match="b_tile"):
+            klstm.lstm_cell_fused(xp[0], h0, c0, U, klstm.ORDER_IFOG,
+                                  "interpret", 4)
 
     @pytest.mark.slow
     def test_layer_masked_equivalence(self):
