@@ -18,7 +18,17 @@ server with two models:
    404;
 4. curl ``/metrics`` (Prometheus text with the serving series) and
    ``/healthz`` (JSON with the serving section), then drain gracefully
-   and assert a post-drain request answers 503.
+   and assert a post-drain request answers 503;
+5. (ISSUE 12) prove the request-scope layer end-to-end: an inbound
+   ``X-Request-Id`` echoes on the response header AND body, the sampled
+   trace (``DL4J_TPU_TRACE_SAMPLE=1`` for the whole smoke) carries
+   queue-wait/compute spans for that exact id plus per-token decode
+   spans for the generate traffic, ``/slo`` serves burn-rate math for a
+   declared objective, a synthetic budget-exhausted objective flips
+   ``/healthz`` to 503 (and recovery flips it back), and the
+   flight-recorder dump at ``/v1/models/<id>/debug/requests`` is
+   non-empty after the forced deadline shed with the shed cause on
+   record.
 
 Exit 0 on success, 1 with a FAIL line on any violated check.
 
@@ -72,18 +82,27 @@ def http_get(url: str, use_curl: bool):
 
 def http_post(url: str, obj: dict):
     """(status, json body, retry_after) for a JSON POST."""
+    code, body, headers = http_post_full(url, obj)
+    return code, body, headers.get("Retry-After")
+
+
+def http_post_full(url: str, obj: dict, request_id: str = None):
+    """(status, json body, response headers) for a JSON POST, optionally
+    carrying an ``X-Request-Id`` (the ISSUE 12 round-trip check)."""
     data = json.dumps(obj).encode()
-    req = urllib.request.Request(
-        url, data=data, headers={"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    if request_id is not None:
+        headers["X-Request-Id"] = request_id
+    req = urllib.request.Request(url, data=data, headers=headers)
     try:
         r = urllib.request.urlopen(req, timeout=60)
-        return r.status, json.loads(r.read()), None
+        return r.status, json.loads(r.read()), dict(r.headers)
     except urllib.error.HTTPError as e:
         try:
             body = json.loads(e.read())
         except Exception:
             body = {}
-        return e.code, body, e.headers.get("Retry-After")
+        return e.code, body, dict(e.headers)
 
 
 def build_server():
@@ -167,6 +186,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     use_curl = not args.no_curl
 
+    # trace every request for the whole smoke: the span/flight-recorder
+    # checks below must not depend on the default 2% head-sample dice
+    os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1"
     server, clf_net, np = build_server()
     from deeplearning4j_tpu.util import telemetry as tm
 
@@ -222,6 +244,112 @@ def main(argv=None) -> int:
           set(models) == {"dense", "bert-decode"}, str(sorted(models)))
     check("/healthz reports completed work",
           all(m.get("completed", 0) > 0 for m in models.values()))
+
+    print("== request-scope observability (ISSUE 12) ==")
+    # X-Request-Id round-trip: the caller's id comes back on the response
+    # header AND body, for 200s and sheds alike
+    code, body, hdrs = http_post_full(
+        f"{server.url}/v1/models/dense/infer",
+        {"inputs": xs[0].tolist()}, request_id="smoke-rid-1")
+    check("X-Request-Id echoed on 200 (header + body)",
+          code == 200 and hdrs.get("X-Request-Id") == "smoke-rid-1"
+          and body.get("request_id") == "smoke-rid-1",
+          f"hdr {hdrs.get('X-Request-Id')}, body {body.get('request_id')}")
+    code, body, hdrs = http_post_full(
+        f"{server.url}/v1/models/dense/infer",
+        {"inputs": xs[0].tolist(), "deadline_ms": -1},
+        request_id="smoke-rid-shed")
+    check("X-Request-Id echoed on the 429 shed",
+          code == 429 and hdrs.get("X-Request-Id") == "smoke-rid-shed"
+          and body.get("request_id") == "smoke-rid-shed")
+    code, body, hdrs = http_post_full(f"{server.url}/v1/models/dense/infer",
+                                      {"inputs": xs[0].tolist()})
+    check("server mints an id when the caller sends none",
+          code == 200 and bool(hdrs.get("X-Request-Id"))
+          and body.get("request_id") == hdrs.get("X-Request-Id"))
+
+    # the sampled trace carries the request's phase spans on the shared
+    # timebase: queue wait + compute for smoke-rid-1, per-token decode
+    # spans from the generate traffic (all head-kept at sample rate 1)
+    trace = tele.chrome_trace()["traceEvents"]
+    by_rid = [e for e in trace
+              if e.get("args", {}).get("request_id") == "smoke-rid-1"]
+    names = {e["name"] for e in by_rid}
+    check("trace has queue-wait + compute spans for smoke-rid-1",
+          {"serving.request.queue_wait",
+           "serving.request.compute"} <= names, str(sorted(names)))
+    shed_spans = [e for e in trace
+                  if e.get("args", {}).get("request_id") == "smoke-rid-shed"]
+    check("shed request's span is kept with the shed outcome",
+          any(e.get("args", {}).get("outcome") == "shed:deadline"
+              for e in shed_spans))
+    decode = [e for e in trace
+              if e["name"] == "serving.generate.decode_token"]
+    check("trace has per-token decode spans for generate traffic",
+          len(decode) >= 3, f"{len(decode)} decode-step spans")
+
+    # flight recorder: the forced deadline shed above is on record, with
+    # its cause, in the per-model debug dump
+    code, text = http_get(
+        f"{server.url}/v1/models/dense/debug/requests?last=64", use_curl)
+    dump = json.loads(text) if code == 200 else {}
+    recs = dump.get("requests", [])
+    check("flight-recorder dump non-empty after the shed",
+          code == 200 and len(recs) > 0, f"{len(recs)} records")
+    check("shed record carries id + cause",
+          any(r.get("id") == "smoke-rid-shed" and r.get("status") == "shed"
+              and r.get("cause") == "deadline" for r in recs))
+    check("ok records carry phase timings",
+          any(r.get("status") == "ok" and r.get("compute_ms") is not None
+              and r.get("total_ms", 0) >= r.get("compute_ms", 0)
+              for r in recs))
+    code, _text = http_get(
+        f"{server.url}/v1/models/ghost/debug/requests", use_curl)
+    check("debug dump for unknown model answers 404", code == 404)
+
+    # SLO engine: /slo serves burn-rate math for a declared objective;
+    # a synthetic budget-exhausted objective flips /healthz to 503
+    from deeplearning4j_tpu.util import slo
+    from deeplearning4j_tpu.util import telemetry as _tm
+
+    slo.register(slo.SloObjective("smoke-avail", "availability",
+                                  target=0.5, model="dense"))
+    code, text = http_get(f"{server.url}/slo", use_curl)
+    doc = json.loads(text) if code == 200 else {}
+    objs = {o["name"]: o for o in doc.get("objectives", [])}
+    ok_slo = (code == 200 and "smoke-avail" in objs
+              and "60s" in objs["smoke-avail"]["windows"]
+              and "burn_rate" in objs["smoke-avail"]["windows"]["60s"])
+    check("/slo serves burn-rate windows for the objective", ok_slo)
+    check("real traffic meets the smoke objective",
+          objs.get("smoke-avail", {}).get("compliant") is True)
+    code, text = http_get(f"{server.url}/metrics", use_curl)
+    check("/metrics carries the SLO gauges",
+          'dl4j_slo_burn_rate{slo="smoke-avail"' in text)
+
+    # synthetic exhaustion: a 99.9% objective over counters we feed
+    # directly — one baseline evaluation, then a burst of sheds
+    slo.register(slo.SloObjective("smoke-exhausted", "availability",
+                                  target=0.999, model="synthetic-smoke"))
+    _tm.counter("serving.completed_total", 1, model="synthetic-smoke",
+                lane="interactive")
+    slo.get_engine().evaluate()
+    _tm.counter("serving.shed_total", 9, model="synthetic-smoke",
+                reason="deadline", lane="interactive")
+    code, text = http_get(f"{server.url}/healthz", use_curl)
+    health = json.loads(text) if text.strip().startswith("{") else {}
+    exhausted = {o["name"]: o
+                 for o in health.get("slo", {}).get("objectives", [])}
+    check("exhausted budget flips /healthz to 503", code == 503,
+          f"code {code}")
+    check("/healthz slo section shows the exhausted objective",
+          exhausted.get("smoke-exhausted", {}).get("exhausted") is True)
+    check("/healthz check slo.smoke-exhausted is failing",
+          health.get("checks", {}).get("slo.smoke-exhausted",
+                                       {}).get("ok") is False)
+    slo.reset()  # recovery: dropping the objectives restores the checks
+    code, _text = http_get(f"{server.url}/healthz", use_curl)
+    check("/healthz recovers after SLO reset", code == 200, f"code {code}")
 
     print("== graceful drain ==")
     server.request_drain()

@@ -42,6 +42,15 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   spans, /metrics + /healthz on the UI server. Default ON (span cost is
   ~µs against ms-scale steps — bench.py ``telemetry_overhead``); set to
   0/false to strip every recording hook.
+- ``DL4J_TPU_TRACE_SAMPLE`` — serving request-trace head-sampling keep
+  fraction in [0, 1] (serving/scheduler.py,
+  docs/OBSERVABILITY.md#request-tracing--slos): the fraction of healthy
+  requests whose per-phase spans (queue wait / batch fill / compute /
+  per-token decode) land on the merged trace. Slow, shed, and errored
+  requests are ALWAYS kept regardless of the dice; ``0`` disables
+  request tracing entirely (bench.py ``request_tracing_overhead``
+  A/B's 1 vs 0). Unset = 0.02. The flight recorder is independent of
+  this knob and always records.
 - ``DL4J_TPU_FAULTS`` — chaos knob for the elastic runtime
   (util/faults.py, docs/FAULT_TOLERANCE.md): arm injectable faults as
   ``"kind[@step][:arg]"`` pairs, e.g.
@@ -149,6 +158,10 @@ class Environment:
         self.compile_cache_dir = (
             os.environ.get("DL4J_TPU_COMPILE_CACHE") or None)
         self.telemetry = _env_bool("DL4J_TPU_TELEMETRY", default=True)
+        # request-trace head-sampling keep fraction (authoritative parse is
+        # serving.scheduler.trace_sample_rate — memoized per raw string;
+        # surfaced here so crash dumps show the knob)
+        self.trace_sample = os.environ.get("DL4J_TPU_TRACE_SAMPLE") or None
         # armed-faults spec (authoritative parse lives in util/faults.py's
         # injector; surfaced here so crash dumps show the chaos config)
         self.fault_spec = os.environ.get("DL4J_TPU_FAULTS") or None

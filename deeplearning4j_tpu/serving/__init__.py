@@ -25,14 +25,18 @@ from deeplearning4j_tpu.serving.router import (ModelRouter,
                                                current_status)
 from deeplearning4j_tpu.serving.scheduler import (BatchScheduler,
                                                   DeadlineExceededError,
+                                                  FlightRecorder,
                                                   QueueFullError,
                                                   SchedulerDrainingError,
-                                                  ShedError)
+                                                  ShedError,
+                                                  new_request_id,
+                                                  trace_sample_rate)
 from deeplearning4j_tpu.serving.server import ModelServer
 
 __all__ = [
     "BatchScheduler",
     "DeadlineExceededError",
+    "FlightRecorder",
     "Generator",
     "ModelRouter",
     "ModelServer",
@@ -42,4 +46,6 @@ __all__ = [
     "ShedError",
     "UnknownModelError",
     "current_status",
+    "new_request_id",
+    "trace_sample_rate",
 ]

@@ -29,6 +29,7 @@ signature they ever trace — steady-state serving shows 0.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
 
@@ -170,18 +172,33 @@ class Generator:
     # ------------------------------------------------------------ decoding
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 16, *, temperature: float = 0.0,
-                 key=None, eos_id: Optional[int] = None) -> List[List[int]]:
+                 key=None, eos_id: Optional[int] = None,
+                 trace: bool = False) -> List[List[int]]:
         """KV-cache decode: one prefill + ``max_new_tokens - 1`` decode
         steps, all on warmed executables. ``temperature=0`` is greedy
         (deterministic); otherwise categorical sampling from ``key``
-        (default PRNGKey(0) — pass a key for fresh randomness)."""
+        (default PRNGKey(0) — pass a key for fresh randomness).
+        ``trace=True`` (a head-sampled serving batch) emits a prefill span
+        and one ``serving.generate.decode_token`` span per generated
+        position — the per-token ruler of
+        docs/OBSERVABILITY.md#request-tracing--slos."""
         if max_new_tokens < 1:
             return [[] for _ in prompts]
         tokens, lengths, b_real, lens = self._prep(prompts, max_new_tokens)
         params = self.net.params
         if key is None:
             key = jax.random.PRNGKey(0)
+        # deferred span emission (no registry lock in the decode loop —
+        # it competes for the GIL with every other model's worker)
+        tele = tm.get_telemetry() if trace else None
+        batch = int(tokens.shape[0])
+
+        t_pf = time.time_ns() if tele else 0
         logits, caches = self._prefill_jit(params, tokens, lengths)
+        if tele:
+            tele.event_deferred("serving.generate.prefill", t_pf,
+                                time.time_ns(), batch=batch,
+                                seq=int(tokens.shape[1]))
         positions = lengths  # where the sampled token goes
         steps = []
         key, sub = jax.random.split(key)
@@ -190,7 +207,12 @@ class Generator:
             steps.append(cur)
             if i == max_new_tokens - 1:
                 break
-            logits, caches = self._decode_jit(params, caches, cur, positions)
+            t_dt = time.time_ns() if tele else 0
+            logits, caches = self._decode_jit(params, caches, cur,
+                                              positions)
+            if tele:
+                tele.event_deferred("serving.generate.decode_token", t_dt,
+                                    time.time_ns(), step=i + 1, batch=batch)
             positions = positions + 1
             key, sub = jax.random.split(key)
             cur = self._sample(logits, temperature, sub)

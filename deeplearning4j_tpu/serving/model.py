@@ -29,11 +29,13 @@ zero contract the CI smoke asserts.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import get_watcher
 
 _DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
@@ -126,28 +128,40 @@ class ServingModel:
         return primed
 
     # ------------------------------------------------------------- execute
-    def execute(self, payloads: List[Any], **opts
+    def execute(self, payloads: List[Any], _trace: bool = False, **opts
                 ) -> Tuple[List[Any], Dict[str, Any]]:
         """Run one coalesced batch; returns (per-payload results, stats).
         stats: real/padded row counts and the number of XLA traces this
-        batch caused (0 in steady state)."""
+        batch caused (0 in steady state); generate batches add
+        ``decode_tokens``/``decode_seconds`` for per-request tokens/sec.
+        ``_trace`` (set by the scheduler for head-sampled batches) emits
+        the batch-level pad/device/decode phase spans."""
         watcher = get_watcher()
         traces_before = watcher.total_traces()
+        stats: Dict[str, Any] = {}
         if self.kind == "generate":
-            results, real, padded = self._execute_generate(payloads, **opts)
+            results, real, padded = self._execute_generate(
+                payloads, _trace=_trace, _stats=stats, **opts)
         else:
-            results, real, padded = self._execute_classify(payloads, **opts)
-        return results, {
+            results, real, padded = self._execute_classify(
+                payloads, _trace=_trace, **opts)
+        stats.update({
             "real_rows": real,
             "padded_rows": padded,
             "recompiles": watcher.total_traces() - traces_before,
-        }
+        })
+        return results, stats
 
-    def _execute_classify(self, payloads, **opts):
+    def _emit(self, name: str, t0_ns: int, **args):
+        # deferred (no registry lock): this runs on the scheduler worker
+        # while other models' workers hold the GIL — see event_deferred
+        tm.get_telemetry().event_deferred(name, t0_ns, time.time_ns(),
+                                          model=self.model_id, **args)
+
+    def _execute_classify(self, payloads, _trace=False, **opts):
         if opts:
             raise ValueError(f"classify takes no options, got {opts}")
-        xs = np.concatenate([np.asarray(p) for p in payloads], axis=0)
-        n = len(xs)
+        n = sum(int(np.shape(p)[0]) for p in payloads)
         # the SAME cap-aware plan the mesh path executes, so the occupancy
         # stat reflects the padding that actually ran (mesh-divisibility
         # rounding of the 'data' axis is not included — on a 1-device
@@ -157,18 +171,37 @@ class ServingModel:
         plan = self.policy.plan_serving_batch(n, cap=cap)
         padded = sum(p for _, p in plan)
         if self.inference is not None:
-            out = self.inference.output(xs)  # plans the same chunks inside
+            t0 = time.time_ns() if _trace else 0
+            xs = np.concatenate([np.asarray(p) for p in payloads], axis=0)
+            if _trace:
+                self._emit("serving.exec.pad", t0, rows=n)
+            t1 = time.time_ns() if _trace else 0
+            out = self.inference.output(xs)  # plans the chunks inside
+            if _trace:
+                self._emit("serving.exec.device", t1, rows=n, padded=padded)
         else:
-            chunks, off = [], 0
+            # bucket-padding phase (host work) separated from the device
+            # phase so a sampled trace shows where the milliseconds went
+            t0 = time.time_ns() if _trace else 0
+            xs = np.concatenate([np.asarray(p) for p in payloads], axis=0)
+            padded_chunks, off = [], 0
             for take, bucket in plan:
                 chunk = xs[off:off + take]
                 if bucket != take:
-                    pad = np.zeros((bucket - take,) + xs.shape[1:], xs.dtype)
+                    pad = np.zeros((bucket - take,) + xs.shape[1:],
+                                   xs.dtype)
                     chunk = np.concatenate([chunk, pad], axis=0)
-                res = np.asarray(self.net.output(chunk))[:take]
-                chunks.append(res)
+                padded_chunks.append((chunk, take))
                 off += take
+            if _trace:
+                self._emit("serving.exec.pad", t0, rows=n, padded=padded)
+            t1 = time.time_ns() if _trace else 0
+            chunks = [np.asarray(self.net.output(chunk))[:take]
+                      for chunk, take in padded_chunks]
             out = np.concatenate(chunks, axis=0)
+            if _trace:
+                self._emit("serving.exec.device", t1, rows=n,
+                           padded=padded, chunks=len(plan))
         results, off = [], 0
         for p in payloads:
             k = int(np.shape(p)[0])
@@ -176,14 +209,20 @@ class ServingModel:
             off += k
         return results, n, padded
 
-    def _execute_generate(self, payloads, **opts):
+    def _execute_generate(self, payloads, _trace=False, _stats=None, **opts):
         prompts = [list(np.asarray(p).ravel().astype(np.int64)) for p in
                    payloads]
         max_new = int(opts.get("max_new_tokens", 16))
+        t0 = time.perf_counter()
         tokens = self.generator.generate(
             prompts, max_new_tokens=max_new,
             temperature=float(opts.get("temperature", 0.0)),
-            eos_id=opts.get("eos_id"))
+            eos_id=opts.get("eos_id"), trace=_trace)
+        if _stats is not None:
+            # decode wall (incl. prefill) — the scheduler turns this into
+            # per-request serving.decode_tokens_per_sec observations
+            _stats["decode_seconds"] = time.perf_counter() - t0
+            _stats["decode_tokens"] = sum(len(t) for t in tokens)
         real = len(prompts)
         padded = self.policy.bucket_batch(real)
         return tokens, real, padded

@@ -90,11 +90,21 @@ class ModelRouter:
 
     # ------------------------------------------------------------- serving
     def submit(self, model_id: str, payload, *, lane: str = "interactive",
-               deadline_ms: Optional[float] = None, **opts):
-        """Route one request to its model's scheduler; returns a Future."""
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None, **opts):
+        """Route one request to its model's scheduler; returns a Future.
+        ``request_id`` (e.g. the HTTP layer's ``X-Request-Id``) threads
+        through to the scheduler's trace spans and flight recorder."""
         _model, sched = self.get(model_id)
         return sched.submit(payload, lane=lane, deadline_ms=deadline_ms,
-                            **opts)
+                            request_id=request_id, **opts)
+
+    def debug_requests(self, model_id: str,
+                       last: Optional[int] = None) -> list:
+        """The model's flight-recorder ring (newest last) — the
+        ``/v1/models/<id>/debug/requests`` body (docs/OBSERVABILITY.md)."""
+        _model, sched = self.get(model_id)
+        return sched.flight.dump(last=last)
 
     def warmup(self) -> int:
         """Prime every model's bucket executables (docs/SERVING.md).
@@ -149,8 +159,8 @@ def current_status() -> dict:
 
 def collect_metrics() -> list:
     """Scrape-time gauges for the telemetry default collectors: fresh
-    queue depth / p50 / p99 / QPS per model even when no batch has run
-    since the last scrape."""
+    queue depth / p50 / p99 / QPS per model (combined AND per-lane) even
+    when no batch has run since the last scrape."""
     rows = []
     for r in list(_ROUTERS):
         for model_id in r.model_ids():
@@ -161,10 +171,40 @@ def collect_metrics() -> list:
             labels = {"model": model_id}
             rows.append(("serving.queue_depth", labels,
                          float(sched.queue_depth())))
+            for lane, depth in sched.lane_queue_depths().items():
+                rows.append(("serving.queue_depth",
+                             {**labels, "lane": lane}, float(depth)))
             rows.append(("serving.qps_10s", labels, float(sched.qps())))
+            rows.append(("serving.flight_recorder_depth", labels,
+                         float(len(sched.flight))))
             for q, name in ((0.5, "serving.latency_p50_seconds"),
                             (0.99, "serving.latency_p99_seconds")):
                 v = sched.latencies.quantile(q)
                 if v is not None:
                     rows.append((name, labels, float(v)))
+                for lane, win in sched.lane_latencies.items():
+                    lv = win.quantile(q)
+                    if lv is not None:
+                        rows.append((name, {**labels, "lane": lane},
+                                     float(lv)))
     return rows
+
+
+def flight_snapshot(last: int = 64) -> dict:
+    """Last-N flight-recorder records for every live router's models —
+    the crash-dump serving section (util/stats.py), sys.modules-guarded
+    at the call site like current_status()."""
+    out: dict = {}
+    for r in list(_ROUTERS):
+        models = {}
+        for model_id in r.model_ids():
+            try:
+                _m, sched = r.get(model_id)
+            except UnknownModelError:
+                continue
+            records = sched.flight.dump(last=last)
+            if records:
+                models[model_id] = records
+        if models:
+            out[r.name] = models
+    return out

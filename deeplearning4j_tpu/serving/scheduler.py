@@ -26,23 +26,134 @@ device batches:
 
 Telemetry (all on the process registry → /metrics): per-model request/shed
 counters, queue-depth gauge, batch-occupancy and latency histograms,
-p50/p99 latency gauges, and ``serving.recompiles_total`` — the count of
-XLA traces serving has caused since warmup, asserted 0 in steady state by
-the CI smoke (benchmarks/serving_smoke.py).
+p50/p99 latency gauges (combined AND split by ``lane``), and
+``serving.recompiles_total`` — the count of XLA traces serving has caused
+since warmup, asserted 0 in steady state by the CI smoke
+(benchmarks/serving_smoke.py).
+
+Request-scope observability (docs/OBSERVABILITY.md#request-tracing--slos):
+every request carries a ``request_id`` (the HTTP layer honors/echoes
+``X-Request-Id``) and wall-clock phase stamps — queue wait, batch-fill
+wait, device compute — emitted as telemetry spans on the shared trace
+timebase when the request is **head-sampled** (``DL4J_TPU_TRACE_SAMPLE``,
+a 0..1 keep fraction; slow/shed/error requests are ALWAYS kept so the
+interesting tail never depends on the dice; ``0`` disables request tracing
+entirely). Every completed/shed/errored request additionally lands in the
+:class:`FlightRecorder` — a bounded per-model ring dumpable via
+``/v1/models/<id>/debug/requests`` and appended to the crash dump — so a
+postmortem after a shed storm has the last N requests in hand regardless
+of sampling.
 """
 
 from __future__ import annotations
 
+import bisect
 import collections
+import itertools
 import dataclasses
+import os
+import random
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.util import telemetry as tm
 
 LANES = ("interactive", "batch")  # priority order, first drains first
+
+#: head-sampling keep fraction when DL4J_TPU_TRACE_SAMPLE is unset: 2% of
+#: healthy requests get full phase spans; slow/shed/error requests are
+#: always kept (see trace_sample_rate); the flight recorder sees 100%.
+DEFAULT_TRACE_SAMPLE = 0.02
+
+#: a completed request slower than this is "slow" and always traced
+SLOW_REQUEST_MS = 100.0
+
+_sample_cache: Tuple[Optional[str], float] = ("\x00unset", DEFAULT_TRACE_SAMPLE)
+
+
+def trace_sample_rate() -> float:
+    """The head-sampling keep fraction (0..1) from ``DL4J_TPU_TRACE_SAMPLE``
+    (parse memoized on the raw string — submit() calls this per request).
+    ``0`` means request tracing is OFF, including the slow/shed/error
+    always-keep; unset means :data:`DEFAULT_TRACE_SAMPLE`."""
+    global _sample_cache
+    raw = os.environ.get("DL4J_TPU_TRACE_SAMPLE")
+    if raw == _sample_cache[0]:
+        return _sample_cache[1]
+    try:
+        val = min(1.0, max(0.0, float(raw)))  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        val = DEFAULT_TRACE_SAMPLE
+    _sample_cache = (raw, val)
+    return val
+
+
+_id_counter = itertools.count()
+_id_prefix = f"{random.getrandbits(24):06x}"  # per-process, import-time
+
+
+def new_request_id() -> str:
+    """Cheap process-unique 12-hex request id: random per-process prefix
+    + monotone counter. NOT uuid4 — its os.urandom syscall drops the GIL
+    and re-acquiring behind a busy scheduler worker measured ~100µs per
+    submit() on the mixed serving bench (a 30% QPS regression)."""
+    return f"{_id_prefix}{next(_id_counter) & 0xFFFFFF:06x}"
+
+
+#: staged-trace bound per scheduler (sampled requests awaiting export)
+_TRACE_STAGE_MAX = 4096
+
+#: every live scheduler, for export-time span materialization
+#: (telemetry._fold_pending -> collect_deferred_spans, sys.modules-guarded
+#: exactly like the serving metrics collector)
+_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def collect_deferred_spans() -> List[dict]:
+    """Materialize every live scheduler's staged request phase spans into
+    Chrome-event dicts and clear the staging lists. Called by telemetry at
+    export time (chrome_trace/drain_events/snapshot) — per-request span
+    emission on the worker thread measured ~20µs/event of GIL stolen from
+    other models' decode loops, so the hot path stages one tuple instead
+    and ALL dict building happens here, on the cold export path."""
+    out: List[dict] = []
+    for s in list(_SCHEDULERS):
+        try:
+            out.extend(s._materialize_spans())
+        except Exception:
+            continue  # a dying scheduler must never break an export
+    return out
+
+
+class FlightRecorder:
+    """Bounded ring of per-request postmortem records (one per completed,
+    shed, or errored request — independent of trace sampling). Record
+    schema: ``id, lane, rows, bucket, status(ok|shed|error), cause,
+    queue_ms, fill_ms, compute_ms, total_ms, tokens_per_sec?, sampled,
+    traced, time`` (docs/OBSERVABILITY.md#flight-recorder)."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._buf: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def record(self, rec: dict):
+        with self._lock:
+            self._buf.append(rec)
+
+    def dump(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._buf)
+        if last is not None and last > 0:
+            out = out[-last:]
+        return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buf)
 
 
 class ShedError(RuntimeError):
@@ -76,6 +187,14 @@ class _Request:
     opts: Dict[str, Any]
     t_enqueue: float                 # monotonic
     deadline: Optional[float]        # absolute monotonic, or None
+    request_id: str = ""
+    sampled: bool = False            # head-sampling decision at submit
+    # wall-clock phase stamps (ns) for span emission + the flight recorder:
+    # submit -> joined a batch -> execute started -> execute done
+    t_submit_ns: int = 0
+    t_open_ns: int = 0
+    t_exec0_ns: int = 0
+    t_exec1_ns: int = 0
 
 
 class _LatencyWindow:
@@ -85,19 +204,34 @@ class _LatencyWindow:
 
     def __init__(self, size: int = 1024):
         self._buf = collections.deque(maxlen=size)
+        self._sorted: List[float] = []
         self._lock = threading.Lock()
 
     def add(self, v: float):
+        # the sorted view is maintained INCREMENTALLY (one C-speed insort
+        # per add, one bisect-delete per eviction): the batch tail reads
+        # p50/p99 on every window it touched, and a full sort there was
+        # ~50µs of GIL per call — measured stealing 2-3x wall from the
+        # OTHER model's per-token decode loop on the mixed serving bench
         with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                evicted = self._buf[0]
+                i = bisect.bisect_left(self._sorted, evicted)
+                del self._sorted[i]
             self._buf.append(v)
+            bisect.insort(self._sorted, v)
 
     def quantile(self, q: float) -> Optional[float]:
+        return self.quantiles((q,))[0]
+
+    def quantiles(self, qs) -> tuple:
+        """Several quantiles in one locked read (no sort — see add)."""
         with self._lock:
-            if not self._buf:
-                return None
-            vals = sorted(self._buf)
-        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
-        return vals[idx]
+            if not self._sorted:
+                return tuple(None for _ in qs)
+            n = len(self._sorted) - 1
+            return tuple(
+                self._sorted[min(n, max(0, int(round(q * n))))] for q in qs)
 
 
 class BatchScheduler:
@@ -105,7 +239,7 @@ class BatchScheduler:
 
     def __init__(self, model, *, max_wait_ms: float = 2.0,
                  max_batch: Optional[int] = None, queue_limit: int = 64,
-                 lanes=LANES):
+                 lanes=LANES, flight_capacity: int = 256):
         self.model = model
         self.model_id = model.model_id
         self.max_wait_ms = float(max_wait_ms)
@@ -120,43 +254,160 @@ class BatchScheduler:
         self._accepting = True
         self._inflight = 0
         self.latencies = _LatencyWindow()
+        self.lane_latencies: Dict[str, _LatencyWindow] = {
+            lane: _LatencyWindow() for lane in self.lanes}
         self._completed_ts = collections.deque(maxlen=4096)
         self._ts_lock = threading.Lock()  # appends race /metrics scrapes
         self.counts = collections.Counter()  # completed/shed_* totals
+        self.lane_counts: Dict[str, collections.Counter] = {
+            lane: collections.Counter() for lane in self.lanes}
+        self.flight = FlightRecorder(capacity=flight_capacity)
+        self._traced: list = []  # staged sampled requests (flat tuples)
+        self._trace_dropped = 0
+        _SCHEDULERS.add(self)
+
+    # ------------------------------------------------------ request tracing
+    def _tracing_on(self) -> bool:
+        return tm.enabled() and trace_sample_rate() > 0.0
+
+    @staticmethod
+    def _phase_ms(t0_ns: int, t1_ns: int) -> Optional[float]:
+        if not t0_ns or not t1_ns:
+            return None
+        return round(max(0, t1_ns - t0_ns) / 1e6, 3)
+
+    def _flight_record(self, req: _Request, status: str, *,
+                       cause: Optional[str] = None, end_ns: Optional[int] = None,
+                       bucket: Optional[int] = None, traced: bool = False,
+                       tokens_per_sec: Optional[float] = None) -> dict:
+        end_ns = end_ns or time.time_ns()
+        rec = {
+            "id": req.request_id,
+            "lane": req.lane,
+            "rows": req.rows,
+            "bucket": bucket,
+            "status": status,
+            "cause": cause,
+            "queue_ms": self._phase_ms(req.t_submit_ns,
+                                       req.t_open_ns or end_ns),
+            "fill_ms": self._phase_ms(req.t_open_ns, req.t_exec0_ns),
+            "compute_ms": self._phase_ms(req.t_exec0_ns, req.t_exec1_ns),
+            "total_ms": self._phase_ms(req.t_submit_ns, end_ns),
+            "sampled": req.sampled,
+            "traced": traced,
+            "time": end_ns / 1e9,
+        }
+        if tokens_per_sec is not None:
+            rec["tokens_per_sec"] = round(tokens_per_sec, 3)
+        self.flight.record(rec)
+        return rec
+
+    def _stage_spans(self, req: _Request, outcome: str,
+                     bucket: Optional[int] = None,
+                     tokens_per_sec: Optional[float] = None,
+                     end_ns: Optional[int] = None):
+        """Stage ONE sampled request for span export: a flat tuple append
+        (no dicts, no registry lock — the hot-path finding behind
+        :func:`collect_deferred_spans`). Thread identity is captured here
+        so the spans land on the recording thread's trace row."""
+        if len(self._traced) >= _TRACE_STAGE_MAX:
+            self._trace_dropped += 1
+            return
+        th = threading.current_thread()
+        self._traced.append(
+            (req.request_id, req.lane, req.rows, req.t_submit_ns,
+             req.t_open_ns, req.t_exec0_ns, req.t_exec1_ns, outcome,
+             bucket, tokens_per_sec, end_ns or time.time_ns(),
+             th.ident, th.name))
+
+    def _materialize_spans(self) -> List[dict]:
+        """Staged tuples -> Chrome phase events (queue_wait / batch_fill /
+        compute), cleared on read. Cold path: runs at telemetry export."""
+        staged, self._traced = self._traced, []
+        if self._trace_dropped:
+            tm.counter("serving.trace_stage_dropped_total",
+                       self._trace_dropped, model=self.model_id)
+            self._trace_dropped = 0
+        pid = os.getpid()
+        out: List[dict] = []
+        for (rid, lane, rows, t_submit, t_open, t_exec0, t_exec1, outcome,
+             bucket, tps, end_ns, tid, tname) in staged:
+            base = {"request_id": rid, "model": self.model_id,
+                    "lane": lane, "outcome": outcome}
+            if not outcome.startswith("shed"):
+                # completions/errors are recorded by the worker inside its
+                # serving.batch span; sheds happen on the submit thread
+                base["parent"] = "serving.batch"
+
+            def ev(name, t0, t1, args):
+                return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+                        "tname": tname, "ts": t0,
+                        "dur": max(0, t1 - t0), "args": args}
+
+            out.append(ev("serving.request.queue_wait", t_submit,
+                          t_open or end_ns, base))
+            if t_open and t_exec0:
+                out.append(ev("serving.request.batch_fill", t_open,
+                              t_exec0, base))
+            if t_exec0 and t_exec1:
+                args = dict(base, rows=rows)
+                if bucket is not None:
+                    args["bucket"] = bucket
+                if tps is not None:
+                    args["tokens_per_sec"] = round(tps, 3)
+                out.append(ev("serving.request.compute", t_exec0,
+                              t_exec1, args))
+        return out
 
     # ------------------------------------------------------------ admission
     def submit(self, payload, *, lane: str = "interactive",
-               deadline_ms: Optional[float] = None, **opts) -> Future:
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None, **opts) -> Future:
         """Enqueue one request; returns a Future of the model result.
         Raises a :class:`ShedError` subclass instead of queueing when the
-        scheduler is draining or the queue is full."""
+        scheduler is draining or the queue is full. ``request_id`` defaults
+        to a fresh id; the HTTP layer passes the inbound ``X-Request-Id``."""
         if lane not in self._queues:
             raise ValueError(f"unknown lane {lane!r} (have {self.lanes})")
         rows = self.model.payload_rows(payload)
         now = time.monotonic()
+        rate = trace_sample_rate() if tm.enabled() else 0.0
         req = _Request(
             payload=payload, rows=rows, future=Future(), lane=lane,
             opts_key=tuple(sorted(opts.items())), opts=opts, t_enqueue=now,
-            deadline=None if deadline_ms is None else now + deadline_ms / 1e3)
+            deadline=None if deadline_ms is None else now + deadline_ms / 1e3,
+            request_id=request_id or new_request_id(),
+            sampled=rate > 0.0 and (rate >= 1.0 or random.random() < rate),
+            t_submit_ns=time.time_ns())
         with self._cv:
             if not self._accepting:
-                self.counts["shed_draining"] += 1
-                tm.counter("serving.shed_total", model=self.model_id,
-                           reason="draining")
+                self._count_shed(req, "draining")
                 raise SchedulerDrainingError(
                     f"{self.model_id}: scheduler draining")
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
-                self.counts["shed_queue_full"] += 1
-                tm.counter("serving.shed_total", model=self.model_id,
-                           reason="queue_full")
+                self._count_shed(req, "queue_full")
                 raise QueueFullError(
                     f"{self.model_id}: queue at capacity ({depth})")
             self._queues[lane].append(req)
             tm.gauge("serving.queue_depth", depth + 1, model=self.model_id)
+            tm.gauge("serving.queue_depth", len(self._queues[lane]),
+                     model=self.model_id, lane=lane)
             self._cv.notify()
         tm.counter("serving.requests_total", model=self.model_id, lane=lane)
         return req.future
+
+    def _count_shed(self, req: _Request, reason: str):
+        """Shared shed bookkeeping: counters (total + per-lane), the flight
+        recorder, and — when tracing is on — the always-kept shed span."""
+        self.counts[f"shed_{reason}"] += 1
+        self.lane_counts[req.lane][f"shed_{reason}"] += 1
+        tm.counter("serving.shed_total", model=self.model_id,
+                   reason=reason, lane=req.lane)
+        traced = self._tracing_on()
+        self._flight_record(req, "shed", cause=reason, traced=traced)
+        if traced:
+            self._stage_spans(req, f"shed:{reason}")
 
     # --------------------------------------------------------------- worker
     def start(self) -> "BatchScheduler":
@@ -170,8 +421,7 @@ class BatchScheduler:
         return self
 
     def _shed(self, req: _Request, exc: ShedError, reason: str):
-        self.counts[f"shed_{reason}"] += 1
-        tm.counter("serving.shed_total", model=self.model_id, reason=reason)
+        self._count_shed(req, reason)
         if not req.future.set_running_or_notify_cancel():
             return
         req.future.set_exception(exc)
@@ -196,7 +446,9 @@ class BatchScheduler:
         """Pop the head of the highest-priority non-empty lane."""
         for lane in self.lanes:
             if self._queues[lane]:
-                return [self._queues[lane].popleft()]
+                req = self._queues[lane].popleft()
+                req.t_open_ns = time.time_ns()  # queue wait ends here
+                return [req]
         return None
 
     def _fill_batch_locked(self, batch: List[_Request]) -> int:
@@ -226,6 +478,7 @@ class BatchScheduler:
                 if req.opts_key != head.opts_key \
                         or rows + req.rows > self.max_batch:
                     break
+                req.t_open_ns = time.time_ns()  # joins the open batch
                 batch.append(q.popleft())
                 rows += req.rows
         return rows
@@ -245,66 +498,142 @@ class BatchScheduler:
                     continue
                 self._inflight = 1
             # max-wait window: keep admitting until the batch is full or
-            # max_wait_ms has passed since it opened (continuous batching)
+            # max_wait_ms has passed since it opened (continuous batching).
+            # The whole cycle (fill wait + execute) is one worker-thread
+            # span, so the trace's serving-<model> row shows where the
+            # worker's time goes between batches.
             t_open = time.monotonic()
             deadline = t_open + self.max_wait_ms / 1e3
-            while True:
-                with self._cv:
-                    rows = self._fill_batch_locked(batch)
-                    if rows >= self.max_batch:
-                        break
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(timeout=remaining)
             try:
-                self._run_batch(batch)
+                with tm.span("serving.worker.batch_cycle",
+                             model=self.model_id) as cycle:
+                    while True:
+                        with self._cv:
+                            rows = self._fill_batch_locked(batch)
+                            if rows >= self.max_batch:
+                                break
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._cv.wait(timeout=remaining)
+                    if hasattr(cycle, "args"):  # not the disabled no-op
+                        cycle.args["requests"] = len(batch)
+                        cycle.args["rows"] = rows
+                    self._run_batch(batch)
             finally:
                 with self._cv:
                     self._inflight = 0
                     tm.gauge("serving.queue_depth",
                              sum(len(q) for q in self._queues.values()),
                              model=self.model_id)
+                    # per-lane depths refresh on dequeue too — without this
+                    # a drained lane's gauge stays at its submit-time high
+                    # water forever (scrapes would show a phantom backlog)
+                    for _lane, _q in self._queues.items():
+                        tm.gauge("serving.queue_depth", len(_q),
+                                 model=self.model_id, lane=_lane)
                     self._cv.notify_all()
 
     def _run_batch(self, batch: List[_Request]):
         t0 = time.monotonic()
+        tracing = self._tracing_on()
+        # batch-level pad/device sub-spans ride the head-sampling decision:
+        # a batch with ANY sampled request gets the detailed execute spans
+        trace_batch = tracing and any(r.sampled for r in batch)
+        exec0_ns = time.time_ns()
+        for req in batch:
+            req.t_exec0_ns = exec0_ns
         with tm.span("serving.batch", model=self.model_id,
                      requests=len(batch), lane=batch[0].lane):
             try:
                 results, stats = self.model.execute(
-                    [r.payload for r in batch], **batch[0].opts)
+                    [r.payload for r in batch], _trace=trace_batch,
+                    **batch[0].opts)
             except Exception as e:  # a bad request fails its batch, never
-                for req in batch:   # the worker (ParallelInference contract)
+                err_ns = time.time_ns()  # the worker (ParallelInference
+                for req in batch:        # contract)
+                    req.t_exec1_ns = err_ns
                     if req.future.set_running_or_notify_cancel():
                         req.future.set_exception(e)
+                    self.counts["errors"] += 1
+                    self.lane_counts[req.lane]["errors"] += 1
+                    tm.counter("serving.request_errors_total",
+                               model=self.model_id, lane=req.lane)
+                    # errors are always kept (tracing permitting)
+                    self._flight_record(req, "error", cause=repr(e)[:200],
+                                        end_ns=err_ns, traced=tracing)
+                    if tracing:
+                        self._stage_spans(req, "error", end_ns=err_ns)
                 tm.counter("serving.batch_errors_total", model=self.model_id)
                 return
-        now = time.monotonic()
-        for req, res in zip(batch, results):
-            if req.future.set_running_or_notify_cancel():
-                req.future.set_result(res)
-            lat = now - req.t_enqueue
-            self.latencies.add(lat)
-            with self._ts_lock:
-                self._completed_ts.append(now)
-            self.counts["completed"] += 1
-            tm.observe("serving.request_latency_seconds", lat,
-                       model=self.model_id, lane=req.lane)
+            exec1_ns = time.time_ns()
+            now = time.monotonic()
+            padded = stats.get("padded_rows")
+            decode_s = stats.get("decode_seconds")
+            decode_toks = stats.get("decode_tokens")
+            lane_done: collections.Counter = collections.Counter()
+            for req, res in zip(batch, results):
+                req.t_exec1_ns = exec1_ns
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_result(res)
+                lat = now - req.t_enqueue
+                self.latencies.add(lat)
+                self.lane_latencies[req.lane].add(lat)
+                with self._ts_lock:
+                    self._completed_ts.append(now)
+                self.counts["completed"] += 1
+                self.lane_counts[req.lane]["completed"] += 1
+                lane_done[req.lane] += 1
+                tm.observe("serving.request_latency_seconds", lat,
+                           model=self.model_id, lane=req.lane)
+                tps = None
+                if decode_s and decode_toks:
+                    # per-request decode throughput: this request's tokens
+                    # over the batch's decode wall (incl. prefill)
+                    try:
+                        tps = len(res) / decode_s
+                    except TypeError:
+                        tps = None
+                    if tps is not None:
+                        tm.observe("serving.decode_tokens_per_sec", tps,
+                                   model=self.model_id, lane=req.lane)
+                keep = tracing and (req.sampled
+                                    or lat * 1e3 > SLOW_REQUEST_MS)
+                self._flight_record(req, "ok", end_ns=exec1_ns,
+                                    bucket=padded, traced=keep,
+                                    tokens_per_sec=tps)
+                if keep:
+                    self._stage_spans(
+                        req, "ok" if req.sampled else "slow",
+                        bucket=padded, tokens_per_sec=tps, end_ns=exec1_ns)
+        # one counter bump per lane per batch, not per request — registry
+        # lock acquisitions on the worker are GIL time stolen from other
+        # models' workers (the mixed-bench finding; see _LatencyWindow.add)
+        for lane, done in lane_done.items():
+            tm.counter("serving.completed_total", done,
+                       model=self.model_id, lane=lane)
         tm.counter("serving.batches_total", model=self.model_id)
         tm.counter("serving.recompiles_total", stats.get("recompiles", 0),
                    model=self.model_id)
-        if stats.get("padded_rows"):
+        if padded:
             tm.observe("serving.batch_occupancy",
-                       stats["real_rows"] / stats["padded_rows"],
-                       model=self.model_id)
+                       stats["real_rows"] / padded,
+                       model=self.model_id, lane=batch[0].lane)
         tm.observe("serving.batch_exec_seconds", now - t0,
                    model=self.model_id)
-        for q, g in (("0.5", "serving.latency_p50_seconds"),
-                     ("0.99", "serving.latency_p99_seconds")):
-            val = self.latencies.quantile(float(q))
-            if val is not None:
-                tm.gauge(g, val, model=self.model_id)
+        gauges = ((0.5, "serving.latency_p50_seconds"),
+                  (0.99, "serving.latency_p99_seconds"))
+        # one sort per touched window, and only the lanes THIS batch fed —
+        # idle lanes keep their last gauge (collect_metrics refreshes all
+        # lanes at scrape time anyway)
+        windows = [(self.latencies, {})] + [
+            (self.lane_latencies[lane], {"lane": lane})
+            for lane in {r.lane for r in batch}]
+        for win, extra in windows:
+            for (q, g), val in zip(gauges,
+                                   win.quantiles([q for q, _g in gauges])):
+                if val is not None:
+                    tm.gauge(g, val, model=self.model_id, **extra)
 
     # ----------------------------------------------------------- lifecycle
     def drain(self, timeout: float = 30.0) -> bool:
@@ -350,6 +679,10 @@ class BatchScheduler:
         with self._cv:
             return sum(len(q) for q in self._queues.values())
 
+    def lane_queue_depths(self) -> Dict[str, int]:
+        with self._cv:
+            return {lane: len(q) for lane, q in self._queues.items()}
+
     def qps(self, window_s: float = 10.0) -> float:
         now = time.monotonic()
         with self._ts_lock:
@@ -359,15 +692,36 @@ class BatchScheduler:
     def stats(self) -> dict:
         p50 = self.latencies.quantile(0.5)
         p99 = self.latencies.quantile(0.99)
+
+        def _ms(v):
+            return None if v is None else round(v * 1e3, 3)
+
+        lanes = {}
+        for lane in self.lanes:
+            lc = self.lane_counts[lane]
+            win = self.lane_latencies[lane]
+            lanes[lane] = {
+                "completed": lc["completed"],
+                "errors": lc["errors"],
+                # per-lane shed counts BY CAUSE (deadline vs queue_full vs
+                # draining) — the ISSUE 12 attribution satellite
+                "shed": {k[len("shed_"):]: v for k, v in lc.items()
+                         if k.startswith("shed_")},
+                "latency_p50_ms": _ms(win.quantile(0.5)),
+                "latency_p99_ms": _ms(win.quantile(0.99)),
+            }
         return {
             "queue_depth": self.queue_depth(),
             "accepting": self._accepting,
             "completed": self.counts["completed"],
+            "errors": self.counts["errors"],
             "shed": {k[len("shed_"):]: v for k, v in self.counts.items()
                      if k.startswith("shed_")},
+            "lanes": lanes,
             "qps_10s": round(self.qps(), 3),
-            "latency_p50_ms": None if p50 is None else round(p50 * 1e3, 3),
-            "latency_p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+            "latency_p50_ms": _ms(p50),
+            "latency_p99_ms": _ms(p99),
+            "flight_recorder_depth": len(self.flight),
             "max_batch": self.max_batch,
             "max_wait_ms": self.max_wait_ms,
             "queue_limit": self.queue_limit,

@@ -8,8 +8,15 @@ no egress) over a :class:`~deeplearning4j_tpu.serving.router.ModelRouter`:
                                     "max_new_tokens": N,
                                     "temperature": T}            → tokens
     GET  /v1/models                                              → registry
+    GET  /v1/models/<id>/debug/requests[?last=N]   flight-recorder dump
     GET  /metrics                  Prometheus text (ui_server collectors)
-    GET  /healthz                  health JSON incl. the serving section
+    GET  /healthz                  health JSON incl. serving + slo sections
+    GET  /slo                      SLO evaluation JSON (util/slo.py)
+
+Request scope: every POST honors an inbound ``X-Request-Id`` header (or
+mints one) and echoes it on the response — success AND error — so a caller
+can correlate its 429 with the scheduler's flight-recorder record and the
+sampled trace spans (docs/OBSERVABILITY.md#request-tracing--slos).
 
 Request headers/body knobs: ``lane`` ("interactive"|"batch") and
 ``deadline_ms`` ride in the JSON body. The load-shed contract
@@ -141,17 +148,19 @@ class ModelServer:
         return f"http://{self.host}:{self.port}"
 
     # ------------------------------------------------------------ handlers
-    def _handle_infer(self, model_id: str, body: dict) -> dict:
+    def _handle_infer(self, model_id: str, body: dict,
+                      request_id: Optional[str] = None) -> dict:
         x = np.asarray(body["inputs"], np.float32)
         if x.ndim < 2:
             x = x[None]
         fut = self.router.submit(
             model_id, x, lane=body.get("lane", "interactive"),
-            deadline_ms=body.get("deadline_ms"))
+            deadline_ms=body.get("deadline_ms"), request_id=request_id)
         out = fut.result(timeout=self.request_timeout_s)
         return {"model": model_id, "outputs": np.asarray(out).tolist()}
 
-    def _handle_generate(self, model_id: str, body: dict) -> dict:
+    def _handle_generate(self, model_id: str, body: dict,
+                         request_id: Optional[str] = None) -> dict:
         prompts = body.get("prompt_tokens", body.get("prompts"))
         if prompts is None:
             raise ValueError("generate needs prompt_tokens")
@@ -164,11 +173,18 @@ class ModelServer:
             opts["eos_id"] = int(body["eos_id"])
         futs = []
         try:
-            for p in prompts:
+            for i, p in enumerate(prompts):
+                # multi-prompt bodies fan out to N scheduler requests: each
+                # keeps the caller's id with a /row suffix, so all of them
+                # correlate back to one HTTP request in the flight recorder
+                rid = None if request_id is None else (
+                    request_id if len(prompts) == 1
+                    else f"{request_id}/{i}")
                 futs.append(self.router.submit(
                     model_id, np.asarray(p, np.int32),
                     lane=body.get("lane", "batch"),
-                    deadline_ms=body.get("deadline_ms"), **opts))
+                    deadline_ms=body.get("deadline_ms"),
+                    request_id=rid, **opts))
             toks = [f.result(timeout=self.request_timeout_s) for f in futs]
         except Exception:
             # a shed/timeout mid-list must not abandon live work: cancel
@@ -201,14 +217,36 @@ def _make_handler(server: ModelServer):
             self._send(status, json.dumps(obj).encode(), headers=headers)
 
         def do_GET(self):
-            if self.path == "/metrics":
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            parts = u.path.strip("/").split("/")
+            if u.path == "/metrics":
                 self._send(200, UIServer._metrics_text().encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
-            elif self.path == "/healthz":
+            elif u.path == "/healthz":
                 body, ok = UIServer._healthz()
                 self._send(200 if ok else 503, body.encode())
-            elif self.path in ("/v1/models", "/v1/models/"):
+            elif u.path == "/slo":
+                self._send(200, UIServer._slo_json().encode())
+            elif u.path in ("/v1/models", "/v1/models/"):
                 self._send_json(200, server.router.status())
+            elif len(parts) == 5 and parts[:2] == ["v1", "models"] \
+                    and parts[3:] == ["debug", "requests"]:
+                # flight-recorder dump: the last-N completed/shed/error
+                # request records for one model (docs/OBSERVABILITY.md)
+                try:
+                    last = int(parse_qs(u.query).get("last", [0])[0]) or None
+                except ValueError:
+                    last = None
+                try:
+                    records = server.router.debug_requests(parts[2],
+                                                           last=last)
+                except UnknownModelError as e:
+                    self._send_json(404, {"error": f"unknown model {e}"})
+                    return
+                self._send_json(200, {"model": parts[2],
+                                      "requests": records})
             else:
                 self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -220,32 +258,46 @@ def _make_handler(server: ModelServer):
                 self._send_json(404, {"error": f"no route {self.path}"})
                 return
             model_id, verb = parts[2], parts[3]
+            # honor the caller's X-Request-Id (mint one otherwise) and echo
+            # it on EVERY response — 200s and sheds alike — so the caller,
+            # the trace spans, and the flight recorder share one id
+            from deeplearning4j_tpu.serving.scheduler import new_request_id
+
+            rid = self.headers.get("X-Request-Id") or new_request_id()
+            rid_hdr = [("X-Request-Id", rid)]
             if server.draining:
                 self._send_json(
                     503, {"error": "draining", "model": model_id},
-                    headers=[("Retry-After", "10")])
+                    headers=[("Retry-After", "10")] + rid_hdr)
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 if verb == "infer":
-                    resp = server._handle_infer(model_id, body)
+                    resp = server._handle_infer(model_id, body,
+                                                request_id=rid)
                 else:
-                    resp = server._handle_generate(model_id, body)
-                self._send_json(200, resp)
+                    resp = server._handle_generate(model_id, body,
+                                                   request_id=rid)
+                resp["request_id"] = rid
+                self._send_json(200, resp, headers=rid_hdr)
             except UnknownModelError as e:
-                self._send_json(404, {"error": f"unknown model {e}"})
+                self._send_json(404, {"error": f"unknown model {e}"},
+                                headers=rid_hdr)
             except ShedError as e:
                 # the load-shed contract: 429 (or 503 while draining) with
                 # Retry-After, body says why — docs/SERVING.md
                 self._send_json(
                     e.http_status,
-                    {"error": type(e).__name__, "detail": str(e)},
+                    {"error": type(e).__name__, "detail": str(e),
+                     "request_id": rid},
                     headers=[("Retry-After",
-                              str(int(max(1, e.retry_after_s))))])
+                              str(int(max(1, e.retry_after_s))))] + rid_hdr)
             except (KeyError, ValueError, TypeError) as e:
-                self._send_json(400, {"error": f"bad request: {e!r}"})
+                self._send_json(400, {"error": f"bad request: {e!r}"},
+                                headers=rid_hdr)
             except Exception as e:  # noqa: BLE001 — a broken batch must
-                self._send_json(500, {"error": repr(e)})  # not kill the srv
+                self._send_json(500, {"error": repr(e)},  # not kill the srv
+                                headers=rid_hdr)
 
     return Handler

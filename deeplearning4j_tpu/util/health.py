@@ -68,6 +68,22 @@ class RollbackSignal(RuntimeError):
 ROLLBACK_KINDS = ("loss_non_finite", "params_non_finite", "divergence")
 
 
+def record_anomaly(kind: str, detail: str, *, source: str = "health",
+                   log=None, on_anomaly=None, **args):
+    """The shared anomaly-emission convention: one counter
+    (``<source>.anomalies_total{type=kind}``), one instant event on the
+    trace timeline (``<source>.anomaly``), optional log line and callback.
+    Used by :class:`TrainingHealthMonitor` (source="health") and the SLO
+    engine's budget-exhaustion breaches (util/slo.py, source="slo") so
+    both speak the same dialect on ``/metrics`` and the merged trace."""
+    tm.counter(f"{source}.anomalies_total", type=kind)
+    tm.instant(f"{source}.anomaly", type=kind, detail=detail, **args)
+    if log is not None:
+        log(f"{source.upper()} anomaly: {kind} ({detail})")
+    if on_anomaly is not None:
+        on_anomaly(kind, detail)
+
+
 def _finite_and_norms(params, prev):
     """Device-side probe body: [all_finite, ‖params‖, ‖params−prev‖] as one
     stacked float32 vector — three scalars, ONE fetch. ``prev=None`` skips
@@ -166,14 +182,12 @@ class TrainingHealthMonitor(TrainingListener):
     # ------------------------------------------------------------- anomalies
     def _anomaly(self, iteration: int, kind: str, detail: str):
         self.anomalies.append((iteration, kind, detail))
-        tm.counter("health.anomalies_total", type=kind)
-        tm.instant("health.anomaly", type=kind, iteration=iteration,
-                   detail=detail)
-        if self.log:
-            self.log(f"HEALTH anomaly at iteration {iteration}: {kind} "
-                     f"({detail})")
-        if self.on_anomaly is not None:
-            self.on_anomaly(kind, detail)
+        record_anomaly(
+            kind, detail, source="health", iteration=iteration,
+            log=(lambda _msg: self.log(
+                f"HEALTH anomaly at iteration {iteration}: {kind} "
+                f"({detail})")) if self.log else None,
+            on_anomaly=self.on_anomaly)
         if self.action == "rollback" and kind in ROLLBACK_KINDS:
             # the graceful alternative to panic: the supervising loop
             # restores the last good checkpoint and re-enters training

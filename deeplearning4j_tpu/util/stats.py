@@ -251,6 +251,20 @@ class CrashReportingUtil:
         info["hbm"] = [
             {"metric": name, **labels, "value": value}
             for name, labels, value in tele.device_memory_stats()]
+        # serving flight recorder (docs/OBSERVABILITY.md#flight-recorder):
+        # the last-N completed/shed/errored requests of every live router,
+        # so a postmortem after a shed storm or drain has them in hand —
+        # sys.modules-guarded like the /healthz serving section, a process
+        # that never served pays nothing
+        try:
+            import sys as _sys
+
+            _serving = _sys.modules.get("deeplearning4j_tpu.serving.router")
+            snap = _serving.flight_snapshot(last=64) if _serving else {}
+            if snap:
+                info["serving_flight_recorder"] = snap
+        except Exception:
+            pass  # a broken recorder must never break the crash dump
         with open(path, "w") as f:
             json.dump(info, f, indent=2)
         return path

@@ -122,6 +122,7 @@ class Telemetry:
         self.histograms: Dict[Tuple[str, tuple], _Hist] = {}
         self.health: Dict[str, Tuple[bool, str]] = {}
         self._events: deque = deque()
+        self._pending: list = []  # event_deferred() staging, GIL-atomic
         self._dropped = 0
         self._collectors: List[Callable[[], list]] = []
         self._lock = threading.Lock()
@@ -158,6 +159,25 @@ class Telemetry:
             if h is None:
                 h = self.histograms[key] = _Hist()
             h.observe(float(value))
+
+    # ------------------------------------------------------------ metric reads
+    def counter_total(self, name: str, **label_filter) -> float:
+        """Sum of every counter series named ``name`` whose labels are a
+        superset of ``label_filter`` (the SLO engine's read path —
+        ``counter_total("serving.shed_total", model="dense")`` sums over
+        all reasons/lanes of that model)."""
+        flt = {str(k): str(v) for k, v in label_filter.items()}.items()
+        with self._lock:
+            return sum(v for (n, labels), v in self.counters.items()
+                       if n == name and flt <= set(labels))
+
+    def gauge_values(self, name: str, **label_filter) -> List[float]:
+        """Every gauge value named ``name`` whose labels superset-match
+        ``label_filter`` (callers pick max/min for worst/best-case)."""
+        flt = {str(k): str(v) for k, v in label_filter.items()}.items()
+        with self._lock:
+            return [v for (n, labels), v in self.gauges.items()
+                    if n == name and flt <= set(labels)]
 
     # ------------------------------------------------------------- spans API
     def _span_stack(self) -> list:
@@ -197,6 +217,45 @@ class Telemetry:
         with self._lock:
             self._append(ev)
 
+    def event_deferred(self, name: str, t0_ns: int, t1_ns: int, **args):
+        """:meth:`event` minus the registry lock: the record lands on a
+        staging list with one GIL-atomic append and is folded into the
+        ring at the next export (:meth:`chrome_trace` /
+        :meth:`drain_events` / :meth:`snapshot`). For per-request serving
+        spans — the registry lock there is GIL time stolen from OTHER
+        models' decode loops (the mixed-bench finding: ~20µs/event
+        contended vs ~1µs deferred). Ordering across threads is restored
+        by Perfetto's ts sort; same-thread order is preserved."""
+        if not self.enabled:
+            return
+        th = threading.current_thread()
+        ev = {"name": name, "ph": "X", "pid": os.getpid(), "tid": th.ident,
+              "tname": th.name, "ts": t0_ns, "dur": max(0, t1_ns - t0_ns)}
+        stack = self._span_stack()
+        if stack:
+            args.setdefault("parent", stack[-1])
+        if args:
+            ev["args"] = args
+        if len(self._pending) >= self.max_events:  # bound the staging list
+            with self._lock:
+                self._dropped += 1
+                self.counters[("telemetry.events_dropped_total", ())] = \
+                    self._dropped
+            return
+        self._pending.append(ev)
+
+    def _fold_pending(self):
+        """Move staged event_deferred() records — plus the serving
+        schedulers' staged request spans — into the ring (called under no
+        lock; takes the registry lock once for the whole batch)."""
+        pend, self._pending = self._pending, []
+        pend += _staged_serving_spans()
+        if not pend:
+            return
+        with self._lock:
+            for ev in pend:
+                self._append(ev)
+
     def instant(self, name: str, **args):
         """Record a zero-duration marker ('i' event) — stalls, anomalies."""
         if not self.enabled:
@@ -220,6 +279,7 @@ class Telemetry:
     def drain_events(self) -> List[dict]:
         """Return + clear the span buffer (forked ETL workers ship the
         result of this over the result pipe; datavec/executor.py)."""
+        self._fold_pending()
         with self._lock:
             out = list(self._events)
             self._events.clear()
@@ -272,6 +332,7 @@ class Telemetry:
         loop + prefetch thread + merged ETL workers + replica rows), ts/dur
         in µs relative to the earliest event, with process/thread name
         metadata rows."""
+        self._fold_pending()
         with self._lock:
             events = [dict(e) for e in self._events]
         if not events:
@@ -370,6 +431,8 @@ class Telemetry:
     def snapshot(self, events_tail: int = 0) -> dict:
         """JSON-able counters/gauges/histogram-summaries (+ optional last-N
         events) — the StatsListener ``telemetry`` group and the crash dump."""
+        if events_tail:
+            self._fold_pending()
         with self._lock:
             counters = {_flat_name(k): round(v, 6)
                         for k, v in self.counters.items()}
@@ -390,14 +453,31 @@ class Telemetry:
         return out
 
     def reset(self):
+        _staged_serving_spans()  # discard staged serving request spans
         with self._lock:
             self.counters.clear()
             self.gauges.clear()
             self.histograms.clear()
             self.health.clear()
             self._events.clear()
+            self._pending = []
             self._dropped = 0
             # collectors survive reset: they are wiring, not data
+
+
+def _staged_serving_spans() -> list:
+    """Request phase spans staged by serving schedulers (cleared on read)
+    — sys.modules-guarded like the elastic/serving/tuning collectors, so
+    a process that never imported serving pays nothing."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.serving.scheduler")
+    if mod is None:
+        return []
+    try:
+        return mod.collect_deferred_spans()
+    except Exception:
+        return []  # a broken scheduler must never break an export
 
 
 class _NullSpan:
@@ -582,6 +662,7 @@ def install_default_collectors() -> Telemetry:
         tele.register_collector(_collect_elastic)
         tele.register_collector(_collect_serving)
         tele.register_collector(_collect_tuning)
+        tele.register_collector(_collect_slo)
         _defaults_installed = True
     return tele
 
@@ -680,6 +761,18 @@ def _collect_tuning() -> list:
     return mod.collect_tuning_gauges()
 
 
+def _collect_slo() -> list:
+    """SLO gauges (compliance, burn rates, budget remaining) at scrape
+    time — import-guarded like elastic/serving/tuning, so a process that
+    never declared an objective pays nothing (docs/OBSERVABILITY.md)."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.util.slo")
+    if mod is None:
+        return []
+    return mod.collect_slo_gauges()
+
+
 def _after_fork_child():
     """Forked children (mp-ETL workers) inherit the parent's registry by
     memory image: re-arm the lock (the parent may have held it mid-fork)
@@ -690,6 +783,7 @@ def _after_fork_child():
         t._lock = threading.Lock()
         t._tls = threading.local()
         t._events = deque()
+        t._pending = []
         t._dropped = 0
 
 

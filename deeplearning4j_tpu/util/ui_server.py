@@ -116,6 +116,12 @@ class UIServer:
                     # table, totals, achieved FLOP/s, MFU — as JSON
                     self._send(server._costs_json().encode(),
                                "application/json")
+                elif u.path == "/slo":
+                    # SLO evaluation (docs/OBSERVABILITY.md#request-
+                    # tracing--slos): every declared objective's current
+                    # compliance, per-window burn rates, budget remaining
+                    self._send(server._slo_json().encode(),
+                               "application/json")
                 elif u.path == "/train/sessions":
                     self._send(json.dumps(server._sessions()).encode(),
                                "application/json")
@@ -173,6 +179,17 @@ class UIServer:
         return json.dumps({"reports": cost_model.published_reports()})
 
     @staticmethod
+    def _slo_json() -> str:
+        """JSON body for /slo: the SLO engine's evaluation (util/slo.py).
+        Lazy import — hitting the route is the opt-in; an empty objectives
+        list comes back until something is declared, so dashboards can
+        poll unconditionally."""
+        from deeplearning4j_tpu.util import slo
+
+        doc = slo.current_status()
+        return json.dumps(doc if doc else {"objectives": []})
+
+    @staticmethod
     def _healthz() -> "tuple[str, bool]":
         """(JSON body, healthy?) for /healthz: aggregates every health
         check published by util/health.py monitors, plus device liveness
@@ -182,6 +199,18 @@ class UIServer:
         or LB drains the task without parsing the body."""
         from deeplearning4j_tpu.util import telemetry as tm
 
+        slo_status = {}
+        try:
+            import sys
+
+            # SLO section (docs/OBSERVABILITY.md#request-tracing--slos):
+            # evaluated BEFORE the health report is read, so a budget that
+            # exhausted since the last probe flips THIS response to 503 —
+            # same sys.modules guard as elastic/serving/tuning below
+            _slo = sys.modules.get("deeplearning4j_tpu.util.slo")
+            slo_status = _slo.current_status() if _slo else {}
+        except Exception:
+            pass  # a broken status provider must never break the probe
         ok, checks = tm.get_telemetry().health_report()
         try:
             import jax
@@ -229,6 +258,8 @@ class UIServer:
                 body["tuning"] = status
         except Exception:
             pass
+        if slo_status:
+            body["slo"] = slo_status
         return json.dumps(body), ok
 
     # ------------------------------------------------------------- rendering
