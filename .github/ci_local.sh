@@ -93,6 +93,16 @@ echo "== step: Serving smoke (model server + continuous batching + drain) =="
 # /healthz serving surfaces, graceful drain -> 503.
 JAX_PLATFORMS=cpu python benchmarks/serving_smoke.py
 
+echo "== step: Resilience smoke (reload storm + fault recoveries + brownout) =="
+# ISSUE 13: the serving resilience layer end-to-end on real HTTP — 5
+# rolling reloads under mixed traffic (zero shed, zero recompiles, version
+# advancing), corrupt archive -> 409 with the old version still serving,
+# serving_worker_crash -> 500 + flight cause + supervised restart,
+# serving_compute_error -> breaker open (503 + Retry-After) then half-open
+# probe closes, serving_slow_batch -> deadline shed behind the stall, SLO
+# exhaustion -> batch-lane brownout while interactive serves, clean drain.
+JAX_PLATFORMS=cpu python benchmarks/resilience_smoke.py
+
 echo "== step: Kernel-engine equivalence (Pallas interpret, fused optimizer) =="
 # ISSUE 9: the hot-path kernel suite with the dispatch knob FORCED to
 # pallas — off-TPU that is the Pallas interpreter, bit-faithful to the

@@ -82,6 +82,15 @@ LOWER_BETTER = {
     # default 2% head sample; target ≤ 1.05x, the r9 telemetry_overhead
     # convention
     "request_tracing_overhead",
+    # serving resilience layer (ISSUE 13): what the supervised watchdog +
+    # per-model circuit breaker cost the mixed serving workload vs both
+    # off — target ≤ 1.05x, the r9 overhead convention
+    "serving_resilience_overhead",
+    # and what a rolling-reload storm (restore + shadow warmup + canary +
+    # swap) adds to the traffic's p99 tail vs a duration-matched steady
+    # window — ms, floored at 0.5 so the multiplicative band stays sane
+    # when the storm is within timer noise of free
+    "serving_reload_p99_delta_ms",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

@@ -1,0 +1,417 @@
+"""Serving-resilience smoke check (the ISSUE 13 CI leg, wired in
+ci.yml/ci_local.sh).
+
+End-to-end proof of the serving resilience layer (docs/SERVING.md#resilience)
+on a real HTTP server, applying the r11 standard — every fault kind's
+specific recovery asserted in CI — to the serving path:
+
+1. **rolling reload storm**: N=5 weight reloads through the admin verb
+   (``POST /v1/models/<id>/reload``) while CONCURRENT mixed classify+generate
+   traffic runs from worker threads — every traffic request answers 200
+   (zero shed), the steady-state ``serving.recompiles_total`` delta is
+   exactly 0 (shadow warmup compiles on the reload thread, never the
+   worker's tally), the version surface advances 2→6 on ``/v1/models``, and
+   the post-storm weights are BIT-identical to the last archive's direct
+   forward;
+2. **corrupt-archive rejection**: a truncated archive answers 409 (never a
+   5xx — the tier is healthy) while the old version keeps answering
+   bit-identically, and the ``reload_corrupt_archive`` fault kind fires the
+   same truncated-zip mechanism on a GOOD archive — rejected once, then the
+   same archive reloads clean;
+3. **supervised worker**: ``serving_worker_crash`` kills the scheduler loop
+   mid-batch — the rider gets a loud 500, the flight recorder records the
+   ``worker_crash`` cause, ``serving.worker_restarts_total`` increments, and
+   the restarted worker answers the next request 200;
+4. **circuit breaker**: ``serving_compute_error`` fails consecutive batches
+   — the breaker OPENS (fast-fail 503 + Retry-After instead of queueing
+   doomed work), the cooldown admits a half-open probe whose success CLOSES
+   it, and ``/metrics`` carries the breaker state gauge;
+5. **slow batch**: ``serving_slow_batch`` wedges the worker on a real stall
+   — a request whose deadline expires queued behind it sheds 429, the
+   stalled batch itself completes 200;
+6. **brownout**: a synthetic SLO budget exhaustion sheds the ``batch`` lane
+   (429) while ``interactive`` keeps serving, and budget recovery restores
+   full service;
+7. graceful drain stays clean after all of it.
+
+Exit 0 on success, 1 with a FAIL line on any violated check.
+
+    JAX_PLATFORMS=cpu python benchmarks/resilience_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILED = []
+
+N_RELOADS = 5
+
+
+def check(name: str, ok: bool, detail: str = ""):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def http_get(url: str, use_curl: bool):
+    if use_curl and shutil.which("curl"):
+        out = subprocess.run(
+            ["curl", "-sS", "-w", "\n%{http_code}", url],
+            capture_output=True, text=True, timeout=30)
+        body, _, code = out.stdout.rpartition("\n")
+        if not code.strip().isdigit():
+            return 0, f"curl failed: {out.stderr.strip()}"
+        return int(code), body
+    try:
+        r = urllib.request.urlopen(url, timeout=30)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def http_post(url: str, obj: dict):
+    """(status, json body, headers) for a JSON POST."""
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=120)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def build_dense_net(seed: int):
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .batch_buckets((1, 2, 4, 8)).list()
+            .layer(DenseLayer(n_in=12, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_in=32, n_out=5, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_server():
+    import numpy as np
+
+    from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+    from deeplearning4j_tpu.serving import (ModelRouter, ModelServer,
+                                            ServingModel)
+    from deeplearning4j_tpu.zoo.bert import Bert
+
+    clf_net = build_dense_net(seed=0)
+    bert = Bert.tiny(causal=True, task="mlm", vocab_size=48, max_length=32,
+                     hidden_dropout=0.0).init()
+    router = ModelRouter(name="resilience-smoke")
+    router.register(ServingModel(clf_net, "dense"), max_wait_ms=1.0,
+                    queue_limit=256)
+    router.register(
+        ServingModel(bert, "bert-decode", kind="generate",
+                     bucketing=BucketingPolicy(batch_buckets=(1, 2, 4),
+                                               seq_buckets=(8,))),
+        max_wait_ms=1.0, queue_limit=256)
+    server = ModelServer(router, port=0).start()  # warms every bucket
+    return server, router, np
+
+
+def traffic_loop(server, np, stop, results):
+    """One closed-loop traffic worker: alternating classify (interactive)
+    and generate (batch) requests until ``stop``; every (status, body)
+    lands in ``results``."""
+    rng = np.random.default_rng(os.getpid() ^ threading.get_ident())
+    i = 0
+    while not stop.is_set():
+        if i % 4 == 3:
+            code, body, _ = http_post(
+                f"{server.url}/v1/models/bert-decode/generate",
+                {"prompt_tokens": [list(map(int, rng.integers(1, 48, 5)))],
+                 "max_new_tokens": 3, "lane": "batch"})
+        else:
+            code, body, _ = http_post(
+                f"{server.url}/v1/models/dense/infer",
+                {"inputs": rng.normal(size=(2, 12)).astype(
+                    np.float32).tolist(), "lane": "interactive"})
+        results.append((code, body))
+        i += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--no-curl", action="store_true")
+    args = ap.parse_args(argv)
+    use_curl = not args.no_curl
+
+    server, router, np = build_server()
+    from deeplearning4j_tpu.serving import BrownoutController
+    from deeplearning4j_tpu.util import faults as fl
+    from deeplearning4j_tpu.util import slo
+    from deeplearning4j_tpu.util import telemetry as tm
+    from deeplearning4j_tpu.util.faults import get_injector
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    tele = tm.get_telemetry()
+    injector = get_injector()
+    injector.clear()  # a stray DL4J_TPU_FAULTS must not skew the checks
+    tmpdir = tempfile.mkdtemp(prefix="resilience-smoke-")
+    model, sched = router.get("dense")
+
+    def counter(name, **labels):
+        return tele.counter_total(name, **labels)
+
+    try:
+        # ------------------------------------------------ 1. reload storm
+        print(f"== reload storm: {N_RELOADS} rolling reloads under "
+              "sustained mixed traffic ==")
+        nets = [build_dense_net(seed=i) for i in range(1, N_RELOADS + 1)]
+        paths = []
+        for i, net in enumerate(nets):
+            p = os.path.join(tmpdir, f"v{i + 2}.zip")
+            ModelSerializer.write_model(net, p, save_updater=False)
+            paths.append(p)
+        stop, results = threading.Event(), []
+        threads = [threading.Thread(
+            target=traffic_loop, args=(server, np, stop, results))
+            for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # traffic flowing before the first swap
+        rec0 = counter("serving.recompiles_total", model="dense")
+        versions = []
+        for p in paths:
+            code, body, _ = http_post(
+                f"{server.url}/v1/models/dense/reload", {"path": p})
+            if code == 200:
+                versions.append(body.get("version"))
+        time.sleep(0.3)  # traffic across the last swap too
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        check(f"all {N_RELOADS} reloads accepted, versions advance",
+              versions == list(range(2, N_RELOADS + 2)), str(versions))
+        bad = [(c, b) for c, b in results if c != 200]
+        check(f"zero shed requests across the storm "
+              f"({len(results)} requests)", not bad, str(bad[:3]))
+        check("zero steady-state recompiles across the storm",
+              counter("serving.recompiles_total", model="dense") - rec0 == 0,
+              f"delta {counter('serving.recompiles_total', model='dense') - rec0}")
+        x = np.asarray([[0.1] * 12, [-0.2] * 12], np.float32)
+        code, body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                  {"inputs": x.tolist()})
+        direct = np.asarray(nets[-1].output(x))
+        check("post-storm weights bit-identical to the last archive",
+              code == 200 and np.array_equal(
+                  np.asarray(body["outputs"], np.float32),
+                  direct.astype(np.float32)))
+        code, text = http_get(f"{server.url}/v1/models", use_curl)
+        doc = json.loads(text) if code == 200 else {}
+        surfaced = doc.get("models", {}).get("dense", {}).get("version")
+        check("version surface advanced on /v1/models",
+              surfaced == N_RELOADS + 1, f"version {surfaced}")
+
+        # ------------------------------------- 2. corrupt-archive reload
+        print("== corrupt-archive rejection (reload_corrupt_archive) ==")
+        data = open(paths[-1], "rb").read()
+        trunc = os.path.join(tmpdir, "trunc.zip")
+        open(trunc, "wb").write(data[: len(data) // 2])
+        code, body, _ = http_post(
+            f"{server.url}/v1/models/dense/reload", {"path": trunc})
+        check("truncated archive answers 409 (never a 5xx)",
+              code == 409 and body.get("error") == "ModelLoadError",
+              f"code {code}, error {body.get('error')}")
+        code, body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                  {"inputs": x.tolist()})
+        check("old version keeps answering bit-identically after the 409",
+              code == 200 and np.array_equal(
+                  np.asarray(body["outputs"], np.float32),
+                  direct.astype(np.float32)))
+        check("model version unchanged by the rejected reload",
+              model.version == N_RELOADS + 1, f"version {model.version}")
+        # the injected fault: the SAME truncated-zip mechanism fired on a
+        # GOOD archive (fault kind recovery, r11 standard)
+        injector.inject(fl.RELOAD_CORRUPT_ARCHIVE)
+        code, _body, _ = http_post(
+            f"{server.url}/v1/models/dense/reload", {"path": paths[-1]})
+        check("reload_corrupt_archive fault rejects a good archive (409)",
+              code == 409, f"code {code}")
+        code, body, _ = http_post(
+            f"{server.url}/v1/models/dense/reload", {"path": paths[-1]})
+        check("fault disarmed: the same archive then reloads clean",
+              code == 200 and body.get("version") == N_RELOADS + 2,
+              f"code {code}, version {body.get('version')}")
+
+        # ------------------------------------------- 3. supervised worker
+        print("== supervised worker (serving_worker_crash) ==")
+        restarts0 = counter("serving.worker_restarts_total", model="dense")
+        injector.inject(fl.SERVING_WORKER_CRASH, count=1)
+        code, body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                  {"inputs": x.tolist()})
+        check("crashed batch's rider gets a loud 500",
+              code == 500 and "WorkerCrashedError" in str(body.get("error")),
+              f"code {code}, body {body}")
+        check("worker restart counted",
+              counter("serving.worker_restarts_total",
+                      model="dense") == restarts0 + 1)
+        code, text = http_get(
+            f"{server.url}/v1/models/dense/debug/requests?last=16", use_curl)
+        recs = json.loads(text).get("requests", []) if code == 200 else []
+        check("flight recorder carries the worker_crash cause",
+              any(r.get("status") == "error"
+                  and str(r.get("cause", "")).startswith("worker_crash")
+                  for r in recs))
+        code, body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                  {"inputs": x.tolist()})
+        check("restarted worker answers the next request 200",
+              code == 200 and np.array_equal(
+                  np.asarray(body["outputs"], np.float32),
+                  direct.astype(np.float32)))
+        check("worker health check stays OK within the restart budget",
+              tele.health_report()[1].get(
+                  "serving.worker.dense", {}).get("ok") is not False)
+
+        # --------------------------------------------- 4. circuit breaker
+        print("== circuit breaker (serving_compute_error) ==")
+        sched.breaker.consecutive_errors = 2
+        sched.breaker.cooldown_s = 1.0
+        opens0 = counter("serving.breaker_opens_total", model="dense")
+        injector.inject(fl.SERVING_COMPUTE_ERROR, count=2)
+        codes = [http_post(f"{server.url}/v1/models/dense/infer",
+                           {"inputs": x.tolist()})[0] for _ in range(2)]
+        check("injected compute errors answer 500", codes == [500, 500],
+              str(codes))
+        check("breaker opens after consecutive errors",
+              sched.breaker.state == "open"
+              and counter("serving.breaker_opens_total",
+                          model="dense") == opens0 + 1,
+              f"state {sched.breaker.state}")
+        code, body, hdrs = http_post(
+            f"{server.url}/v1/models/dense/infer", {"inputs": x.tolist()})
+        check("open breaker fast-fails 503 + Retry-After",
+              code == 503 and hdrs.get("Retry-After") is not None
+              and "CircuitOpenError" in str(body.get("error")),
+              f"code {code}, retry {hdrs.get('Retry-After')}")
+        code, text = http_get(f"{server.url}/metrics", use_curl)
+        check("/metrics carries the breaker state gauge (open=2)",
+              'dl4j_serving_breaker_state{model="dense"} 2' in text)
+        time.sleep(1.2)  # cooldown -> half-open probe admitted
+        code, body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                  {"inputs": x.tolist()})
+        check("half-open probe succeeds (200)", code == 200)
+        deadline = time.time() + 10
+        while sched.breaker.state != "closed" and time.time() < deadline:
+            time.sleep(0.05)
+        check("probe success closes the breaker",
+              sched.breaker.state == "closed",
+              f"state {sched.breaker.state}")
+
+        # ------------------------------------------------- 5. slow batch
+        print("== slow batch (serving_slow_batch) ==")
+        shed0 = counter("serving.shed_total", model="dense",
+                        reason="deadline", lane="interactive")
+        injector.inject(fl.SERVING_SLOW_BATCH, arg=500.0)
+        slow_result = {}
+
+        def slow_req():
+            slow_result["r"] = http_post(
+                f"{server.url}/v1/models/dense/infer",
+                {"inputs": x.tolist()})
+
+        t = threading.Thread(target=slow_req)
+        t.start()
+        time.sleep(0.15)  # the stalled batch is open on the worker
+        code, _body, hdrs = http_post(
+            f"{server.url}/v1/models/dense/infer",
+            {"inputs": x.tolist(), "deadline_ms": 100})
+        t.join(timeout=30)
+        check("deadline expires behind the stalled batch -> 429",
+              code == 429 and hdrs.get("Retry-After") is not None,
+              f"code {code}")
+        check("deadline shed counted",
+              counter("serving.shed_total", model="dense",
+                      reason="deadline", lane="interactive") > shed0)
+        check("the stalled batch itself completes 200 (slow, not broken)",
+              slow_result.get("r", (0,))[0] == 200)
+
+        # --------------------------------------------------- 6. brownout
+        print("== brownout (SLO budget exhaustion) ==")
+        ctrl = BrownoutController(router).install()
+        slo.register(slo.SloObjective(
+            "smoke-brownout", "availability", target=0.999,
+            model="synthetic-resilience", windows=(3.0,)))
+        tm.counter("serving.completed_total", 1,
+                   model="synthetic-resilience", lane="interactive")
+        slo.get_engine().evaluate()
+        tm.counter("serving.shed_total", 9, model="synthetic-resilience",
+                   reason="deadline", lane="interactive")
+        slo.get_engine().evaluate()
+        check("budget exhaustion activates the brownout", ctrl.active)
+        code, _body, _ = http_post(
+            f"{server.url}/v1/models/bert-decode/generate",
+            {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 2,
+             "lane": "batch"})
+        check("batch lane sheds 429 during brownout", code == 429,
+              f"code {code}")
+        code, _body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                   {"inputs": x.tolist(),
+                                    "lane": "interactive"})
+        check("interactive lane keeps serving during brownout", code == 200,
+              f"code {code}")
+        deadline = time.time() + 30
+        while ctrl.active and time.time() < deadline:
+            time.sleep(0.25)  # bad traffic ages out of the 3s window
+            slo.get_engine().evaluate()
+        check("budget recovery ends the brownout", not ctrl.active)
+        code, _body, _ = http_post(
+            f"{server.url}/v1/models/bert-decode/generate",
+            {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 2,
+             "lane": "batch"})
+        check("batch lane restored after recovery", code == 200,
+              f"code {code}")
+        ctrl.uninstall()  # detach from the process SLO engine
+        slo.reset()
+
+        # --------------------------------------------------------- drain
+        print("== graceful drain ==")
+        server.request_drain()
+        check("server drains clean after the chaos",
+              server.wait_drained(timeout=60))
+        code, _body, _ = http_post(f"{server.url}/v1/models/dense/infer",
+                                   {"inputs": x.tolist()})
+        check("post-drain request answers 503", code == 503, f"code {code}")
+    finally:
+        injector.clear()
+        server.stop()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    if _FAILED:
+        print(f"RESILIENCE SMOKE FAIL: {len(_FAILED)} checks failed: "
+              f"{_FAILED}")
+        return 1
+    print("resilience smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

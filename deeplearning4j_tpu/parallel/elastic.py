@@ -370,6 +370,89 @@ class FileMembership:
         }
 
 
+# --------------------------------------------------------------- publisher
+class _ArchivePublisher:
+    """Single background writer for the train→serve publish seam
+    (docs/SERVING.md#resilience): the training thread drops a same-step
+    host-array ``ModelSerializer.snapshot`` and returns to stepping; this
+    thread pays the DEFLATE + atomic replace. ONE pending slot, latest
+    wins — a disk slower than the checkpoint cadence collapses
+    intermediate publishes instead of queueing behind them (the watcher
+    only ever wants the newest weights anyway)."""
+
+    def __init__(self, path: str, log_fn=None):
+        self.path = path
+        self.log = log_fn
+        self._cv = threading.Condition()
+        self._pending = None  # (snapshot, step) | None
+        self._busy = False
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="elastic-publish")
+        self._thread.start()
+
+    def publish(self, snap: dict, step: int):
+        with self._cv:
+            self._pending = (snap, step)
+            self._cv.notify_all()
+
+    def _loop(self):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stop:
+                    self._cv.wait(timeout=0.2)
+                if self._pending is None:
+                    return  # stopped with nothing left to write
+                (snap, step), self._pending = self._pending, None
+                self._busy = True
+            try:
+                with tm.span("elastic.publish", step=step):
+                    ModelSerializer.write_snapshot(snap, self.path)
+                tm.counter("elastic.publishes_total")
+                tm.gauge("elastic.last_publish_step", step)
+            except Exception as e:  # noqa: BLE001 — serving seam
+                tm.counter("elastic.publish_errors_total")
+                if self.log:
+                    self.log(f"ELASTIC publish to {self.path} failed at "
+                             f"step {step}: {e!r}")
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Block until everything handed to :meth:`publish` is on disk —
+        fit() calls this before returning so the FINAL weights' archive is
+        durable when training ends."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._pending is not None or self._busy) \
+                    and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.1)
+            return self._pending is None and not self._busy
+
+    def stop(self, timeout: float = 60.0):
+        """Flush, then end the writer thread. Each fit() tears its
+        publisher down (and lazily recreates on the next publish) so a
+        process that builds trainers repeatedly does not accumulate idle
+        publisher threads. A flush that times out is LOUD — the
+        "final weights durable when fit() returns" contract just broke,
+        and the watcher would otherwise serve stale weights with zero
+        signal."""
+        if not self.flush(timeout=timeout):
+            tm.counter("elastic.publish_flush_timeouts_total")
+            if self.log:
+                self.log(f"ELASTIC publish flush timed out after "
+                         f"{timeout}s — the final archive at {self.path} "
+                         "may be stale")
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+
+
 # ----------------------------------------------------------------- trainer
 _ACTIVE: "weakref.WeakValueDictionary[int, ElasticTrainer]" = \
     weakref.WeakValueDictionary()
@@ -406,6 +489,7 @@ class ElasticTrainer:
                  max_rollbacks: int = 3, async_checkpoint: bool = True,
                  initial_checkpoint: bool = True,
                  retry: Optional[RetryPolicy] = None,
+                 publish_archive: Optional[str] = None,
                  drain_signals=(signal.SIGTERM,), log_fn=print):
         global _ACTIVE_SEQ
         from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
@@ -430,6 +514,26 @@ class ElasticTrainer:
         #: before the first anomaly can hit; False skips it (a startup-cost
         #: escape hatch when rollback protection is not wanted)
         self.initial_checkpoint = initial_checkpoint
+        #: train→serve seam (docs/SERVING.md#resilience): every checkpoint
+        #: cadence ALSO publishes a ModelSerializer archive here (atomic
+        #: tmp+os.replace — a watching ModelRouter.watch() poller reloads
+        #: it under live traffic, never reading a torn file). The training
+        #: thread captures a same-step HOST snapshot right at the
+        #: checkpoint point (the device→host copy is mandatory — the next
+        #: step donates the param buffers, the checkpointer's
+        #: _host_snapshot rule); a background publisher thread pays the
+        #: DEFLATE + write, so the step loop never stalls on compression
+        #: (latest-wins: a slow disk collapses intermediate publishes
+        #: instead of queueing behind them).
+        self.publish_archive = publish_archive
+        self._publisher: Optional[_ArchivePublisher] = None
+        if self.publish_archive is not None:
+            # commit correlation for the serving watcher's trace: one
+            # instant per durable checkpoint commit (async commits fire
+            # this from the background committer)
+            self.ckpt.add_commit_hook(
+                lambda step: tm.instant("elastic.commit", step=step,
+                                        publish=str(self.publish_archive)))
         self.drain_signals = tuple(drain_signals)
         self.log = log_fn
         if monitor is None and rollback_on_anomaly:
@@ -510,7 +614,32 @@ class ElasticTrainer:
         }
         self.ckpt.save(self.net.iteration, self.net, extra_meta=meta,
                        block=block or not self.async_checkpoint)
+        if self.publish_archive is not None:
+            self._publish()
         self._steps_since_ckpt = 0
+
+    def _publish(self):
+        """Hand this checkpoint's weights to the background publisher: the
+        HOST snapshot is captured HERE on the training thread so archive
+        and checkpoint carry the same step (and so no device ref outlives
+        the next step's donation); the DEFLATE + atomic write happen on
+        the publisher thread. A publish failure is loud but must not kill
+        training — the checkpoint itself already committed; the watcher
+        simply keeps serving the previous version."""
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        try:
+            snap = ModelSerializer.snapshot(self.net)
+        except Exception as e:  # noqa: BLE001 — serving seam, not training
+            tm.counter("elastic.publish_errors_total")
+            if self.log:
+                self.log(f"ELASTIC publish snapshot failed at step "
+                         f"{self.net.iteration}: {e!r}")
+            return
+        if self._publisher is None:
+            self._publisher = _ArchivePublisher(self.publish_archive,
+                                                log_fn=self.log)
+        self._publisher.publish(snap, self.net.iteration)
 
     def _resume(self) -> Optional[int]:
         step = self.ckpt.restore_latest_good(self.net)
@@ -666,6 +795,16 @@ class ElasticTrainer:
                 self.ckpt.wait_until_finished()
             except Exception:  # noqa: BLE001 — don't mask the real error
                 pass
+            if self._publisher is not None:
+                # the final weights' archive must be durable when fit()
+                # returns (the watcher's "follows training" contract);
+                # stop() also ends the writer thread — the next fit()
+                # lazily recreates it
+                try:
+                    self._publisher.stop()
+                except Exception:  # noqa: BLE001 — don't mask the error
+                    pass
+                self._publisher = None
             if installed_monitor and self.monitor in net.listeners:
                 net.listeners.remove(self.monitor)
 
@@ -709,6 +848,7 @@ class ElasticTrainer:
             "rollbacks": self.rollbacks,
             "resumed_from": self.resumed_from,
             "drained": self.drained,
+            "publish_archive": self.publish_archive,
         }
         comp = getattr(self.wrapper, "_compressor", None) \
             if self.wrapper is not None else None

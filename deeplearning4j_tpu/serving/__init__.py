@@ -20,6 +20,14 @@ ZERO XLA compiles.
 
 from deeplearning4j_tpu.serving.generate import Generator
 from deeplearning4j_tpu.serving.model import ServingModel
+from deeplearning4j_tpu.serving.resilience import (BrownoutController,
+                                                   BrownoutShedError,
+                                                   CircuitBreaker,
+                                                   CircuitOpenError,
+                                                   ModelLoadError,
+                                                   ReloadRejectedError,
+                                                   SchedulerStoppedError,
+                                                   WorkerCrashedError)
 from deeplearning4j_tpu.serving.router import (ModelRouter,
                                                UnknownModelError,
                                                current_status)
@@ -35,16 +43,24 @@ from deeplearning4j_tpu.serving.server import ModelServer
 
 __all__ = [
     "BatchScheduler",
+    "BrownoutController",
+    "BrownoutShedError",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "DeadlineExceededError",
     "FlightRecorder",
     "Generator",
+    "ModelLoadError",
     "ModelRouter",
     "ModelServer",
     "QueueFullError",
+    "ReloadRejectedError",
     "SchedulerDrainingError",
+    "SchedulerStoppedError",
     "ServingModel",
     "ShedError",
     "UnknownModelError",
+    "WorkerCrashedError",
     "current_status",
     "new_request_id",
     "trace_sample_rate",

@@ -249,6 +249,20 @@ class Generator:
         return self._trim(stacked, len(prompts), lens, max_new_tokens,
                           eos_id)
 
+    # -------------------------------------------------------------- health
+    def health_probe(self) -> bool:
+        """Finite-logits canary for the reload pipeline
+        (docs/SERVING.md#resilience): one tiny prompt through the prefill
+        executable; True iff every logit is finite. Runs at an
+        already-warmed (smallest-bucket) signature, so on a warmed
+        generator it never traces."""
+        b = int(self.policy.bucket_batch(1))
+        t = self._prefill_len(1)
+        tokens = jnp.ones((b, t), jnp.int32)
+        lengths = jnp.ones((b,), jnp.int32)
+        logits, _ = self._prefill_jit(self.net.params, tokens, lengths)
+        return bool(np.isfinite(np.asarray(logits)).all())
+
     # -------------------------------------------------------------- warmup
     def warmup(self, batch_sizes=None, prompt_lengths=None) -> int:
         """Pre-trace every (batch bucket × prefill bucket) prefill and every

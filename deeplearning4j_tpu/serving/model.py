@@ -29,12 +29,14 @@ zero contract the CI smoke asserts.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.util import faults as fl
 from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import get_watcher
 
@@ -55,6 +57,16 @@ class ServingModel:
         self.model_id = str(model_id)
         self.kind = kind
         self.export_dir = export_dir
+        self._max_length = max_length
+        self._use_mesh = bool(use_mesh)
+        #: rolling-reload version surface (docs/SERVING.md#resilience):
+        #: starts at 1, bumps on every successful swap_from()
+        self.version = 1
+        self.reload_time: Optional[float] = None
+        # execute() holds this for each batch; a rolling reload's swap takes
+        # it too, so the swap lands BETWEEN batch cycles — the in-flight
+        # batch finishes on the old weights, the next one runs the new
+        self._swap_lock = threading.Lock()
         if isinstance(bucketing, str):
             bucketing = BucketingPolicy.from_spec(bucketing)
         if bucketing is None:
@@ -128,28 +140,52 @@ class ServingModel:
         return primed
 
     # ------------------------------------------------------------- execute
-    def execute(self, payloads: List[Any], _trace: bool = False, **opts
+    def execute(self, payloads: List[Any], _trace: bool = False,
+                _step: Optional[int] = None, **opts
                 ) -> Tuple[List[Any], Dict[str, Any]]:
         """Run one coalesced batch; returns (per-payload results, stats).
         stats: real/padded row counts and the number of XLA traces this
         batch caused (0 in steady state); generate batches add
         ``decode_tokens``/``decode_seconds`` for per-request tokens/sec.
         ``_trace`` (set by the scheduler for head-sampled batches) emits
-        the batch-level pad/device/decode phase spans."""
+        the batch-level pad/device/decode phase spans; ``_step`` is the
+        scheduler's batch-cycle number — the serving faults' ``@nth``
+        concept (util/faults.py). The faults fire ONLY on scheduler
+        batches (``_step`` set): a reload's canary and direct execute()
+        calls run with ``_step=None``, and letting them consume a stepless
+        armed fault would reject a good reload while the fault's
+        documented target — the live worker — never saw it."""
+        if _step is not None:
+            injector = fl.get_injector()
+            fault = injector.fire(fl.SERVING_SLOW_BATCH, step=_step)
+            if fault is not None:
+                # a real stall on the real worker thread: queued requests
+                # behind it age toward their deadlines exactly as they
+                # would behind a wedged device
+                time.sleep((fault.arg or 50.0) / 1e3)
+            if injector.fire(fl.SERVING_COMPUTE_ERROR,
+                             step=_step) is not None:
+                raise RuntimeError(
+                    f"{self.model_id}: injected serving compute error "
+                    f"(batch {_step})")
         watcher = get_watcher()
-        traces_before = watcher.total_traces()
-        stats: Dict[str, Any] = {}
-        if self.kind == "generate":
-            results, real, padded = self._execute_generate(
-                payloads, _trace=_trace, _stats=stats, **opts)
-        else:
-            results, real, padded = self._execute_classify(
-                payloads, _trace=_trace, **opts)
-        stats.update({
-            "real_rows": real,
-            "padded_rows": padded,
-            "recompiles": watcher.total_traces() - traces_before,
-        })
+        with self._swap_lock:
+            # traces are counted per-THREAD: a concurrent reload warming
+            # its shadow model on another thread must not read as this
+            # batch having recompiled (docs/SERVING.md#resilience)
+            traces_before = watcher.thread_traces()
+            stats: Dict[str, Any] = {}
+            if self.kind == "generate":
+                results, real, padded = self._execute_generate(
+                    payloads, _trace=_trace, _stats=stats, **opts)
+            else:
+                results, real, padded = self._execute_classify(
+                    payloads, _trace=_trace, **opts)
+            stats.update({
+                "real_rows": real,
+                "padded_rows": padded,
+                "recompiles": watcher.thread_traces() - traces_before,
+            })
         return results, stats
 
     def _emit(self, name: str, t0_ns: int, **args):
@@ -227,12 +263,88 @@ class ServingModel:
         padded = self.policy.bucket_batch(real)
         return tokens, real, padded
 
+    # ----------------------------------------------------- rolling reload
+    def clone_with_net(self, net) -> "ServingModel":
+        """A SHADOW ServingModel around ``net`` with this model's exact
+        serving configuration (kind, bucket policy, export dir, mesh) —
+        the reload pipeline warms and canary-validates it without touching
+        the live model's caches (docs/SERVING.md#resilience)."""
+        return ServingModel(net, self.model_id, kind=self.kind,
+                            bucketing=self.policy,
+                            use_mesh=self._use_mesh,
+                            export_dir=self.export_dir,
+                            max_length=self._max_length)
+
+    def structure_matches(self, net) -> bool:
+        """Whether ``net``'s parameter tree is swap-compatible with the
+        live one (same treedef, same leaf shapes) — a reload that changes
+        topology must go through a fresh ``register()``, not a swap."""
+        import jax
+
+        live = jax.tree_util.tree_leaves(self.net.params)
+        new = jax.tree_util.tree_leaves(net.params)
+        if (jax.tree_util.tree_structure(self.net.params)
+                != jax.tree_util.tree_structure(net.params)):
+            return False
+        return all(np.shape(a) == np.shape(b) for a, b in zip(live, new))
+
+    def canary_check(self, payload=None) -> Tuple[bool, str]:
+        """Run one canary batch through THIS model (a warmed shadow during
+        reload) and decide whether the weights are servable: the forward
+        must complete and produce finite values. Corrupt or NaN-producing
+        weights fail here and never reach traffic. Returns (ok, detail)."""
+        try:
+            if self.kind == "generate":
+                if not self.generator.health_probe():
+                    return False, "non-finite prefill logits"
+                toks, _ = self.execute(
+                    [np.asarray([1, 2, 3], np.int32)]
+                    if payload is None else [payload], max_new_tokens=2)
+                if not toks or not toks[0]:
+                    return False, "canary decode produced no tokens"
+            else:
+                if payload is None:
+                    conf = getattr(self.net, "conf", None)
+                    shape = tuple(getattr(conf, "input_shape", None) or ())
+                    if not shape:
+                        return False, "no canary payload and no input_shape"
+                    payload = np.zeros((1,) + shape, np.float32)
+                out, _ = self.execute([payload])
+                arr = np.asarray(out[0])
+                if not np.all(np.isfinite(arr)):
+                    bad = int(arr.size - np.isfinite(arr).sum())
+                    return False, (f"canary output has {bad} non-finite "
+                                   f"value(s) of {arr.size}")
+        except Exception as e:  # noqa: BLE001 — canary verdict, not a crash
+            return False, f"canary raised {type(e).__name__}: {e}"
+        return True, ""
+
+    def swap_from(self, shadow: "ServingModel") -> int:
+        """Atomically adopt the shadow's (warmed, canary-validated) net and
+        executors. Taken under the same lock ``execute`` holds, so the swap
+        lands between batch cycles: zero shed requests, and — because the
+        shadow warmed every bucket signature on its own thread — zero
+        steady-state recompiles after it. Returns the new version."""
+        with self._swap_lock:
+            self.net = shadow.net
+            self.generator = shadow.generator
+            self.inference = shadow.inference
+            self.policy = shadow.policy
+            self.warmed = shadow.warmed
+            self.version += 1
+            self.reload_time = time.time()
+        tm.gauge("serving.model_version", self.version, model=self.model_id)
+        return self.version
+
     def describe(self) -> dict:
         return {
             "kind": self.kind,
             "buckets": self.policy.to_spec(),
             "coalesce_limit": self.coalesce_limit(),
             "warmed": self.warmed,
+            "version": self.version,
+            "reload_time": self.reload_time,
+            "iteration": int(getattr(self.net, "iteration", 0) or 0),
             "mesh": self.inference is not None,
             "params": int(self.net.num_params())
             if hasattr(self.net, "num_params") else None,

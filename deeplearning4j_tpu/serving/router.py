@@ -21,13 +21,19 @@ elastic runtime uses).
 
 from __future__ import annotations
 
+import os
 import threading
 import weakref
 from typing import Dict, Optional, Tuple
 
 from deeplearning4j_tpu.serving.model import ServingModel
+from deeplearning4j_tpu.serving.resilience import (BREAKER_STATES,  # noqa: F401 — re-export
+                                                   ModelLoadError,
+                                                   ReloadRejectedError)
 from deeplearning4j_tpu.serving.scheduler import BatchScheduler
+from deeplearning4j_tpu.util import faults as fl
 from deeplearning4j_tpu.util import telemetry as tm
+from deeplearning4j_tpu.util.health import record_anomaly
 
 _ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
 
@@ -46,36 +52,228 @@ class ModelRouter:
         self._lock = threading.Lock()
         self._models: Dict[str, Tuple[ServingModel, BatchScheduler]] = {}
         self.draining = False
+        self._reload_locks: Dict[str, threading.Lock] = {}
+        self._watchers: Dict[str, Tuple[threading.Thread,
+                                        threading.Event]] = {}
         _ROUTERS.add(self)
 
     # ------------------------------------------------------------ registry
     def register(self, model: ServingModel, *, max_wait_ms: float = 2.0,
                  max_batch: Optional[int] = None, queue_limit: int = 64,
-                 start: bool = True) -> BatchScheduler:
+                 start: bool = True, **sched_kw) -> BatchScheduler:
         """Attach a model under its ``model_id`` with its own scheduler
-        (per-model admission control via ``queue_limit``)."""
+        (per-model admission control via ``queue_limit``; ``sched_kw``
+        passes resilience knobs — ``breaker``, ``max_restarts``,
+        ``supervised`` — through to :class:`BatchScheduler`)."""
         sched = BatchScheduler(model, max_wait_ms=max_wait_ms,
-                               max_batch=max_batch, queue_limit=queue_limit)
+                               max_batch=max_batch, queue_limit=queue_limit,
+                               **sched_kw)
         with self._lock:
             if model.model_id in self._models:
                 raise ValueError(f"model {model.model_id!r} already "
                                  "registered")
             self._models[model.model_id] = (model, sched)
+            self._reload_locks[model.model_id] = threading.Lock()
         tm.counter("serving.models_registered_total")
         if start:
             sched.start()
         return sched
 
+    @staticmethod
+    def _restore_archive(path: str, what: str):
+        """Restore a ModelSerializer archive, wrapping every failure mode —
+        missing file, truncated/corrupt zip, structure mismatch inside the
+        archive — in ONE clean :class:`ModelLoadError` (the
+        ``restore_latest_good`` corrupt-skip convention from
+        util/checkpoint.py: a bad archive is a loud, typed error, never a
+        half-initialized model)."""
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        try:
+            return ModelSerializer.restore_model(path, load_updater=False)
+        except ModelLoadError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one typed error out
+            raise ModelLoadError(
+                f"{what}: archive {path!r} failed to load "
+                f"({type(e).__name__}: {e})") from e
+
     def load(self, model_id: str, path: str, *, kind: str = "classify",
              **model_kw) -> BatchScheduler:
         """Restore a ModelSerializer archive and register it. ``model_kw``
         passes through to :class:`ServingModel` (bucketing, export_dir,
-        use_mesh, …)."""
-        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
-
-        net = ModelSerializer.restore_model(path, load_updater=False)
+        use_mesh, …). A corrupt/truncated archive raises
+        :class:`ModelLoadError` WITHOUT registering anything — the
+        registry never holds a partially-loaded model."""
+        net = self._restore_archive(path, f"load {model_id!r}")
         model = ServingModel(net, model_id, kind=kind, **model_kw)
         return self.register(model)
+
+    # ------------------------------------------------------ rolling reload
+    def _reject_reload(self, model_id: str, reason: str, detail: str):
+        tm.counter("serving.reload_rejected_total", model=model_id,
+                   reason=reason)
+        record_anomaly("reload_rejected",
+                       f"{model_id}: {reason}: {detail}"[:300],
+                       source="serving", model=model_id)
+
+    def reload(self, model_id: str, path: str, *, canary=None) -> int:
+        """Rolling weight reload (docs/SERVING.md#resilience): load ``path``
+        into a SHADOW model, warm every bucket signature on shadow state
+        (the live model's caches are untouched), validate the new weights
+        with a canary batch, then atomically swap between batch cycles —
+        zero shed requests, zero steady-state recompiles, and the model's
+        ``version`` advances. A corrupt archive (:class:`ModelLoadError`),
+        a topology change, a warmup failure, or a NaN-producing canary
+        (:class:`ReloadRejectedError`) leaves the OLD version serving,
+        untouched. Returns the new version."""
+        model, _sched = self.get(model_id)
+        # register() created this lock with the model: a missing entry is a
+        # broken invariant that must fail loudly, not silently hand each
+        # caller a private lock (= no reload serialization at all)
+        rlock = self._reload_locks[model_id]
+        with rlock, tm.span("serving.reload", model=model_id):
+            read_path, cleanup = path, None
+            fault = fl.get_injector().fire(fl.RELOAD_CORRUPT_ARCHIVE)
+            if fault is not None:
+                # REAL mechanism: restore reads actually-truncated bytes —
+                # the zip machinery fails exactly as it would on a torn
+                # publish or a bad disk
+                read_path = cleanup = self._truncated_copy(path, fault.arg)
+            try:
+                try:
+                    new_net = self._restore_archive(
+                        read_path, f"reload {model_id!r}")
+                except ModelLoadError as e:
+                    self._reject_reload(model_id, "load_error", str(e))
+                    raise
+            finally:
+                if cleanup is not None:
+                    try:
+                        os.unlink(cleanup)
+                    except OSError:
+                        pass
+            if not model.structure_matches(new_net):
+                self._reject_reload(model_id, "structure_mismatch",
+                                    "parameter tree differs from the live "
+                                    "model — register a new model id")
+                raise ReloadRejectedError(
+                    f"reload {model_id!r}: archive {path!r} holds a "
+                    "different topology; the live version keeps serving")
+            try:
+                shadow = model.clone_with_net(new_net)
+                shadow.warmup()
+            except Exception as e:  # noqa: BLE001 — reload must not crash
+                self._reject_reload(model_id, "warmup_error", repr(e))
+                raise ReloadRejectedError(
+                    f"reload {model_id!r}: shadow warmup failed "
+                    f"({type(e).__name__}: {e}); the live version keeps "
+                    "serving") from e
+            ok, detail = shadow.canary_check(canary)
+            if not ok:
+                self._reject_reload(model_id, "canary", detail)
+                raise ReloadRejectedError(
+                    f"reload {model_id!r}: canary rejected the new weights "
+                    f"({detail}); the live version keeps serving")
+            version = model.swap_from(shadow)
+            tm.counter("serving.reloads_total", model=model_id)
+            tm.instant("serving.reload_swap", model=model_id,
+                       version=version, path=str(path))
+            tm.set_health(f"serving.reload.{model_id}", True,
+                          f"serving v{version} from {path}")
+            return version
+
+    @staticmethod
+    def _truncated_copy(path: str, frac: Optional[float]) -> str:
+        """The reload_corrupt_archive fault's mechanism: a copy of the
+        archive truncated to ``frac`` (default 0.5) of its bytes."""
+        with open(path, "rb") as f:
+            data = f.read()
+        cut = max(1, int(len(data) * (frac if frac else 0.5)))
+        out = f"{path}.corrupt-{os.getpid()}"
+        with open(out, "wb") as f:
+            f.write(data[:cut])
+        return out
+
+    def watch(self, model_id: str, path: str,
+              interval_s: float = 1.0) -> threading.Event:
+        """Follow a published archive: a daemon poller reloads ``model_id``
+        whenever ``path``'s (mtime, size) signature changes — the
+        train-and-serve seam (parallel/elastic.py ``publish_archive``
+        commits atomically via os.replace, so the poller never reads a
+        torn file). A rejected reload is remembered by signature and not
+        retried until the publisher commits again. Returns the stop event
+        (also stopped by :meth:`unwatch` / :meth:`shutdown`)."""
+        self.get(model_id)  # UnknownModelError before starting a thread
+        stop = threading.Event()
+
+        def _sig():
+            try:
+                st = os.stat(path)
+                return (st.st_mtime_ns, st.st_size)
+            except OSError:
+                return None
+
+        last_sig = _sig()  # reload only NEW commits, not the current file
+
+        def _poll():
+            nonlocal last_sig
+            while not stop.wait(interval_s):
+                sig = _sig()
+                if sig is None or sig == last_sig:
+                    continue
+                try:
+                    v = self.reload(model_id, path)
+                    last_sig = sig
+                    tm.counter("serving.watch_reloads_total",
+                               model=model_id)
+                    tm.instant("serving.watch_reload", model=model_id,
+                               version=v)
+                except (ModelLoadError, ReloadRejectedError,
+                        UnknownModelError):
+                    # already counted/anomaly-recorded by reload(); the
+                    # old version keeps serving and this signature is
+                    # remembered so a bad publish is not retry-spun
+                    last_sig = sig
+                    continue
+                except Exception as e:  # noqa: BLE001 — poller survives
+                    # an UNTYPED failure (transient fs error, OOM during
+                    # shadow warmup) must be loud AND retried: the
+                    # signature stays unconsumed so the next poll tries
+                    # the same publish again instead of silently never
+                    # serving good weights
+                    tm.counter("serving.watch_errors_total",
+                               model=model_id)
+                    record_anomaly("watch_reload_error",
+                                   f"{model_id}: {e!r}"[:200],
+                                   source="serving", model=model_id)
+                    continue
+
+        t = threading.Thread(target=_poll, daemon=True,
+                             name=f"serving-watch-{model_id}")
+        with self._lock:
+            old = self._watchers.pop(model_id, None)
+            self._watchers[model_id] = (t, stop)
+        if old is not None:
+            old[1].set()
+        t.start()
+        return stop
+
+    def unwatch(self, model_id: str) -> bool:
+        with self._lock:
+            entry = self._watchers.pop(model_id, None)
+        if entry is None:
+            return False
+        entry[1].set()
+        entry[0].join(timeout=30.0)
+        return True
+
+    def set_brownout(self, lanes=()):
+        """Fan a brownout (resilience.BrownoutController) out to every
+        model's scheduler; ``()`` restores full service."""
+        for model_id in self.model_ids():
+            _m, sched = self.get(model_id)
+            sched.set_brownout(lanes)
 
     def get(self, model_id: str) -> Tuple[ServingModel, BatchScheduler]:
         with self._lock:
@@ -117,10 +315,22 @@ class ModelRouter:
         return primed
 
     # ----------------------------------------------------------- lifecycle
+    def _stop_watchers(self):
+        with self._lock:
+            watchers, self._watchers = dict(self._watchers), {}
+        for _t, stop in watchers.values():
+            stop.set()
+        for t, _stop in watchers.values():
+            # join so a reload in flight (shadow warmup is real XLA work)
+            # finishes before teardown — a daemon thread dying mid-compile
+            # aborts the process at interpreter exit
+            t.join(timeout=30.0)
+
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful drain across every model (the SIGTERM path): stop
         admission everywhere, finish queued work, stop workers."""
         self.draining = True
+        self._stop_watchers()
         ok = True
         for model_id in self.model_ids():
             _m, sched = self.get(model_id)
@@ -132,6 +342,7 @@ class ModelRouter:
 
     def shutdown(self):
         self.draining = True
+        self._stop_watchers()
         for model_id in self.model_ids():
             _m, sched = self.get(model_id)
             sched.shutdown()
@@ -177,6 +388,16 @@ def collect_metrics() -> list:
             rows.append(("serving.qps_10s", labels, float(sched.qps())))
             rows.append(("serving.flight_recorder_depth", labels,
                          float(len(sched.flight))))
+            # resilience surfaces (docs/SERVING.md#resilience): breaker
+            # state (0 closed / 1 half-open / 2 open), reload version,
+            # watchdog restarts — fresh at every scrape
+            if sched.breaker is not None:
+                rows.append(("serving.breaker_state", labels,
+                             float(sched.breaker.state_value())))
+            rows.append(("serving.model_version", labels,
+                         float(_m.version)))
+            rows.append(("serving.worker_restarts", labels,
+                         float(sched._restarts)))
             for q, name in ((0.5, "serving.latency_p50_seconds"),
                             (0.99, "serving.latency_p99_seconds")):
                 v = sched.latencies.quantile(q)

@@ -59,9 +59,26 @@ import weakref
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.serving.resilience import (BrownoutShedError,
+                                                   CircuitBreaker,
+                                                   CircuitOpenError,
+                                                   DeadlineExceededError,
+                                                   QueueFullError,
+                                                   SchedulerDrainingError,
+                                                   SchedulerStoppedError,
+                                                   ShedError,
+                                                   WorkerCrashedError)
+from deeplearning4j_tpu.util import faults as fl
 from deeplearning4j_tpu.util import telemetry as tm
+from deeplearning4j_tpu.util.faults import RetryPolicy
+from deeplearning4j_tpu.util.health import record_anomaly
 
 LANES = ("interactive", "batch")  # priority order, first drains first
+
+#: default watchdog backoff between worker restarts (serving workers are
+#: cheap to restart; the deadline bounds a crash-looping model's thrash)
+WORKER_RESTART_POLICY = RetryPolicy(max_attempts=8, base_delay=0.05,
+                                    max_delay=2.0, jitter=0.25)
 
 #: head-sampling keep fraction when DL4J_TPU_TRACE_SAMPLE is unset: 2% of
 #: healthy requests get full phase spans; slow/shed/error requests are
@@ -156,25 +173,12 @@ class FlightRecorder:
             return len(self._buf)
 
 
-class ShedError(RuntimeError):
-    """Request rejected by load shedding (HTTP 429 + Retry-After)."""
-
-    http_status = 429
-    retry_after_s = 1.0
-
-
-class QueueFullError(ShedError):
-    """Admission control: the model's queue is at capacity."""
-
-
-class DeadlineExceededError(ShedError):
-    """The request's queueing deadline expired before execution started."""
-
-
-class SchedulerDrainingError(ShedError):
-    """The scheduler is draining (SIGTERM) — no new work accepted."""
-
-    http_status = 503
+# the shed-error hierarchy lives in serving/resilience.py (ISSUE 13) and is
+# re-exported here so every pre-existing `from ...scheduler import ShedError`
+# import path keeps working
+__all_errors__ = (ShedError, QueueFullError, DeadlineExceededError,
+                  SchedulerDrainingError, SchedulerStoppedError,
+                  CircuitOpenError, BrownoutShedError, WorkerCrashedError)
 
 
 @dataclasses.dataclass
@@ -239,13 +243,38 @@ class BatchScheduler:
 
     def __init__(self, model, *, max_wait_ms: float = 2.0,
                  max_batch: Optional[int] = None, queue_limit: int = 64,
-                 lanes=LANES, flight_capacity: int = 256):
+                 lanes=LANES, flight_capacity: int = 256,
+                 breaker="default", max_restarts: int = 3,
+                 restart_policy: Optional[RetryPolicy] = None,
+                 restart_reset_batches: int = 100,
+                 supervised: bool = True):
         self.model = model
         self.model_id = model.model_id
         self.max_wait_ms = float(max_wait_ms)
         self.max_batch = int(max_batch or model.coalesce_limit())
         self.queue_limit = int(queue_limit)
         self.lanes = tuple(lanes)
+        #: per-model circuit breaker (serving/resilience.py); pass
+        #: ``breaker=None`` to disable, or a configured CircuitBreaker
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(model_id=self.model_id)
+            if breaker == "default" else breaker)
+        #: watchdog budget: worker restarts before the scheduler is declared
+        #: dead (health check flips, queued futures fail loudly). The budget
+        #: bounds a CRASH LOOP, not lifetime crashes: after
+        #: ``restart_reset_batches`` clean batches since the last crash the
+        #: spent budget resets — a rare transient (one device OOM a day)
+        #: must not accumulate over weeks into a permanent 503
+        self.max_restarts = int(max_restarts)
+        self.restart_policy = restart_policy or WORKER_RESTART_POLICY
+        self.restart_reset_batches = int(restart_reset_batches)
+        self.supervised = bool(supervised)
+        self._restarts = 0
+        self._batches_since_crash = 0
+        self._worker_dead = False
+        self._batch_seq = 0            # batch-cycle counter (fault @step)
+        self._current_batch: Optional[List[_Request]] = None
+        self._brownout_lanes: frozenset = frozenset()
         self._queues: Dict[str, collections.deque] = {
             lane: collections.deque() for lane in self.lanes}
         self._cv = threading.Condition()
@@ -380,10 +409,33 @@ class BatchScheduler:
             sampled=rate > 0.0 and (rate >= 1.0 or random.random() < rate),
             t_submit_ns=time.time_ns())
         with self._cv:
+            if self._worker_dead:
+                # fail fast: the worker crashed past its restart budget (or
+                # the scheduler was shut down) — enqueueing here would park
+                # the future on a queue nothing will ever drain
+                self._count_shed(req, "worker_dead")
+                why = (f"worker crashed {self._restarts}x "
+                       f"(budget {self.max_restarts})" if self._restarts
+                       else "scheduler stopped")
+                raise SchedulerStoppedError(f"{self.model_id}: {why} — "
+                                            "no worker will run this request")
             if not self._accepting:
                 self._count_shed(req, "draining")
                 raise SchedulerDrainingError(
                     f"{self.model_id}: scheduler draining")
+            if lane in self._brownout_lanes:
+                # SLO budget exhausted (resilience.BrownoutController):
+                # bulk lanes shed so the interactive promise survives
+                self._count_shed(req, "brownout")
+                raise BrownoutShedError(
+                    f"{self.model_id}: lane {lane!r} browned out "
+                    "(SLO error budget exhausted)")
+            if self.breaker is not None:
+                try:
+                    self.breaker.allow()
+                except CircuitOpenError:
+                    self._count_shed(req, "circuit_open")
+                    raise
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.queue_limit:
                 self._count_shed(req, "queue_full")
@@ -415,10 +467,96 @@ class BatchScheduler:
             if self._thread is None:
                 self._stop = False
                 self._thread = threading.Thread(
-                    target=self._loop, daemon=True,
-                    name=f"serving-{self.model_id}")
+                    target=self._supervised if self.supervised
+                    else self._loop,
+                    daemon=True, name=f"serving-{self.model_id}")
                 self._thread.start()
         return self
+
+    def set_brownout(self, lanes=()):
+        """Shed ``lanes`` at submit time with :class:`BrownoutShedError`
+        (the resilience.BrownoutController seam). Pass ``()`` to restore."""
+        with self._cv:
+            self._brownout_lanes = frozenset(lanes)
+            self._cv.notify_all()
+
+    def _supervised(self):
+        """Watchdog wrapper around the worker loop: a crash fails the
+        in-flight batch loudly (500 + flight-recorder cause), counts
+        ``serving.worker_restarts_total``, and restarts the loop under the
+        RetryPolicy backoff; ``max_restarts`` exhausted flips the model's
+        ``serving.worker.<id>`` health check and fails everything still
+        queued with :class:`SchedulerStoppedError` (docs/SERVING.md)."""
+        while True:
+            try:
+                self._loop()
+                return  # clean stop (drain/shutdown)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — the watchdog seam
+                if not self._on_worker_crash(e):
+                    return
+                self.restart_policy.sleep_before_retry(self._restarts)
+
+    def _on_worker_crash(self, exc: BaseException) -> bool:
+        """Crash bookkeeping; returns True when the loop should restart."""
+        cause = f"worker_crash: {exc!r}"[:200]
+        with self._cv:
+            batch, self._current_batch = self._current_batch, None
+        # the in-flight batch's callers get a loud 500, never a hang
+        for req in batch or ():
+            if req.future.done():
+                # a crash AFTER _run_batch resolved this rider (e.g. in the
+                # post-result bookkeeping) — re-failing a FINISHED future
+                # raises, which would kill the watchdog itself and leave
+                # the queue dead with _worker_dead never set
+                continue
+            err_ns = time.time_ns()
+            req.t_exec1_ns = req.t_exec1_ns or err_ns
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(WorkerCrashedError(
+                    f"{self.model_id}: scheduler worker crashed executing "
+                    f"this batch: {exc!r}"))
+            self.counts["errors"] += 1
+            self.lane_counts[req.lane]["errors"] += 1
+            tm.counter("serving.request_errors_total",
+                       model=self.model_id, lane=req.lane)
+            self._flight_record(req, "error", cause=cause, end_ns=err_ns,
+                                traced=self._tracing_on())
+        if self.breaker is not None:
+            self.breaker.record_error()
+        self._restarts += 1
+        self._batches_since_crash = 0
+        tm.counter("serving.worker_restarts_total", model=self.model_id)
+        record_anomaly("worker_crash",
+                       f"{self.model_id}: {exc!r}"[:200],
+                       source="serving", model=self.model_id)
+        if self._restarts <= self.max_restarts:
+            tm.set_health(f"serving.worker.{self.model_id}", True,
+                          f"restarted after crash "
+                          f"({self._restarts}/{self.max_restarts}): "
+                          f"{exc!r}"[:200])
+            return True
+        # budget exhausted: the model is declared down — health flips, and
+        # everything still queued fails loudly instead of hanging forever
+        tm.set_health(f"serving.worker.{self.model_id}", False,
+                      f"worker dead after {self._restarts} crashes "
+                      f"(budget {self.max_restarts}): {exc!r}"[:200])
+        with self._cv:
+            self._worker_dead = True
+            self._inflight = 0
+            pending = [r for l in self.lanes for r in self._queues[l]]
+            for l in self.lanes:
+                self._queues[l].clear()
+            self._cv.notify_all()
+        for req in pending:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(SchedulerStoppedError(
+                    f"{self.model_id}: worker crashed past its restart "
+                    f"budget ({self.max_restarts}); request abandoned"))
+            self._flight_record(req, "error", cause="worker_dead",
+                                traced=self._tracing_on())
+        return False
 
     def _shed(self, req: _Request, exc: ShedError, reason: str):
         self._count_shed(req, reason)
@@ -497,6 +635,8 @@ class BatchScheduler:
                 if batch is None:
                     continue
                 self._inflight = 1
+                self._current_batch = batch  # the watchdog fails these
+                                             # loudly if the loop dies
             # max-wait window: keep admitting until the batch is full or
             # max_wait_ms has passed since it opened (continuous batching).
             # The whole cycle (fill wait + execute) is one worker-thread
@@ -520,6 +660,9 @@ class BatchScheduler:
                         cycle.args["requests"] = len(batch)
                         cycle.args["rows"] = rows
                     self._run_batch(batch)
+                    # every future resolved (result or handled error): the
+                    # watchdog must not re-fail them if the loop dies later
+                    self._current_batch = None
             finally:
                 with self._cv:
                     self._inflight = 0
@@ -536,6 +679,16 @@ class BatchScheduler:
 
     def _run_batch(self, batch: List[_Request]):
         t0 = time.monotonic()
+        self._batch_seq += 1
+        seq = self._batch_seq  # the serving_* faults' @step concept
+        # the injected worker crash escapes to the watchdog (_supervised):
+        # the REAL mechanism a broken scheduler exhibits — an exception in
+        # the loop machinery itself, outside the per-batch model-error catch
+        if fl.get_injector().fire(fl.SERVING_WORKER_CRASH,
+                                  step=seq) is not None:
+            raise RuntimeError(
+                f"{self.model_id}: injected serving worker crash "
+                f"(batch {seq})")
         tracing = self._tracing_on()
         # batch-level pad/device sub-spans ride the head-sampling decision:
         # a batch with ANY sampled request gets the detailed execute spans
@@ -548,7 +701,7 @@ class BatchScheduler:
             try:
                 results, stats = self.model.execute(
                     [r.payload for r in batch], _trace=trace_batch,
-                    **batch[0].opts)
+                    _step=seq, **batch[0].opts)
             except Exception as e:  # a bad request fails its batch, never
                 err_ns = time.time_ns()  # the worker (ParallelInference
                 for req in batch:        # contract)
@@ -565,7 +718,25 @@ class BatchScheduler:
                     if tracing:
                         self._stage_spans(req, "error", end_ns=err_ns)
                 tm.counter("serving.batch_errors_total", model=self.model_id)
+                if self.breaker is not None and not isinstance(
+                        e, (KeyError, TypeError, ValueError)):
+                    # one failed batch = one breaker outcome: enough of
+                    # these in a row fast-fails instead of queueing more
+                    # doomed work (resilience.CircuitBreaker). The
+                    # client-shaped family (the server's HTTP 400 mapping)
+                    # is excluded — a buggy client's malformed payloads
+                    # must not open the breaker and 503 a healthy model
+                    # for everyone else
+                    self.breaker.record_error()
                 return
+            if self.breaker is not None:
+                self.breaker.record_success()
+            self._batches_since_crash += 1
+            if self._restarts and \
+                    self._batches_since_crash >= self.restart_reset_batches:
+                # a sustained healthy run pays the crash budget back: the
+                # watchdog bounds crash LOOPS, not lifetime crashes
+                self._restarts = 0
             exec1_ns = time.time_ns()
             now = time.monotonic()
             padded = stats.get("padded_rows")
@@ -658,10 +829,14 @@ class BatchScheduler:
         return drained
 
     def shutdown(self):
-        """Immediate stop: fail everything still queued."""
+        """Immediate stop: fail everything still queued loudly (a pending
+        future must never outlive the worker that would have run it), and
+        make any LATER submit fail fast (SchedulerStoppedError) instead of
+        enqueueing into the dead queue."""
         with self._cv:
             self._accepting = False
             self._stop = True
+            self._worker_dead = True
             pending = [r for l in self.lanes for r in self._queues[l]]
             for l in self.lanes:
                 self._queues[l].clear()
@@ -713,6 +888,13 @@ class BatchScheduler:
         return {
             "queue_depth": self.queue_depth(),
             "accepting": self._accepting,
+            "worker_alive": (self._thread is not None
+                             and self._thread.is_alive()
+                             and not self._worker_dead),
+            "worker_restarts": self._restarts,
+            "breaker": (self.breaker.status()
+                        if self.breaker is not None else None),
+            "brownout_lanes": sorted(self._brownout_lanes),
             "completed": self.counts["completed"],
             "errors": self.counts["errors"],
             "shed": {k[len("shed_"):]: v for k, v in self.counts.items()

@@ -43,6 +43,8 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.serving.resilience import (ModelLoadError,
+                                                   ReloadRejectedError)
 from deeplearning4j_tpu.serving.router import ModelRouter, UnknownModelError
 from deeplearning4j_tpu.serving.scheduler import ShedError
 from deeplearning4j_tpu.util import telemetry as tm
@@ -252,9 +254,9 @@ def _make_handler(server: ModelServer):
 
         def do_POST(self):
             parts = self.path.strip("/").split("/")
-            # /v1/models/<id>/infer|generate
+            # /v1/models/<id>/infer|generate|reload
             if len(parts) != 4 or parts[:2] != ["v1", "models"] \
-                    or parts[3] not in ("infer", "generate"):
+                    or parts[3] not in ("infer", "generate", "reload"):
                 self._send_json(404, {"error": f"no route {self.path}"})
                 return
             model_id, verb = parts[2], parts[3]
@@ -276,6 +278,11 @@ def _make_handler(server: ModelServer):
                 if verb == "infer":
                     resp = server._handle_infer(model_id, body,
                                                 request_id=rid)
+                elif verb == "reload":
+                    # rolling-reload admin verb (docs/SERVING.md#resilience)
+                    resp = {"model": model_id,
+                            "version": server.router.reload(
+                                model_id, body["path"])}
                 else:
                     resp = server._handle_generate(model_id, body,
                                                    request_id=rid)
@@ -283,6 +290,12 @@ def _make_handler(server: ModelServer):
                 self._send_json(200, resp, headers=rid_hdr)
             except UnknownModelError as e:
                 self._send_json(404, {"error": f"unknown model {e}"},
+                                headers=rid_hdr)
+            except (ModelLoadError, ReloadRejectedError) as e:
+                # a rejected reload is a CONFLICT with the live version,
+                # which keeps serving — never a 5xx, the tier is healthy
+                self._send_json(409, {"error": type(e).__name__,
+                                      "detail": str(e)},
                                 headers=rid_hdr)
             except ShedError as e:
                 # the load-shed contract: 429 (or 503 while draining) with

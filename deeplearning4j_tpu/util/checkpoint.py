@@ -120,6 +120,18 @@ class ShardedCheckpointer:
         self._lock = threading.Lock()
         #: steps THIS instance committed — gates the same-step fast path
         self._committed_steps: set = set()
+        #: hooks fired AFTER a step's atomic rename lands (the commit→reload
+        #: seam, docs/SERVING.md#resilience): ``hook(step)`` — runs on the
+        #: committing thread (the background one for async saves), so hooks
+        #: must read only what they capture, never the live model
+        self._commit_hooks: list = []
+
+    def add_commit_hook(self, hook) -> None:
+        """Register ``hook(step)``, called after every successful commit
+        (blocking and async alike). Hook failures are counted and logged,
+        never raised — a broken observer must not fail a good checkpoint."""
+        if hook not in self._commit_hooks:
+            self._commit_hooks.append(hook)
 
     # ------------------------------------------------------------------ save
     def _state(self, model) -> dict:
@@ -223,6 +235,14 @@ class ShardedCheckpointer:
         self._committed_steps.add(step)
         tm.counter("elastic.checkpoints_total")
         tm.gauge("elastic.last_checkpoint_step", step)
+        for hook in list(self._commit_hooks):
+            try:
+                hook(step)
+            except Exception as e:  # noqa: BLE001 — observer, not the save
+                tm.counter("elastic.commit_hook_errors_total")
+                if self.log:
+                    self.log(f"WARNING: checkpoint commit hook failed at "
+                             f"step {step}: {e!r}")
         self._rotate()
 
     def save(self, step: int, model, extra_meta: Optional[dict] = None,

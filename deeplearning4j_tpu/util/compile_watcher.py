@@ -103,6 +103,12 @@ class CompileWatcher:
         self.jaxpr_trace_seconds = 0.0
         self.persistent_cache_hits = 0
         self._lock = threading.Lock()
+        # per-thread trace tally: note_trace runs ON the thread that
+        # triggered the trace (jit tracing is synchronous), so this lets a
+        # serving worker count only the traces ITS batches caused — a
+        # rolling reload's shadow warmup compiling on another thread must
+        # not show up as steady-state serving recompiles (serving/model.py)
+        self._tls = threading.local()
 
     @classmethod
     def get_instance(cls) -> "CompileWatcher":
@@ -114,6 +120,7 @@ class CompileWatcher:
     # ------------------------------------------------------------- recording
     def note_trace(self, fn_name: str, *traced_args) -> None:
         sig = tuple(_shape_of(a) for a in traced_args)
+        self._tls.traces = getattr(self._tls, "traces", 0) + 1
         with self._lock:
             self.traces[fn_name] = self.traces.get(fn_name, 0) + 1
             per = self.shapes.setdefault(fn_name, {})
@@ -123,6 +130,14 @@ class CompileWatcher:
     # --------------------------------------------------------------- queries
     def total_traces(self) -> int:
         return sum(self.traces.values())
+
+    def thread_traces(self) -> int:
+        """Traces noted on the CALLING thread since it first traced (0 for
+        a thread that never did). Delta this around a region to count only
+        the traces that region itself caused — immune to concurrent
+        compilation on other threads (a reload's shadow warmup, another
+        model's cold start)."""
+        return getattr(self._tls, "traces", 0)
 
     def counts(self) -> Dict[str, Any]:
         """One JSON-able snapshot of every counter. ``uncached_compiles``

@@ -15,7 +15,9 @@ bootstrap handshake (parallel/distributed.py):
   flapped before a run died.
 - :class:`FaultInjector` — a process-global registry of injectable faults
   (kill an ETL worker, stall the prefetch producer, drop heartbeats,
-  poison a batch with NaN, SIGKILL the host), each triggerable at a step
+  poison a batch with NaN, SIGKILL the host, and — since ISSUE 13 — the
+  serving-path kinds: fail a batch's compute, crash a scheduler worker,
+  stall a batch, corrupt a reload archive), each triggerable at a step
   number programmatically or via the ``DL4J_TPU_FAULTS`` env knob
   (``"inject_nan@5,kill_etl_worker"``). Recovery code that cannot be
   made to fire in a test does not ship — tests/test_elastic.py and the
@@ -131,16 +133,28 @@ STALL_PREFETCH = "stall_prefetch"      # data/prefetch.py: producer sleeps
 DROP_HEARTBEAT = "drop_heartbeat"      # parallel/elastic.py: skip heartbeats
 INJECT_NAN = "inject_nan"              # parallel/elastic.py: poison a batch
 SIGKILL_HOST = "sigkill_host"          # parallel/elastic.py: kill this process
+# serving-path kinds (docs/SERVING.md#resilience): the r13 tier's failure
+# modes, each firing on the REAL mechanism so the recovery exercised is the
+# production one (benchmarks/resilience_smoke.py drives all four in CI)
+SERVING_COMPUTE_ERROR = "serving_compute_error"  # serving/model.py: execute raises
+SERVING_WORKER_CRASH = "serving_worker_crash"    # serving/scheduler.py: worker loop dies
+SERVING_SLOW_BATCH = "serving_slow_batch"        # serving/model.py: execute stalls arg ms
+RELOAD_CORRUPT_ARCHIVE = "reload_corrupt_archive"  # serving/router.py: reload reads a truncated zip
 
 FAULT_KINDS = (KILL_ETL_WORKER, STALL_PREFETCH, DROP_HEARTBEAT, INJECT_NAN,
-               SIGKILL_HOST)
+               SIGKILL_HOST, SERVING_COMPUTE_ERROR, SERVING_WORKER_CRASH,
+               SERVING_SLOW_BATCH, RELOAD_CORRUPT_ARCHIVE)
 
-#: kinds whose injection site has a training-step concept (the elastic
-#: loop); the other sites — the ETL dispatcher, the prefetch producer, the
-#: heartbeat thread — fire with step=None, where a step-gated fault stays
-#: armed forever, so @step is rejected for them at parse/inject time
-#: ("a typo'd chaos knob must not silently test nothing")
-STEP_GATED_KINDS = (INJECT_NAN, SIGKILL_HOST)
+#: kinds whose injection site has a step concept — the elastic training
+#: loop's iteration for inject_nan/sigkill_host, the serving scheduler's
+#: batch-cycle sequence number for the serving_* kinds (``@nth`` = fire at
+#: the nth batch the worker runs). The other sites — the ETL dispatcher,
+#: the prefetch producer, the heartbeat thread, the reload path — fire with
+#: step=None, where a step-gated fault stays armed forever, so @step is
+#: rejected for them at parse/inject time ("a typo'd chaos knob must not
+#: silently test nothing")
+STEP_GATED_KINDS = (INJECT_NAN, SIGKILL_HOST, SERVING_COMPUTE_ERROR,
+                    SERVING_WORKER_CRASH, SERVING_SLOW_BATCH)
 
 
 @dataclass
@@ -189,6 +203,12 @@ class FaultInjector:
         self.log: List[Tuple[str, Optional[int]]] = []  # (kind, step) fired
         for f in parse_fault_spec(os.environ.get("DL4J_TPU_FAULTS", "")):
             self._faults.setdefault(f.kind, []).append(f)
+        #: lock-free fast path for fire() — the serving tier calls fire()
+        #: on every batch cycle (util/faults is process-global), and an
+        #: un-chaos'd process must not pay a global lock acquisition per
+        #: call. Conservative: set on inject, cleared only by clear()
+        #: (a process with exhausted faults is a chaos test already).
+        self._armed_fast = bool(self._faults)
 
     @classmethod
     def get_instance(cls) -> "FaultInjector":
@@ -211,6 +231,7 @@ class FaultInjector:
         f = Fault(kind, at_step=at_step, count=count, arg=arg)
         with self._lock:
             self._faults.setdefault(kind, []).append(f)
+            self._armed_fast = True
         return f
 
     def armed(self, kind: Optional[str] = None) -> bool:
@@ -222,6 +243,8 @@ class FaultInjector:
     def fire(self, kind: str, step: Optional[int] = None) -> Optional[Fault]:
         """Consume one firing of ``kind`` at ``step`` (None when the site has
         no step concept). Returns the Fault (for ``arg``) or None."""
+        if not self._armed_fast:  # plain attribute read: no lock on the
+            return None           # hot path of an un-chaos'd process
         with self._lock:
             for f in self._faults.get(kind, ()):
                 if f.should_fire(step):
@@ -239,6 +262,7 @@ class FaultInjector:
         with self._lock:
             self._faults.clear()
             self.log.clear()
+            self._armed_fast = False
 
 
 def parse_fault_spec(spec: str) -> List[Fault]:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 from typing import Any, Optional
 
@@ -100,15 +101,83 @@ class ModelSerializer:
             "has_updater_state": bool(save_updater),
             "format_version": 1,
         }
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(_CONFIG, model.conf.to_json())
-            zf.writestr(_COEFF, _savez(_leaves(model.params)))
-            zf.writestr(_STATE, _savez(_leaves(model.states)))
-            if save_updater:
-                zf.writestr(_UPDATER, _savez(_leaves(model.opt_states)))
-            zf.writestr(_META, json.dumps(meta))
-            if normalizer is not None:
-                zf.writestr(_NORMALIZER, json.dumps(normalizer.to_dict()))
+        entries = [(_CONFIG, model.conf.to_json()),
+                   (_COEFF, _savez(_leaves(model.params))),
+                   (_STATE, _savez(_leaves(model.states)))]
+        if save_updater:
+            entries.append((_UPDATER, _savez(_leaves(model.opt_states))))
+        entries.append((_META, json.dumps(meta)))
+        if normalizer is not None:
+            entries.append((_NORMALIZER, json.dumps(normalizer.to_dict())))
+        ModelSerializer._write_zip(path, entries)
+
+    @staticmethod
+    def _write_zip(path: str, entries) -> None:
+        """Atomic publish: write the whole zip to a tmp sibling, then
+        os.replace into place — a reader (the serving watch poller,
+        docs/SERVING.md#resilience) can never observe a torn archive, and
+        a crash mid-write leaves only the tmp corpse."""
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+                for name, data in entries:
+                    zf.writestr(name, data)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    # ------------------------------------------------------------- snapshot
+    @staticmethod
+    def snapshot(model) -> dict:
+        """Capture everything a no-updater ``write_model`` reads as HOST
+        arrays, on the caller's thread. The device→host copy is mandatory,
+        not an optimization to skip: the train step donates the param
+        buffers (nn/multilayer.py ``donate_argnums``), so a background
+        writer holding device refs would read freed buffers — the same
+        reason ``ShardedCheckpointer._host_snapshot`` exists. The
+        still-expensive DEFLATE + write happen later on the writer thread
+        via :meth:`write_snapshot` — the elastic publish seam
+        (docs/SERVING.md#resilience) without stalling the step loop on
+        compression."""
+        import jax
+
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if isinstance(model, MultiLayerNetwork):
+            mtype = "MultiLayerNetwork"
+        elif isinstance(model, ComputationGraph):
+            mtype = "ComputationGraph"
+        else:
+            raise TypeError(f"cannot serialize {type(model).__name__}")
+        host = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: np.asarray(jax.device_get(a)), tree)
+        return {
+            "conf_json": model.conf.to_json(),
+            "params": host(model.params),
+            "states": host(model.states),
+            "meta": {
+                "type": mtype,
+                "iteration": int(model.iteration),
+                "epoch": int(model.epoch),
+                "rng_key": np.asarray(model._rng_key).tolist(),
+                "params_structure": _fingerprint(model.params),
+                "has_updater_state": False,
+                "format_version": 1,
+            },
+        }
+
+    @staticmethod
+    def write_snapshot(snap: dict, path: str) -> None:
+        """Serialize a :meth:`snapshot` capture to ``path`` (atomic). Safe
+        on any thread — the snapshot owns immutable tree refs."""
+        ModelSerializer._write_zip(path, [
+            (_CONFIG, snap["conf_json"]),
+            (_COEFF, _savez(_leaves(snap["params"]))),
+            (_STATE, _savez(_leaves(snap["states"]))),
+            (_META, json.dumps(snap["meta"])),
+        ])
 
     # --------------------------------------------------------------- restore
     @staticmethod

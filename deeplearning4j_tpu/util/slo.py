@@ -131,6 +131,7 @@ class SloEngine:
         self._samples: Dict[str, deque] = {}
         self._exhausted: Dict[str, bool] = {}
         self._hooks: List[Callable[[str, str], None]] = []
+        self._recover_hooks: List[Callable[[str], None]] = []
 
     # -------------------------------------------------------------- registry
     def register(self, objective: SloObjective) -> SloObjective:
@@ -151,15 +152,50 @@ class SloEngine:
             if hook not in self._hooks:
                 self._hooks.append(hook)
 
+    def on_recover(self, hook: Callable[[str], None]):
+        """``hook(objective_name)`` invoked when an exhausted objective's
+        budget recovers — the other half of the breach seam, so state
+        machines hung off the SLO engine (the serving brownout controller,
+        serving/resilience.py) can restore service symmetrically."""
+        with self._lock:
+            if hook not in self._recover_hooks:
+                self._recover_hooks.append(hook)
+
+    def off_breach(self, hook):
+        """Remove a hook registered with :meth:`on_breach` (the other half
+        of install/uninstall symmetry — serving/resilience.py)."""
+        with self._lock:
+            if hook in self._hooks:
+                self._hooks.remove(hook)
+
+    def off_recover(self, hook):
+        """Remove a hook registered with :meth:`on_recover`."""
+        with self._lock:
+            if hook in self._recover_hooks:
+                self._recover_hooks.remove(hook)
+
     def reset(self):
         """Drop every objective and restore its health check (tests, and
-        the smoke's synthetic budget-exhausted case)."""
+        the smoke's synthetic budget-exhausted case). Objectives that are
+        exhausted at reset time fire their recover hooks first — dropping
+        an objective ends its breach, and a state machine hung off the
+        engine (the serving brownout controller) must see the recovery,
+        not stay browned out forever with the hook list emptied under it."""
         with self._lock:
             names = list(self.objectives)
+            exhausted = [n for n, bad in self._exhausted.items() if bad]
+            recover_hooks = list(self._recover_hooks)
             self.objectives.clear()
             self._samples.clear()
             self._exhausted.clear()
             self._hooks.clear()
+            self._recover_hooks.clear()
+        for name in exhausted:
+            for hook in recover_hooks:
+                try:
+                    hook(name)
+                except Exception:
+                    pass  # a broken hook must never break reset
         for name in names:
             tm.set_health(f"slo.{name}", True, "slo reset")
 
@@ -276,6 +312,7 @@ class SloEngine:
             was = self._exhausted.get(obj.name, False)
             self._exhausted[obj.name] = res["exhausted"]
             hooks = list(self._hooks)
+            recover_hooks = list(self._recover_hooks)
         if res["exhausted"]:
             detail = (f"error budget exhausted: burn "
                       f"{res['windows'][_window_label(obj.windows[-1])]['burn_rate']}x "
@@ -296,6 +333,11 @@ class SloEngine:
             if was:
                 record_anomaly("budget_recovered", obj.name, source="slo",
                                slo=obj.name)
+                for hook in recover_hooks:
+                    try:
+                        hook(obj.name)
+                    except Exception:
+                        pass  # a broken hook must never break evaluation
 
 
 # ------------------------------------------------------------- module API

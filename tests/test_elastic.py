@@ -39,6 +39,14 @@ def _clean_injector():
     get_injector().clear()
     yield
     get_injector().clear()
+    # NaN-injection tests flip training.* checks in the PROCESS-GLOBAL
+    # health registry; restore them so a later suite's /healthz assertion
+    # (e.g. test_serving's 200 contract) sees a healthy process — the r17
+    # hygiene convention for process-global check state
+    _ok, checks = tm.get_telemetry().health_report()
+    for name, c in checks.items():
+        if name.startswith("training.") and not c.get("ok"):
+            tm.set_health(name, True, "test cleanup (elastic NaN leg)")
 
 
 def _counter(name):
