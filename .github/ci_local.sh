@@ -134,6 +134,18 @@ JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/autotune_smoke.py
 
+echo "== step: Pipeline smoke (3D mesh: bytes/device + trajectory + compose) =="
+# ISSUE 14: the pipeline-parallel fit() on the (data=2, model=2, pipe=2)
+# 8-virtual-device mesh — a model whose replicated param+optimizer
+# footprint busts a per-device budget places at ~1/pipe_stages
+# bytes/device and trains; the fit tracks the unpipelined trajectory and
+# is BIT-identical across data folds with the pipe placement fixed;
+# grad_compression t->0 composes bit-identically under ZeRO; the bubble
+# fraction equals the GPipe schedule expression (computed, never timed).
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/pipeline_smoke.py
+
 echo "== step: Perf-regression gate (BENCH bands + injected-regression self-test) =="
 # ISSUE 5: the committed BENCH_r*.json trajectory becomes machine-checked
 # bands (noise-aware, direction-aware); the latest record must pass, and

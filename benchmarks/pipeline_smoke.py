@@ -1,0 +1,198 @@
+"""CI smoke for the pipeline-parallel fit() (ISSUE 14).
+
+Runs on 8 virtual CPU devices and asserts the acceptance contracts CPU can
+honestly prove about the 3D (data x tensor x pipe) trainer
+(docs/DISTRIBUTED.md#pipeline-parallelism):
+
+1. **Memory: a model too big for one device's budget trains.** On the
+   (data=2, model=2, pipe=2) mesh, a stage-dominated net whose replicated
+   param+optimizer footprint EXCEEDS a declared per-device budget places
+   under it — bytes/device ≈ 1/pipe_stages of the replicated footprint
+   (stage params P('pipe'), moments ZeRO over 'data') — and fit() runs.
+2. **Trajectory equivalence.** The (2,2,2) 8-device pipelined fit tracks
+   the plain unpipelined single-device fit (allclose); the same pipelined
+   program on (data=1, pipe=2) reproduces the 8-device fit BIT-identically
+   (params + Adam moments + RNG key) — the r12 lane contract with the
+   pipe placement fixed.
+3. **Composition.** grad_compression threshold→0 on the pipelined step is
+   bit-identical to the uncompressed pipelined fit (ZeRO default-on under
+   both); an active threshold ships encoded wire bytes.
+4. **Schedule accounting.** `pipeline_bubble_fraction` equals the GPipe
+   fill-drain expression (S-1)/(n_micro+S-1) and is published as a gauge
+   (computed from the schedule, never timed — the r6 CPU honesty rule).
+
+Exit 0 on success; any assertion failure exits non-zero (the CI legs in
+.github/workflows/ci.yml + .github/ci_local.sh run this file directly).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.data import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn import (  # noqa: E402
+    InputType, MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.parallel import (  # noqa: E402
+    PipelinedTrainer, TrainingMesh, gspmd)
+from deeplearning4j_tpu.parallel.pipeline import bubble_fraction  # noqa: E402
+from deeplearning4j_tpu.util import telemetry as tm  # noqa: E402
+
+STAGES, N_MICRO = 2, 4
+
+
+def _net(width=16, comp=None, threshold=1e-3):
+    b = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+         .pipe_stages(STAGES).n_micro(N_MICRO))
+    if comp:
+        b = b.grad_compression(comp, threshold=threshold)
+    conf = (b.list()
+            .layer(DenseLayer(n_in=8, n_out=width, activation="relu"))
+            .stage_boundary()
+            .layer(DenseLayer(n_in=width, n_out=width, activation="tanh"))
+            .layer(DenseLayer(n_in=width, n_out=width, activation="relu"))
+            .stage_boundary()
+            .layer(DenseLayer(n_in=width, n_out=width, activation="tanh"))
+            .layer(DenseLayer(n_in=width, n_out=width, activation="relu"))
+            .stage_boundary()
+            .layer(OutputLayer(n_in=width, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=16):
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n, 8)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return DataSet(xs, ys)
+
+
+def _leaves(t):
+    return [np.asarray(jax.device_get(l))
+            for l in jax.tree_util.tree_leaves(t)]
+
+
+def check_memory_budget():
+    """A stage-dominated net whose replicated footprint busts a declared
+    per-device budget fits (and trains) once pipelined."""
+    net = _net(width=512)  # stage params dominate: 4 x 512x512 fp32
+    ds = _data()
+    pt = PipelinedTrainer(net, mesh=TrainingMesh(data=2, model=2, pipe=2),
+                          replicas=2, skew_every=0)
+    pt._build()
+    replicated = (gspmd.tree_bytes(net.params)
+                  + gspmd.tree_bytes(net.opt_states))
+    per_dev = pt.train_state_bytes_per_device()
+    budget = int(replicated * 0.75)  # one "device" cannot hold the model
+    assert replicated > budget, "net too small for the budget story"
+    assert per_dev < budget, (per_dev, budget)
+    ratio = per_dev / replicated
+    assert ratio < 1.0 / STAGES + 0.12, \
+        f"bytes/device ratio {ratio:.3f} not ~1/{STAGES}"
+    pt.step_batch(ds)  # and it trains
+    assert np.isfinite(float(net.score_value))
+    print(f"PASS memory: replicated {replicated} B > budget {budget} B; "
+          f"per-device {per_dev} B (ratio {ratio:.3f} ≈ 1/{STAGES}), "
+          f"fit() ran")
+    return per_dev, replicated
+
+
+def check_trajectory_and_bit_identity():
+    ds = _data()
+    ref = _net()
+    for _ in range(4):
+        ref._fit_batch(ds.features, ds.labels)
+    n8 = _net()
+    p8 = PipelinedTrainer(n8, mesh=TrainingMesh(data=2, model=2, pipe=2),
+                          replicas=2, skew_every=0)
+    for _ in range(4):
+        p8.step_batch(ds)
+    p8.sync_model()
+    for a, b in zip(_leaves(n8.params), _leaves(ref.params)):
+        np.testing.assert_allclose(a, b, atol=5e-6, rtol=5e-6)
+    n1 = _net()
+    p1 = PipelinedTrainer(
+        n1, mesh=TrainingMesh(data=1, model=2, pipe=2,
+                              devices=jax.devices()[:4]),
+        replicas=2, skew_every=0)
+    for _ in range(4):
+        p1.step_batch(ds)
+    p1.sync_model()
+    for a, b in zip(_leaves(n8.params), _leaves(n1.params)):
+        assert np.array_equal(a, b), "data-fold bit-identity broke"
+    for a, b in zip(_leaves(n8.opt_states), _leaves(n1.opt_states)):
+        assert np.array_equal(a, b), "opt-state bit-identity broke"
+    assert np.array_equal(np.asarray(n8._rng_key), np.asarray(n1._rng_key))
+    print("PASS trajectory: (2,2,2) fit ~ unpipelined (5e-6) and "
+          "bit-identical to the (1,2,2) fold (params+moments+RNG)")
+
+
+def check_compression_composition():
+    ds = _data()
+    mesh = lambda: TrainingMesh(data=2, model=2, pipe=2)  # noqa: E731
+    nc = _net(comp="threshold", threshold=0.0)
+    pc = PipelinedTrainer(nc, mesh=mesh(), replicas=2, skew_every=0)
+    nu = _net()
+    pu = PipelinedTrainer(nu, mesh=mesh(), replicas=2, skew_every=0)
+    for _ in range(3):
+        pc.step_batch(ds)
+        pu.step_batch(ds)
+    pc.sync_model()
+    pu.sync_model()
+    for a, b in zip(_leaves(nc.params), _leaves(nu.params)):
+        assert np.array_equal(a, b), "t->0 compression identity broke"
+    na = _net(comp="threshold", threshold=1e-3)
+    pa = PipelinedTrainer(na, mesh=mesh(), replicas=2, skew_every=0)
+    for _ in range(4):
+        pa.step_batch(ds)
+    stats = pa.compression_stats()
+    assert stats["wire_bytes"] > 0, stats
+    print(f"PASS composition: t->0 bit-identical under ZeRO; active "
+          f"threshold ships {stats['wire_bytes']:.0f} wire bytes "
+          f"(ratio {stats['ratio']:.3f})")
+
+
+def check_bubble_fraction():
+    expected = (STAGES - 1) / (N_MICRO + STAGES - 1)
+    net = _net()
+    pt = PipelinedTrainer(net, mesh=TrainingMesh(data=2, model=2, pipe=2),
+                          replicas=2, skew_every=0)
+    pt._build()
+    assert abs(pt.bubble_fraction - expected) < 1e-12
+    assert abs(bubble_fraction(STAGES, N_MICRO) - expected) < 1e-12
+    gauges = tm.get_telemetry().gauges
+    val = next((v for (name, _), v in gauges.items()
+                if name == "parallel.pipeline_bubble_fraction"), None)
+    assert val is not None and abs(val - expected) < 1e-12, val
+    print(f"PASS schedule: bubble fraction {expected:.4f} = "
+          f"(S-1)/(n_micro+S-1) published as a gauge (computed, not timed)")
+    return expected
+
+
+def main():
+    assert len(jax.devices()) >= 8, jax.devices()
+    check_memory_budget()
+    check_trajectory_and_bit_identity()
+    check_compression_composition()
+    check_bubble_fraction()
+    print("pipeline smoke: ALL PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,16 @@ LOWER_BETTER = {
     # window — ms, floored at 0.5 so the multiplicative band stays sane
     # when the storm is within timer noise of free
     "serving_reload_p99_delta_ms",
+    # pipeline-parallel fit() (ISSUE 14, BENCH_r10 headline):
+    # param+optimizer bytes ONE device holds for the stage-dominated net
+    # on the (data=2, model=2, pipe=2) mesh — stacked stage params
+    # P('pipe') + ZeRO moments; deterministic byte accounting, so this
+    # band is exact — a regression means the placement stopped sharding
+    "pipeline_param_bytes_per_device",
+    # and the GPipe schedule's bubble fraction (S-1)/(n_micro+S-1) at the
+    # committed config — schedule arithmetic, not wall-clock (CPU cannot
+    # rank bubbles; the r6 honesty convention)
+    "pipeline_bubble_fraction",
 }
 
 # Metrics a candidate run may NEVER drop (missing == fail even without

@@ -88,6 +88,12 @@ Recognized variables (DL4J_TPU_* namespace; reference names in comments):
   winner). Every entry is equivalence-gated before commit — the r6
   honesty convention made executable. Empty/unset = off (auto keeps its
   honest prior: compiled kernels only on the real chip).
+- ``DL4J_TPU_PIPE_STAGES`` — default ``pipe_stages`` for new configs
+  (parallel/pipelined.py, docs/DISTRIBUTED.md#pipeline-parallelism):
+  partition the net into N pipeline stages at its ``stage_boundary()``
+  markers and let ``PipelinedTrainer`` place the stacked stage params
+  over the mesh 'pipe' axis — "model too big for one chip" as a config
+  knob. 0/unset = off. Inert on single-device ``fit()``.
 - ``DL4J_TPU_GRAD_COMPRESSION`` — default ``grad_compression`` for new
   configs ("none" | "threshold" | "bitmap" | "onebit" —
   parallel/compression.py, docs/DISTRIBUTED.md#gradient-compression):
@@ -150,6 +156,10 @@ class Environment:
         # validated by the conf Builder so a typo fails at config build
         self.default_grad_compression = (
             os.environ.get("DL4J_TPU_GRAD_COMPRESSION") or None)
+        # pipeline parallelism default (parallel/pipelined.py): stage
+        # count for new configs; 0 = off
+        self.default_pipe_stages = _env_int("DL4J_TPU_PIPE_STAGES", 0,
+                                            floor=0)
         # autotuning database (tuning/database.py; the authoritative read
         # is database_dir() — surfaced here so crash dumps show the knob)
         self.tuning_db_dir = os.environ.get("DL4J_TPU_TUNING_DB") or None

@@ -93,6 +93,11 @@ class ComputationGraphConfiguration:
     grad_compression: str = "none"
     grad_compression_threshold: float = 1e-3
     grad_compression_target: float = 1e-3
+    # Pipeline parallelism (parallel/pipelined.py): same knobs as
+    # MultiLayerConfiguration — stage boundaries come from the graph
+    # builder's stage_boundary() node names.
+    pipe_stages: int = 0
+    n_micro: int = 0
 
     # -- serialization (JSON round-trip is a tested invariant) ---------------
     def to_json(self) -> str:
@@ -122,6 +127,8 @@ class ComputationGraphConfiguration:
                 "grad_compression": self.grad_compression,
                 "grad_compression_threshold": self.grad_compression_threshold,
                 "grad_compression_target": self.grad_compression_target,
+                "pipe_stages": self.pipe_stages,
+                "n_micro": self.n_micro,
                 "nodes": [
                     {
                         "name": n.name,
@@ -175,6 +182,8 @@ class ComputationGraphConfiguration:
             grad_compression_threshold=d.get("grad_compression_threshold",
                                              1e-3),
             grad_compression_target=d.get("grad_compression_target", 1e-3),
+            pipe_stages=d.get("pipe_stages", 0),
+            n_micro=d.get("n_micro", 0),
             nodes=[
                 GraphNode(n["name"], denode(n["node"]), list(n["inputs"]))
                 for n in d["nodes"]
@@ -301,6 +310,8 @@ class GraphBuilder:
                 self._p, "_grad_compression_threshold", 1e-3),
             grad_compression_target=getattr(
                 self._p, "_grad_compression_target", 1e-3),
+            pipe_stages=getattr(self._p, "_pipe_stages", 0),
+            n_micro=getattr(self._p, "_n_micro", 0),
         )
 
 

@@ -91,6 +91,13 @@ class MultiLayerConfiguration:
     grad_compression: str = "none"
     grad_compression_threshold: float = 1e-3  # initial (adaptive) threshold
     grad_compression_target: float = 1e-3     # target transmitted fraction
+    # Pipeline parallelism (parallel/pipelined.py,
+    # docs/DISTRIBUTED.md#pipeline-parallelism): number of pipeline stages
+    # the stage_boundary() markers partition the net into (0 = off), and
+    # the microbatch count per data lane (0 = default: one per stage).
+    # Inert on single-device fit(); PipelinedTrainer consults them.
+    pipe_stages: int = 0
+    n_micro: int = 0
 
     def to_json(self) -> str:
         return json.dumps(
@@ -115,6 +122,8 @@ class MultiLayerConfiguration:
                 "grad_compression": self.grad_compression,
                 "grad_compression_threshold": self.grad_compression_threshold,
                 "grad_compression_target": self.grad_compression_target,
+                "pipe_stages": self.pipe_stages,
+                "n_micro": self.n_micro,
                 "layers": [lyr.to_dict() for lyr in self.layers],
             },
             indent=2,
@@ -156,6 +165,8 @@ class MultiLayerConfiguration:
             grad_compression_threshold=d.get("grad_compression_threshold",
                                              1e-3),
             grad_compression_target=d.get("grad_compression_target", 1e-3),
+            pipe_stages=d.get("pipe_stages", 0),
+            n_micro=d.get("n_micro", 0),
         )
 
 
@@ -252,6 +263,11 @@ class Builder:
             raise ValueError(f"DL4J_TPU_GRAD_COMPRESSION: {e}") from None
         self._grad_compression_threshold = 1e-3
         self._grad_compression_target = 1e-3
+        # pipeline parallelism defaults (parallel/pipelined.py): env knob
+        # DL4J_TPU_PIPE_STAGES folds in here so a deployment can flip a
+        # whole fleet to pipelined placement without code changes
+        self._pipe_stages = env.default_pipe_stages
+        self._n_micro = 0
         if env.default_buckets:
             from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 
@@ -416,6 +432,27 @@ class Builder:
         self._grad_compression_target = float(target_sparsity)
         return self
 
+    def pipe_stages(self, n: int) -> "Builder":
+        """Pipeline parallelism (docs/DISTRIBUTED.md#pipeline-parallelism):
+        partition the net into ``n`` pipeline stages at the
+        ``stage_boundary()`` markers and place the stacked stage params
+        over the mesh 'pipe' axis. ``0`` (default) = off. Consulted by
+        ``PipelinedTrainer``; inert on a single-device fit()."""
+        if n < 0:
+            raise ValueError(f"pipe_stages must be >= 0, got {n}")
+        self._pipe_stages = int(n)
+        return self
+
+    def n_micro(self, n: int) -> "Builder":
+        """Microbatch count per data lane for the pipelined fit (GPipe
+        fill-drain schedule; bubble fraction (S-1)/(n+S-1)). ``0``
+        (default) = one microbatch per stage. Batches not divisible pad
+        with 0-weighted rows (exact gradients, the r8 machinery)."""
+        if n < 0:
+            raise ValueError(f"n_micro must be >= 0, got {n}")
+        self._n_micro = int(n)
+        return self
+
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
@@ -495,4 +532,6 @@ class ListBuilder:
             grad_compression=self._p._grad_compression,
             grad_compression_threshold=self._p._grad_compression_threshold,
             grad_compression_target=self._p._grad_compression_target,
+            pipe_stages=self._p._pipe_stages,
+            n_micro=self._p._n_micro,
         )

@@ -31,8 +31,14 @@ from deeplearning4j_tpu.parallel.masters import (  # noqa: F401
 )
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh  # noqa: F401
 from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    bubble_fraction,
+    gpipe_scan,
     pipeline_forward,
     stack_stage_params,
+)
+from deeplearning4j_tpu.parallel.pipelined import (  # noqa: F401
+    PipelinedTrainer,
+    stage_partition,
 )
 from deeplearning4j_tpu.parallel.ring import ring_attention, shard_sequence  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper  # noqa: F401

@@ -606,6 +606,13 @@ class ElasticTrainer:
         disp = getattr(self.net, "_dispatcher", None)
         if disp is not None:
             disp.flush()
+        # pipelined trainers keep the live state stage-stacked on device
+        # (parallel/pipelined.py); pull it back into the net's model layout
+        # (bit-exact unstack) so the checkpoint — and the publish snapshot
+        # right after — carry the CURRENT weights
+        sync = getattr(self.wrapper, "sync_model", None)
+        if sync is not None:
+            sync()
         meta = {
             "batch_in_epoch": self._batch_in_epoch,
             "epoch": self.net.epoch,

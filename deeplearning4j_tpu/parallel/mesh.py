@@ -8,6 +8,7 @@ parameter server — path-cite, mount empty this round): a
 - ``data``  — batch (DP); gradients all-reduce over ICI
 - ``model`` — tensor parallelism (sharded matmuls)
 - ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline parallelism (stage-stacked params; parallel/pipelined.py)
 
 Multi-host: the same mesh spans hosts (DCN between slices); construction is
 identical — jax.distributed bootstrap happens in parallel.distributed.
@@ -24,22 +25,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 class TrainingMesh:
-    AXES = ("data", "model", "seq")
+    AXES = ("data", "model", "seq", "pipe")
 
     def __init__(self, data: int = 0, model: int = 1, seq: int = 1,
-                 devices: Optional[Sequence] = None):
+                 pipe: int = 1, devices: Optional[Sequence] = None):
         devices = list(devices) if devices is not None else jax.devices()
         n = len(devices)
+        fixed = model * seq * pipe
         if data <= 0:
-            if n % (model * seq) != 0:
-                raise ValueError(f"{n} devices not divisible by model*seq={model * seq}")
-            data = n // (model * seq)
-        total = data * model * seq
+            if n % fixed != 0:
+                raise ValueError(
+                    f"{n} devices not divisible by model*seq*pipe={fixed}")
+            data = n // fixed
+        total = data * fixed
         if total > n:
-            raise ValueError(f"mesh {data}x{model}x{seq} needs {total} devices, have {n}")
-        grid = np.array(devices[:total]).reshape(data, model, seq)
+            raise ValueError(f"mesh {data}x{model}x{seq}x{pipe} needs "
+                             f"{total} devices, have {n}")
+        grid = np.array(devices[:total]).reshape(data, model, seq, pipe)
         self.mesh = Mesh(grid, axis_names=self.AXES)
-        self.data, self.model, self.seq = data, model, seq
+        self.data, self.model, self.seq, self.pipe = data, model, seq, pipe
 
     # -- shardings ---------------------------------------------------------
     def replicated(self) -> NamedSharding:
@@ -124,7 +128,8 @@ class TrainingMesh:
 
         return jax.tree_util.tree_map(place, tree)
 
-    def pad_lane_batch(self, x, y, replicas: int, extras=None):
+    def pad_lane_batch(self, x, y, replicas: int, extras=None,
+                       micro: int = 1):
         """Lane-decomposed variant of :meth:`pad_shard_batch` (the
         deterministic GSPMD path — parallel/gspmd.py): the same ragged
         padding (``_pad_ragged``), then every array reshapes to
@@ -132,9 +137,13 @@ class TrainingMesh:
         Returns (x, y, weights[, extras]) with weights shaped
         ``(replicas, b)``. The lane count is fixed by the caller — not by
         the device count — which is what makes a fit reproducible across
-        mesh sizes."""
+        mesh sizes. ``micro > 1`` (the pipelined trainer's microbatch
+        count — parallel/pipelined.py) pads to ``replicas * micro``
+        divisibility so each lane's batch further splits into ``micro``
+        equal microbatches; the extra rows carry weight 0 exactly like
+        every other ragged pad (the r8 0/1-weight machinery)."""
         xs, ys, w, extras, multi_x, multi_y = self._pad_ragged(
-            x, y, replicas, extras)
+            x, y, replicas * max(1, int(micro)), extras)
         lane = lambda v: np.reshape(  # noqa: E731
             v, (replicas, v.shape[0] // replicas) + v.shape[1:])
         place = lambda v: jax.device_put(  # noqa: E731
@@ -207,7 +216,8 @@ class TrainingMesh:
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.model * self.seq
+        return self.data * self.model * self.seq * self.pipe
 
     def __repr__(self):
-        return f"TrainingMesh(data={self.data}, model={self.model}, seq={self.seq})"
+        return (f"TrainingMesh(data={self.data}, model={self.model}, "
+                f"seq={self.seq}, pipe={self.pipe})")

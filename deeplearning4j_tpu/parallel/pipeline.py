@@ -14,7 +14,10 @@ activation that just arrived) and rotates the buffer one stage with
 ``jnp.roll`` on the sharded axis — the partitioner lowers the roll to the
 ring's collective-permute. The tick loop is a ``lax.scan``, so the whole
 pipeline is ONE whole-program-compiled XLA computation (arXiv:1810.09868)
-and reverse AD through the scan gives the backward pipeline for free.
+and reverse AD through the scan gives the backward pipeline for free: the
+scan's transpose threads cotangents backwards through the SAME rolled stage
+buffer, accumulating each stage's parameter gradient across its microbatches
+(microbatch gradient accumulation, without a hand-written backward).
 
 API:
 
@@ -25,12 +28,25 @@ API:
 ``stage_fn(params_i, x) -> y`` must map activations of a fixed shape to the
 same shape (equal-width stages — the standard PP regime; embed/head layers
 live outside the pipeline).
+
+Batch sizes not divisible by ``n_micro`` are padded by repeating the last
+row up to divisibility and slicing the padded rows off the result — the r8
+ragged-batch stance (pad, never raise; under a training loss the padded
+rows carry 0/1 loss weights so gradients stay exact —
+parallel/pipelined.py threads them).
+
+:func:`gpipe_scan` is the raw differentiable building block (no jit, no
+mesh): the :class:`~deeplearning4j_tpu.parallel.pipelined.PipelinedTrainer`
+embeds it inside its lane-decomposed train step, where the lane axis rides
+'data', tensor-parallel annotations ride 'model', and the stacked stage
+axis rides 'pipe' — the full 3D (data x tensor x pipe) composition in one
+jit program.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -44,49 +60,95 @@ def stack_stage_params(params_list: Sequence):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
 
 
+def bubble_fraction(stages: int, n_micro: int) -> float:
+    """The GPipe fill-drain schedule's idle fraction: of the
+    ``n_micro + S - 1`` ticks each stage is live for, ``S - 1`` are
+    fill/drain bubble — identically for the forward scan and its AD
+    transpose, so the whole-step bubble fraction is the same expression.
+    Deterministic in (S, n_micro); computed from the schedule, not timed
+    (the honest CPU stance — wall-clock ranking belongs to real chips)."""
+    s, m = int(stages), int(n_micro)
+    if s < 1 or m < 1:
+        raise ValueError(f"stages ({s}) and n_micro ({m}) must be >= 1")
+    return (s - 1) / (m + s - 1)
+
+
+def gpipe_scan(stage_fn: Callable, stacked_params, micro,
+               constrain: Optional[Callable] = None):
+    """The raw GPipe tick loop, differentiable and transform-friendly.
+
+    ``stage_fn(stage_params, x) -> y`` is vmapped over the leading stage
+    axis of ``stacked_params`` (S stages); ``micro`` is ``(n_micro, mb,
+    ...)``. Each tick feeds microbatch t to stage 0, applies every stage to
+    the activation that just arrived, banks the last stage's output, and
+    rotates the buffer one hop (``jnp.roll`` on the stage axis — the
+    collective-permute once the axis is sharded). Returns ``(n_micro, mb,
+    ...)`` outputs matching sequential stage application (tested).
+
+    ``constrain``: optional ``tree -> tree`` hook asserting the stage-axis
+    sharding on the rolled buffer (``pipeline_forward`` passes one; the
+    pipelined trainer runs inside ``vmap`` where the annotation on the
+    stacked params already pins the layout by propagation).
+
+    No jit here: callers embed it inside their own compiled step — reverse
+    AD through the scan yields the backward pipeline through the same
+    rolled buffer, with per-stage gradients accumulated over microbatches
+    by the scan transpose.
+    """
+    leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
+    if len(leading) != 1:
+        raise ValueError(
+            f"stacked_params leading dims differ: {sorted(leading)} — every "
+            "leaf needs the same leading stage axis (stack_stage_params)")
+    (s,) = leading
+    n_micro = micro.shape[0]
+    mb_shape = micro.shape[1:]
+    ident = lambda t: t  # noqa: E731
+    pin = constrain or ident
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+    buffer = jnp.zeros((s,) + mb_shape, micro.dtype)
+    outs = jnp.zeros((n_micro,) + mb_shape, micro.dtype)
+
+    def tick(carry, t):
+        buffer, outs = carry
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+        # stage 0 ingests microbatch t; stages 1..s-1 use what arrived
+        inp = pin(buffer.at[0].set(feed))
+        out = pin(vstage(stacked_params, inp))
+        # last stage banks its result at slot t-(s-1) once the fill
+        # phase is over
+        slot = jnp.clip(t - (s - 1), 0, n_micro - 1)
+        prev = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(t >= s - 1, out[s - 1], prev), slot, axis=0)
+        # rotate activations one hop around the stage ring
+        buffer = pin(jnp.roll(out, 1, axis=0))
+        return (buffer, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buffer, outs),
+                            jnp.arange(n_micro + s - 1))
+    return outs
+
+
 @functools.lru_cache(maxsize=64)
 def _pipeline_program(stage_fn: Callable, mesh: Mesh, axis_name: str,
                       s: int, n_micro: int):
     stage_spec = NamedSharding(mesh, P(axis_name))
 
-    def constrain(t):
+    def constrain_tree(t):
         return jax.tree_util.tree_map(
             lambda v: lax.with_sharding_constraint(
                 v, NamedSharding(mesh, P(axis_name))), t)
 
-    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+    def pin(v):
+        return lax.with_sharding_constraint(v, stage_spec)
 
     def run(stacked_params, micro):
         # micro: (n_micro, mb, ...); buffer: (s, mb, ...) — the activation
         # each stage processes this tick, stage axis sharded over the ring
-        stacked_params = constrain(stacked_params)
-        mb_shape = micro.shape[1:]
-        buffer = jnp.zeros((s,) + mb_shape, micro.dtype)
-        outs = jnp.zeros((n_micro,) + mb_shape, micro.dtype)
-
-        def tick(carry, t):
-            buffer, outs = carry
-            feed = lax.dynamic_index_in_dim(
-                micro, jnp.clip(t, 0, n_micro - 1), keepdims=False)
-            # stage 0 ingests microbatch t; stages 1..s-1 use what arrived
-            inp = lax.with_sharding_constraint(
-                buffer.at[0].set(feed), stage_spec)
-            out = lax.with_sharding_constraint(
-                vstage(stacked_params, inp), stage_spec)
-            # last stage banks its result at slot t-(s-1) once the fill
-            # phase is over
-            slot = jnp.clip(t - (s - 1), 0, n_micro - 1)
-            prev = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
-            outs = lax.dynamic_update_index_in_dim(
-                outs, jnp.where(t >= s - 1, out[s - 1], prev), slot, axis=0)
-            # rotate activations one hop around the stage ring
-            buffer = lax.with_sharding_constraint(
-                jnp.roll(out, 1, axis=0), stage_spec)
-            return (buffer, outs), None
-
-        (_, outs), _ = lax.scan(tick, (buffer, outs),
-                                jnp.arange(n_micro + s - 1))
-        return outs
+        stacked_params = constrain_tree(stacked_params)
+        return gpipe_scan(stage_fn, stacked_params, micro, constrain=pin)
 
     return jax.jit(run)
 
@@ -96,23 +158,27 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, n_micro: int,
     """Run x (batch, ...) through S pipelined stages, microbatched.
 
     ``stacked_params`` leaves have leading dim S == mesh.shape[axis_name];
-    batch must divide n_micro. Output matches running the stages
-    sequentially (tested), with stage weights resident on separate devices.
+    a batch not divisible by ``n_micro`` pads the last microbatch by
+    repeating the final row (the padded rows are sliced off the result —
+    the r8 pad-don't-raise stance; training losses weight them 0 via the
+    pipelined trainer). Output matches running the stages sequentially
+    (tested), with stage weights resident on separate devices.
     """
     s = int(mesh.shape[axis_name])
     b = x.shape[0]
-    if b % n_micro:
-        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
     leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
     if leading != {s}:
         raise ValueError(
             f"stacked_params leading dim(s) {sorted(leading)} must equal the "
             f"{axis_name!r} mesh axis size {s} (one stage per device)")
-    mb = b // n_micro
+    pad = (n_micro - b % n_micro) % n_micro
+    if pad:
+        x = jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)], axis=0)
+    mb = (b + pad) // n_micro
     micro = x.reshape(n_micro, mb, *x.shape[1:])
     outs = _pipeline_program(stage_fn, mesh, axis_name, s,
                              int(n_micro))(stacked_params, micro)
-    return outs.reshape(b, *x.shape[1:])
+    return outs.reshape(b + pad, *x.shape[1:])[:b]
 
 
 def sequential_reference(stage_fn: Callable, params_list: Sequence, x):

@@ -96,10 +96,13 @@ class ParallelWrapper:
         self.skew_every = skew_every
         self.zero_optimizer = zero_optimizer
         self.deterministic = deterministic
-        if deterministic and (mesh.model != 1 or mesh.seq != 1):
+        if deterministic and (mesh.model != 1 or mesh.seq != 1
+                              or mesh.pipe != 1):
             raise ValueError(
                 "deterministic lane mode is a data-parallel contract; use a "
-                "data-only mesh (model=seq=1)")
+                "data-only mesh (model=seq=pipe=1). PipelinedTrainer is "
+                "deterministic by construction — its pipe contract is "
+                "documented separately (docs/DISTRIBUTED.md)")
         # lane count: fixed at construction so a fit is reproducible across
         # device counts (pass the same replicas on every topology)
         self.replicas = int(replicas if replicas is not None else mesh.data)
@@ -619,13 +622,20 @@ class ParallelWrapper:
             self.compression_stats()
 
     # ------------------------------------------------------- layout plumbing
+    def _publish_mesh_gauges(self):
+        """One gauge per canonical mesh axis — the ONE loop shared with the
+        pipelined trainer's layout publisher, so a future axis cannot be
+        threaded into one and silently missed in the other."""
+        mesh = self.mesh
+        for axis in TrainingMesh.AXES:
+            tm.gauge("parallel.mesh_axis_size", getattr(mesh, axis),
+                     axis=axis)
+
     def _publish_layout(self):
         """Telemetry gauges + the per-leaf layout table (satellite:
         telemetry reports per-device layouts; docs/OBSERVABILITY.md)."""
         mesh = self.mesh
-        for axis, size in (("data", mesh.data), ("model", mesh.model),
-                           ("seq", mesh.seq)):
-            tm.gauge("parallel.mesh_axis_size", size, axis=axis)
+        self._publish_mesh_gauges()
         frac = (gspmd.sharded_fraction(self._zero_specs)
                 if self._zero_specs is not None else 0.0)
         tm.gauge("parallel.zero_state_sharded_fraction", frac)
@@ -681,13 +691,15 @@ class ParallelWrapper:
             # re-derive from the CURRENT device view (after worker loss the
             # survivors), keeping the model/seq factors when they still fit
             devices = jax.devices()
-            model_ax, seq_ax = self.mesh.model, self.mesh.seq
-            if len(devices) % (model_ax * seq_ax):
-                model_ax = seq_ax = 1
+            model_ax, seq_ax, pipe_ax = (self.mesh.model, self.mesh.seq,
+                                         self.mesh.pipe)
+            if len(devices) % (model_ax * seq_ax * pipe_ax):
+                model_ax = seq_ax = pipe_ax = 1
             mesh = TrainingMesh(
-                data=len(devices) // (model_ax * seq_ax),
-                model=model_ax, seq=seq_ax, devices=devices)
-        if self.deterministic and (mesh.model != 1 or mesh.seq != 1):
+                data=len(devices) // (model_ax * seq_ax * pipe_ax),
+                model=model_ax, seq=seq_ax, pipe=pipe_ax, devices=devices)
+        if self.deterministic and (mesh.model != 1 or mesh.seq != 1
+                                   or mesh.pipe != 1):
             raise ValueError("deterministic lane mode needs a data-only mesh")
         self.mesh = mesh
         self._sharded_step = None

@@ -648,6 +648,53 @@ class CompressionHostsSpace(SearchSpace):
         return out
 
 
+class PipeScheduleSpace(SearchSpace):
+    """Pipeline-schedule candidates for the 3D-parallel trainer
+    (parallel/pipelined.py, docs/DISTRIBUTED.md#pipeline-parallelism):
+    microbatch counts (the bubble-vs-activation-memory dial — bubble
+    fraction (S-1)/(n_micro+S-1) shrinks as n_micro grows while live
+    activations grow) × the schedule family (the implemented GPipe
+    fill-drain scan vs a 1F1B interleave candidate). On this CPU the
+    bubble is arithmetic, not wall-clock — CPU proves the schedules
+    EQUIVALENT (trajectory tests) and computes their bubble fractions,
+    but cannot rank bubble cost against per-microbatch dispatch overhead
+    or remat recompute; and 1F1B's payoff is live-activation memory that
+    only a real HBM budget prices. The first chip session measures steps
+    of the real pipelined fit per candidate (1f1b additionally needs the
+    interleaved variant implemented behind the same gpipe_scan seam)."""
+
+    name = "pipe_schedule"
+    op = "pipe_schedule"
+    scope = "conf"
+    measurable = False
+    requires = ("real TPU wall-clock + HBM budget (CPU proves schedule "
+                "equivalence and computes bubble fractions, cannot rank "
+                "bubble vs dispatch/remat cost; 1f1b candidates also need "
+                "the interleaved scan variant on chip)")
+
+    def signature(self, ctx: dict) -> str:
+        s = int(ctx.get("pipe_stages", 2))
+        return f"stages={s}"
+
+    def dtype(self, ctx: dict) -> str:
+        return "any"
+
+    def enumerate(self, ctx: dict) -> List[Candidate]:
+        from deeplearning4j_tpu.parallel.pipeline import bubble_fraction
+
+        s = int(ctx.get("pipe_stages", 2))
+        out = []
+        for sched in ("gpipe", "1f1b"):
+            for mult in (1, 2, 4, 8):
+                n_micro = s * mult
+                out.append(Candidate(
+                    f"{sched}:m{n_micro}", impl="conf",
+                    params={"pipe_schedule": sched, "n_micro": n_micro,
+                            "bubble_fraction": bubble_fraction(s, n_micro)},
+                    is_default=(sched == "gpipe" and mult == 1)))
+        return out
+
+
 # ------------------------------------------------------- default wiring
 register_space(ConvTileSpace())
 register_space(LstmTileSpace())
@@ -655,3 +702,4 @@ register_space(RematPolicySpace())
 register_space(XlaFlagsSpace())
 register_space(BucketSetSpace())
 register_space(CompressionHostsSpace())
+register_space(PipeScheduleSpace())

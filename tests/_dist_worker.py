@@ -207,7 +207,103 @@ def _elastic_compress(shared_dir, pid, world, sigkill_at=None):
     }), flush=True)
 
 
+def _pipe(shared_dir, pid, world, sigkill_at=None):
+    """``--pipe`` mode: one member of a supervised elastic pod whose data
+    plane is the PIPELINED trainer (parallel/pipelined.py) — stacked stage
+    params/optimizer state, GPipe microbatch schedule, lane-decomposed DP.
+    Proves the stacked stage state rides the elastic machinery: a
+    SIGKILLed peer's loss regroups the survivor (reshard() syncs the
+    stacked state through model layout and re-places it), and the final
+    checkpoint restores BIT-exactly at the boundary (the restored net's
+    re-stacked pipeline state is bit-compared in-process against the live
+    trainer's)."""
+    import os
+
+    from deeplearning4j_tpu.data import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel import (ElasticTrainer, FileMembership,
+                                             PipelinedTrainer, TrainingMesh)
+    from deeplearning4j_tpu.util.faults import SIGKILL_HOST, get_injector
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+                .pipe_stages(2).n_micro(2)
+                .list()
+                .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+                .stage_boundary()
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+                .stage_boundary()
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+                .stage_boundary()
+                .layer(OutputLayer(n_in=16, n_out=4, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def build_trainer(net):
+        return PipelinedTrainer(
+            net, mesh=TrainingMesh(data=len(jax.devices())),
+            replicas=2, skew_every=0)
+
+    net = build_net()
+    pt = build_trainer(net)
+    rng = np.random.default_rng(0)  # same data recipe on every member
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    it = ArrayDataSetIterator(xs, ys, batch=8)  # 8 batches / epoch
+
+    if sigkill_at is not None:
+        get_injector().inject(SIGKILL_HOST, at_step=sigkill_at)
+    membership = FileMembership(
+        os.path.join(shared_dir, "membership"), process_id=pid,
+        world_size=world, heartbeat_interval=0.3, miss_threshold=8,
+        barrier_timeout=90.0, log_fn=None)
+    trainer = ElasticTrainer(
+        pt, os.path.join(shared_dir, f"ckpt-{pid}"), checkpoint_every=4,
+        membership=membership, log_fn=None)
+    trainer.fit(it, epochs=3)
+
+    # the final (blocking, synced) checkpoint must restore the STACKED
+    # stage state bit-exactly: restore into a fresh net, re-stack through
+    # a fresh trainer, and compare every placed leaf
+    net2 = build_net()
+    trainer.ckpt.restore(net2)
+    pt2 = build_trainer(net2)
+    pt2._build()
+    pt.sync_model()  # no-op value-wise (fit already synced at checkpoint)
+    live = jax.tree_util.tree_leaves(
+        {"params": pt._pp["params"], "opts": pt._pp["opts"]})
+    restored = jax.tree_util.tree_leaves(
+        {"params": pt2._pp["params"], "opts": pt2._pp["opts"]})
+    stacked_exact = (
+        len(live) == len(restored)
+        and all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(live, restored)))
+
+    view = membership.view
+    print(json.dumps({
+        "pid": pid,
+        "state": trainer.state,
+        "iteration": net.iteration,
+        "epoch": net.epoch,
+        "world_final": view.world if view else None,
+        "members_final": list(view.members) if view else None,
+        "regroups": membership.regroups,
+        "score_finite": bool(np.isfinite(float(net.score_value))),
+        "stacked_exact": bool(stacked_exact),
+        "pipe_stages": pt.pipe_stages,
+        "bubble_fraction": pt.bubble_fraction,
+    }), flush=True)
+
+
 def main():
+    if sys.argv[1] == "--pipe":
+        _pipe(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+              int(sys.argv[5]) if len(sys.argv) > 5 else None)
+        return
     if sys.argv[1] == "--elastic":
         _elastic(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
                  int(sys.argv[5]) if len(sys.argv) > 5 else None)

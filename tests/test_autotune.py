@@ -151,9 +151,14 @@ class TestRegistry:
 
 
 class TestDriverGates:
+    @pytest.mark.slow
     def test_planted_slow_candidate_loses(self, db):
         """A config handicapped by a per-call sleep must demonstrably
-        LOSE the sweep — the gate that proves measurements rank."""
+        LOSE the sweep — the gate that proves measurements rank.
+
+        slow-marked (r19 tier-1 budget): the same planted-slow gate runs
+        against the real sweep in benchmarks/autotune_smoke.py on EVERY
+        CI pass."""
         drv = _driver(db)
         entry = drv.sweep(tuning.get_space("conv2d_tiles"), TINY_CONV,
                           handicap={"exact": 0.05})
@@ -163,9 +168,13 @@ class TestDriverGates:
         assert rows["exact"]["admitted"]            # slow, but correct
         assert rows["exact"]["ms"] > entry["winner"]["ms"]
 
+    @pytest.mark.slow
     def test_planted_wrong_output_rejected(self, db):
         """A candidate whose outputs diverge from the exact path must be
-        REJECTED by the equivalence gate — and never timed."""
+        REJECTED by the equivalence gate — and never timed.
+
+        slow-marked (r19 tier-1 budget): the planted-wrong rejection also
+        runs in benchmarks/autotune_smoke.py on EVERY CI pass."""
         drv = _driver(db)
         m0 = _counter("tuning.measurements_total")
         r0 = _counter("tuning.equivalence_rejects_total")
@@ -361,10 +370,15 @@ class TestDatabase:
 
 
 class TestAutoDispatch:
+    @pytest.mark.slow
     def test_auto_resolves_winner_through_db(self, db, monkeypatch):
         """kernel_impl=auto consults the database: a committed pallas
         winner (with its tile) engages the kernel on the exact geometry,
-        and the output still matches the exact path."""
+        and the output still matches the exact path.
+
+        slow-marked (r19 tier-1 budget): auto-dispatch resolving through
+        an armed DB is asserted by benchmarks/autotune_smoke.py on EVERY
+        CI pass (tuning.hits_total > 0 + tuned == exact)."""
         monkeypatch.delenv("DL4J_TPU_KERNEL_IMPL", raising=False)
         from deeplearning4j_tpu.ops import nn as nnops
 

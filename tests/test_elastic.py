@@ -569,6 +569,45 @@ class TestFaultRecoveryPaths:
         assert r["wire_bytes"] and r["wire_bytes"] > 0
         assert r["threshold"] and r["threshold"] > 0
 
+    @pytest.mark.slow
+    def test_sigkill_with_pipelined_trainer_restores_stacked_state(
+            self, tmp_path):
+        """Elastic × pipeline (ISSUE 14 satellite): the 2-process SIGKILL
+        scenario with the PIPELINED trainer as the data plane — stacked
+        stage params/optimizer state, GPipe microbatch schedule, lane DP.
+        The survivor regroups and keeps training (same 4+8+8 iteration
+        trace as the plain legs — reshard() migrated the stacked state
+        through model layout bit-exactly), and the final checkpoint
+        restores the STACKED stage state bit-exactly at the boundary
+        (compared in-process against the live trainer's placed leaves)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        env.pop("XLA_FLAGS", None)
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_dist_worker.py")
+        d = str(tmp_path / "pod")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, "--pipe", d, str(pid), "2"]
+            + (["2"] if pid == 1 else []),  # pid 1 SIGKILLs itself at step 2
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        out0, err0 = procs[0].communicate(timeout=240)
+        out1, _ = procs[1].communicate(timeout=240)
+        assert procs[1].returncode == -signal.SIGKILL
+        assert not out1.strip()
+        assert procs[0].returncode == 0, err0[-1500:]
+        r = json.loads([l for l in out0.splitlines()
+                        if l.startswith("{")][-1])
+        assert r["state"] == "completed"
+        assert r["world_final"] == 1 and r["members_final"] == [0]
+        assert r["regroups"] >= 1
+        assert r["epoch"] == 3 and r["score_finite"]
+        assert r["iteration"] == 4 + 8 + 8  # same trace as the plain leg
+        assert r["stacked_exact"], r  # checkpoint carried the stacked state
+        assert r["pipe_stages"] == 2
+        assert 0 < r["bubble_fraction"] < 1
+
 
 def _slow_double(v):
     time.sleep(0.005)  # keep workers alive long enough to be killed

@@ -39,7 +39,10 @@ def test_mesh_construction(devices):
     m = TrainingMesh(data=8)
     assert m.n_devices == 8
     m2 = TrainingMesh(data=4, model=2)
-    assert m2.mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    assert m2.mesh.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
+    m3 = TrainingMesh(data=2, model=2, pipe=2)
+    assert m3.mesh.shape == {"data": 2, "model": 2, "seq": 1, "pipe": 2}
+    assert m3.n_devices == 8
     with pytest.raises(ValueError):
         TrainingMesh(data=16)
 
