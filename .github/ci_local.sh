@@ -103,6 +103,16 @@ echo "== step: Resilience smoke (reload storm + fault recoveries + brownout) =="
 # exhaustion -> batch-lane brownout while interactive serves, clean drain.
 JAX_PLATFORMS=cpu python benchmarks/resilience_smoke.py
 
+echo "== step: Decode smoke (paged KV + speculative + int8 over HTTP) =="
+# ISSUE 15: the planet-scale decode path on real HTTP — mixed-length
+# paged+speculative traffic TOKEN-IDENTICAL to the non-speculative greedy
+# reference with 0 steady-state recompiles, pool exhaustion -> first-class
+# 429 + Retry-After + pool_exhausted flight cause + block reuse after the
+# shed, paged concurrent streams beating the contiguous-cache ceiling,
+# int8 serving alongside fp32 (resident + archive bytes >= 3.5x below
+# fp32, gauge-asserted), spec_accept_rate/draft_accept_rate surfaces.
+JAX_PLATFORMS=cpu python benchmarks/decode_smoke.py
+
 echo "== step: Kernel-engine equivalence (Pallas interpret, fused optimizer) =="
 # ISSUE 9: the hot-path kernel suite with the dispatch knob FORCED to
 # pallas — off-TPU that is the Pallas interpreter, bit-faithful to the

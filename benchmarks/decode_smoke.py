@@ -1,0 +1,261 @@
+"""Decode-path smoke check (the ISSUE 15 CI leg, wired in ci.yml/ci_local.sh).
+
+End-to-end proof of the paged-KV + speculative + int8 acceptance criteria
+on a real HTTP server:
+
+1. boot a :class:`ModelServer` with FOUR decoders sharing one set of
+   trained weights — ``bert-spec`` (paged + speculative, draft loaded
+   from its own archive via ``router.load(draft_path=…)``), ``bert-fp32``
+   (paged, no speculation), ``bert-int8`` (weight-only int8 loaded from
+   an int8 ModelSerializer archive), ``bert-tiny-pool`` (a deliberately
+   undersized block pool) — warm every bucket executable;
+2. fire MIXED-LENGTH paged+speculative traffic (prompt lengths crossing
+   page boundaries) through real HTTP and assert every speculative
+   response is TOKEN-IDENTICAL to the local non-speculative greedy
+   reference — and that the steady-state ``serving.recompiles_total``
+   delta is exactly 0 (ONE decode executable serves every context
+   length, CompileWatcher-asserted);
+3. pool exhaustion is a first-class shed: an over-pool request answers
+   HTTP 429 + Retry-After, the flight-recorder dump carries the
+   ``pool_exhausted`` cause, and the freed pool serves the next request;
+4. int8 serving alongside fp32: the int8 model answers the same prompts
+   (tokens may legitimately differ — the contract is the pinned logit
+   tolerance, pinned in tests/test_paged_decode.py), its resident-bytes
+   gauge shows ≥3.5× below the fp32 equivalent on /metrics, and the
+   fp32 model's responses stay bit-identical to the local reference;
+5. speculation observability: ``serving.spec_accept_rate`` on /metrics,
+   ``draft_accept_rate`` on the flight-recorder records, and
+   ``concurrent_streams`` beating the contiguous-cache ceiling on the
+   pool stats (/v1/models).
+
+Exit 0 on success, 1 with a FAIL line on any violated check.
+
+    JAX_PLATFORMS=cpu python benchmarks/decode_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILED = []
+
+VOCAB = 48
+MAXLEN = 32
+
+
+def check(name: str, ok: bool, detail: str = ""):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def http_get(url: str):
+    try:
+        r = urllib.request.urlopen(url, timeout=30)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def http_post(url: str, obj: dict):
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=120)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def build(tmp):
+    import numpy as np  # noqa: F401
+
+    from deeplearning4j_tpu.serving import (Generator, ModelRouter,
+                                            ModelServer, ServingModel)
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.bert import Bert
+
+    net = Bert.tiny(causal=True, task="mlm", vocab_size=VOCAB,
+                    max_length=MAXLEN, hidden_dropout=0.0).init()
+    draft = Bert.draft(vocab_size=VOCAB, max_length=MAXLEN).init()
+    fp32_zip = os.path.join(tmp, "bert.zip")
+    int8_zip = os.path.join(tmp, "bert-int8.zip")
+    draft_zip = os.path.join(tmp, "draft.zip")
+    ModelSerializer.write_model(net, fp32_zip, save_updater=False)
+    ModelSerializer.write_model(net, int8_zip, quantize="int8")
+    ModelSerializer.write_model(draft, draft_zip, save_updater=False)
+
+    buckets = "batch=1,2,4;seq=8,16"
+    router = ModelRouter(name="decode-smoke")
+    # speculative target: the draft rides in from its own archive —
+    # "loaded per-model via the router" (ISSUE 15 tentpole)
+    router.load("bert-spec", fp32_zip, kind="generate", bucketing=buckets,
+                block_size=4, draft_path=draft_zip, spec_tokens=3)
+    router.load("bert-fp32", fp32_zip, kind="generate", bucketing=buckets,
+                block_size=4)
+    router.load("bert-int8", int8_zip, kind="generate", bucketing=buckets,
+                block_size=4, quantize="int8")
+    # 24 blocks of 4 = 96 slots: contiguous ceiling 96//32 = 3 streams,
+    # but a 4-stream short-prompt batch fits paged (the beats-the-ceiling
+    # check); a long-prompt flood exhausts it (the 429 check)
+    router.register(ServingModel(net, "bert-tiny-pool", kind="generate",
+                                 bucketing=buckets, block_size=4,
+                                 pool_blocks=24),
+                    max_wait_ms=1.0, queue_limit=64)
+    server = ModelServer(router, port=0).start()  # warms every bucket
+
+    # local greedy reference on the same weights: the token-identity oracle
+    ref_gen = Generator(net, paged=False, batch_buckets=(1, 2, 4),
+                        prefill_buckets=(8, 16))
+    return server, router, ref_gen, fp32_zip, int8_zip
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DL4J_TPU_TRACE_SAMPLE", "1")
+    import numpy as np
+
+    from deeplearning4j_tpu.util import telemetry as tm
+
+    tmp = tempfile.mkdtemp(prefix="decode-smoke-")
+    print("== decode smoke: paged KV + speculative + int8 over HTTP ==")
+    t0 = time.time()
+    server, router, ref_gen, fp32_zip, int8_zip = build(tmp)
+    print(f"  server up on {server.url} ({time.time() - t0:.1f}s incl. warm)")
+
+    rng = np.random.default_rng(0)
+    # mixed context lengths crossing page boundaries (block_size=4)
+    prompts = [list(map(int, rng.integers(1, VOCAB, size=n)))
+               for n in (2, 3, 5, 7, 9, 13, 17, 21)]
+    ref = ref_gen.generate(prompts, max_new_tokens=6)
+
+    def _rec():
+        tele = tm.get_telemetry()
+        return sum(v for (n, _l), v in tele.counters.items()
+                   if n == "serving.recompiles_total")
+
+    rec_before = _rec()
+
+    # -- 2: concurrent mixed-length speculative traffic, token identity
+    results = [None] * len(prompts)
+
+    def fire(i):
+        results[i] = http_post(
+            f"{server.url}/v1/models/bert-spec/generate",
+            {"prompt_tokens": [prompts[i]], "max_new_tokens": 6,
+             "lane": "batch"})
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    ok_all = all(r is not None and r[0] == 200 for r in results)
+    check("all speculative requests answered 200", ok_all)
+    if ok_all:
+        got = [r[1]["tokens"][0] for r in results]
+        check("speculative HTTP decode TOKEN-IDENTICAL to greedy reference",
+              got == ref, f"{sum(a == b for a, b in zip(got, ref))}/"
+              f"{len(ref)} rows match")
+    code, body, _ = http_post(
+        f"{server.url}/v1/models/bert-fp32/generate",
+        {"prompt_tokens": prompts, "max_new_tokens": 6})
+    check("fp32 paged decode bit-identical to reference",
+          code == 200 and body.get("tokens") == ref)
+    check("steady-state decode recompiles == 0", _rec() - rec_before == 0,
+          f"delta {_rec() - rec_before}")
+
+    # -- 3: pool exhaustion = first-class 429 shed; blocks free + reuse
+    long_prompt = list(map(int, rng.integers(1, VOCAB, size=20)))
+    code, body, headers = http_post(
+        f"{server.url}/v1/models/bert-tiny-pool/generate",
+        {"prompt_tokens": [long_prompt] * 8, "max_new_tokens": 8})
+    check("pool exhaustion answers 429", code == 429, f"code {code}")
+    check("pool-exhausted shed carries Retry-After",
+          headers.get("Retry-After") is not None)
+    check("shed error names PoolExhaustedError",
+          body.get("error") == "PoolExhaustedError", str(body)[:100])
+    code, dump = http_get(
+        f"{server.url}/v1/models/bert-tiny-pool/debug/requests")
+    causes = [r.get("cause") for r in json.loads(dump).get("requests", [])]
+    check("flight recorder carries the pool_exhausted cause",
+          "pool_exhausted" in causes, str(causes[-4:]))
+    code, body, _ = http_post(
+        f"{server.url}/v1/models/bert-tiny-pool/generate",
+        {"prompt_tokens": [prompts[0], prompts[1], prompts[2],
+                           prompts[0]], "max_new_tokens": 4})
+    check("freed pool serves the next batch (block reuse after shed)",
+          code == 200 and len(body.get("tokens", [])) == 4)
+    model, _s = router.get("bert-tiny-pool")
+    pool = model.generator.pool
+    check("paged streams beat the contiguous-cache ceiling",
+          pool.peak_streams > pool.contiguous_stream_ceiling(),
+          f"peak {pool.peak_streams} > ceiling "
+          f"{pool.contiguous_stream_ceiling()}")
+
+    # -- 4: int8 alongside fp32
+    code, body, _ = http_post(
+        f"{server.url}/v1/models/bert-int8/generate",
+        {"prompt_tokens": prompts[:4], "max_new_tokens": 6})
+    check("int8 model serves the same traffic", code == 200
+          and len(body.get("tokens", [])) == 4)
+    m8, _s8 = router.get("bert-int8")
+    qp = m8.generator._qp
+    check("int8 resident bytes >= 3.5x below fp32",
+          qp is not None and qp.fp32_bytes() / qp.resident_bytes() >= 3.5,
+          f"ratio {qp.fp32_bytes() / qp.resident_bytes():.2f}" if qp
+          else "no qp")
+    check("int8 archive >= 3.5x below fp32 archive",
+          os.path.getsize(fp32_zip) / os.path.getsize(int8_zip) >= 3.5,
+          f"ratio "
+          f"{os.path.getsize(fp32_zip) / os.path.getsize(int8_zip):.2f}")
+    code, metrics = http_get(f"{server.url}/metrics")
+    check("/metrics carries the resident-weight-bytes gauge",
+          "serving_weight_bytes" in metrics)
+
+    # -- 5: speculation observability
+    check("/metrics carries serving_spec_accept_rate",
+          "serving_spec_accept_rate" in metrics)
+    check("/metrics carries the KV-pool gauges",
+          "serving_kv_pool_blocks_free" in metrics
+          and "serving_concurrent_streams" in metrics)
+    code, dump = http_get(
+        f"{server.url}/v1/models/bert-spec/debug/requests")
+    recs = json.loads(dump).get("requests", [])
+    ok_recs = [r for r in recs if r.get("status") == "ok"]
+    check("flight records carry draft_accept_rate",
+          any("draft_accept_rate" in r for r in ok_recs),
+          f"{len(ok_recs)} ok records")
+    status = router.status()
+    spec = status["models"]["bert-spec"].get("speculative")
+    check("/v1/models describes the speculative config",
+          spec is not None and spec.get("spec_tokens") == 3)
+    check("/v1/models describes the KV pool",
+          "kv_pool" in status["models"]["bert-fp32"])
+
+    server.stop()
+    print(f"== {'PASS' if not _FAILED else 'FAIL'} "
+          f"({time.time() - t0:.1f}s, {len(_FAILED)} failed) ==")
+    if _FAILED:
+        print("failed checks:", ", ".join(_FAILED))
+    return 1 if _FAILED else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,15 @@ LOWER_BETTER = {
     "pipeline_bubble_fraction",
 }
 
+# The decode-path metrics (ISSUE 15, BENCH_r11 headline) gate through the
+# higher-is-better default: concurrent_streams_per_device is deterministic
+# block accounting of the paged KV pool (±0% — a drop means the pool
+# stopped paging, gated ABOVE the contiguous-cache ceiling by its
+# vs_baseline ratio), and speculative_decode_tokens_per_sec pins the
+# spec-path throughput with the honest CPU A/B in the record note (a
+# random-init draft accepts ~nothing on CPU; the metric exists so the
+# machinery cannot silently regress, not to rank the chip-side win).
+
 # Metrics a candidate run may NEVER drop (missing == fail even without
 # --strict): the scaling-efficiency number is the r12 GSPMD rewrite's
 # contract — a run that silently stops reporting it would let efficiency

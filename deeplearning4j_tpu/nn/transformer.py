@@ -89,6 +89,17 @@ class BertEmbeddingLayer(Layer):
              + params["type"][0])
         return _layer_norm(h, params["gamma"], params["beta"])
 
+    def embed_window(self, params, tokens, positions):
+        """Windowed decode embedding: ``tokens`` (B, W) ids at per-row
+        ``positions`` (B, W) → (B, W, H). The speculative-decoding verify
+        window (serving/generate.py): the same word+pos+type-0 sum and
+        LayerNorm as :meth:`embed_step`, so every window token embeds
+        exactly as it would one step at a time."""
+        h = (jnp.take(params["word"], tokens.astype(jnp.int32), axis=0)
+             + jnp.take(params["pos"], positions.astype(jnp.int32), axis=0)
+             + params["type"][0])
+        return _layer_norm(h, params["gamma"], params["beta"])
+
     def output_shape(self, input_shape):
         return (input_shape[0], self.hidden_size)
 
@@ -244,6 +255,78 @@ class TransformerEncoderBlock(Layer):
         amask = None if mask is None else mask[:, None, None, :].astype(bool)
         o = attn_ops.dot_product_attention(q, k, v, mask=amask, causal=True)
         return self._finish(params, x, self._proj_out(params, o)), cache
+
+    # ------------------------------------------------- paged KV-cache path
+    # Serving substrate for the paged/block pool (serving/paged.py): the
+    # K/V of EVERY stream live in one slot-flat pool per layer — shape
+    # (S, H, Dh) with S = num_blocks * block_size — and each stream's page
+    # table expands to per-position slot indices (``slots``, width
+    # max_length, sliced by the generator). Projections, sublayer math and
+    # the attention mask are the SAME code the contiguous path runs, and
+    # the gathered (B, H, max_length, Dh) layout matches the contiguous
+    # cache exactly, so paged decode is BIT-identical to contiguous decode
+    # (tests/test_paged_decode.py).
+
+    def init_pool(self, num_slots: int, dtype=jnp.float32):
+        """Empty slot-flat K/V pool for this layer: (S, H, Dh) each. Two
+        DISTINCT buffers — the pools are donated through the decode
+        executables, and aliased k/v would be the same buffer donated
+        twice."""
+        dh = self.hidden_size // self.n_heads
+        shape = (num_slots, self.n_heads, dh)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _pool_write(self, pool, slots_flat, k, v):
+        """Scatter (N, H, Dh) K/V rows at flat slot indices (N,). Trash-
+        block collisions (padding writes) are garbage-on-garbage — every
+        read is position-masked before the softmax."""
+        return {
+            "k": pool["k"].at[slots_flat].set(k.astype(pool["k"].dtype)),
+            "v": pool["v"].at[slots_flat].set(v.astype(pool["v"].dtype)),
+        }
+
+    def prefill_paged(self, params, x, pool, slots, mask=None):
+        """Causal forward over the prompt (B,T,H), scattering each
+        position's K/V into the paged ``pool`` at ``slots`` (B,T) —
+        positions outside a stream's reservation point at the trash block.
+        The attention itself runs over the in-register q/k/v exactly like
+        :meth:`prefill`, so the hidden states (and therefore the prompt's
+        next-token logits) are bit-identical to the contiguous prefill."""
+        if not self.causal:
+            raise ValueError("prefill/decode_step need causal=True blocks")
+        b, t, _ = x.shape
+        q, k, v = self._qkv(params, self._attn_input(params, x))
+        rows = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * t, self.n_heads, -1)
+        vrows = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * t, self.n_heads, -1)
+        pool = self._pool_write(pool, slots.reshape(-1), rows, vrows)
+        amask = None if mask is None else mask[:, None, None, :].astype(bool)
+        o = attn_ops.dot_product_attention(q, k, v, mask=amask, causal=True)
+        return self._finish(params, x, self._proj_out(params, o)), pool
+
+    def decode_window_paged(self, params, x_w, pool, slots, positions,
+                            limits=None):
+        """W autoregressive steps in ONE call: ``x_w`` (B, W, H) are the
+        window tokens' hidden states at per-row ``positions`` (B, W).
+        Writes the window's K/V at each token's slot, then attends every
+        window query over ``k_pos <= position`` through the page table —
+        W=1 is the plain paged decode step; W>1 is the speculative-decode
+        verify window (each query attends the window tokens before it plus
+        the whole committed prefix, exactly the sequential-step semantics).
+        ``limits`` (B,): each stream's last valid position — writes past it
+        (a finished row riding a still-decoding batch, or a verify window
+        overhanging a stream's final token) redirect to the trash block so
+        they can never clobber a live slot. Returns (out (B, W, H), pool)."""
+        b, w, _ = x_w.shape
+        q, k, v = self._qkv(params, self._attn_input(params, x_w))
+        wslots = jnp.take_along_axis(slots, positions, axis=1)  # (B, W)
+        if limits is not None:
+            wslots = jnp.where(positions <= limits[:, None], wslots, 0)
+        rows = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * w, self.n_heads, -1)
+        vrows = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * w, self.n_heads, -1)
+        pool = self._pool_write(pool, wslots.reshape(-1), rows, vrows)
+        o = attn_ops.paged_attention(q, pool["k"], pool["v"], slots,
+                                     positions)
+        return self._finish(params, x_w, self._proj_out(params, o)), pool
 
     def decode_step(self, params, x_t, cache, positions):
         """One autoregressive step: ``x_t`` (B,1,H) is the new token's

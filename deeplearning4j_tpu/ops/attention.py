@@ -496,3 +496,41 @@ def multi_head_dot_product_attention(
             amask = mask[:, None, None, :] if mask.ndim == 2 else mask
         o = dot_product_attention(q, k, v, mask=amask, scale=scale, causal=causal)
     return _merge_heads(o) @ Wo
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-cache attention (serving/paged.py substrate)
+# ---------------------------------------------------------------------------
+
+
+def paged_kv_gather(pool, slots):
+    """Gather per-stream K or V rows out of a slot-flat block pool.
+
+    ``pool``: (S, H, Dh) — every block's token slots for ONE layer,
+    flattened to ``S = num_blocks * block_size`` rows (block b's tokens
+    live at slots ``[b*block_size, (b+1)*block_size)``). ``slots``:
+    (B, L) int32 — each stream's page table expanded to a flat slot index
+    per logical position (unallocated positions point into the reserved
+    trash block; the caller's position mask keeps them out of every
+    softmax). Returns (B, H, L, Dh) — the same logical [batch, heads,
+    positions, head_dim] layout a contiguous cache holds, so the exact
+    attention math downstream is IDENTICAL to the contiguous path
+    (the paged==contiguous token-identity contract, docs/SERVING.md)."""
+    return jnp.transpose(pool[slots], (0, 2, 1, 3))
+
+
+def paged_attention(q, k_pool, v_pool, slots, positions, scale=None):
+    """One decode/verify attention over a paged KV pool.
+
+    ``q``: (B, H, W, Dh) — W query tokens per stream (1 for plain decode,
+    the speculation window for verify). ``positions``: (B, W) int32 — the
+    logical position of each query token; key position ``p`` is attended
+    iff ``p <= positions[b, w]`` (the causal-over-cache rule, identical to
+    the contiguous ``decode_step``). Gathers via :func:`paged_kv_gather`
+    and runs the exact :func:`dot_product_attention` — softmax inputs for
+    every unmasked position are bit-identical to the contiguous path."""
+    kk = paged_kv_gather(k_pool, slots)
+    vv = paged_kv_gather(v_pool, slots)
+    amask = (jnp.arange(kk.shape[2])[None, None, :]
+             <= positions[:, :, None])[:, None]  # (B, 1, W, L)
+    return dot_product_attention(q, kk, vv, mask=amask, scale=scale)

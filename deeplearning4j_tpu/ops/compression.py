@@ -144,6 +144,52 @@ def bitmap_decode(packed, threshold, shape):
     return jnp.where(flat == 1, t, jnp.where(flat == 2, -t, 0.0)).reshape(shape)
 
 
+# ------------------------------------------- weight-only int8 (serving)
+
+
+@op("quantize_per_channel", "compression")
+def quantize_per_channel(x, scale):
+    """Symmetric per-channel int8 quantization: ``round(x / scale)``
+    clipped to [-127, 127] (the cuDNN reduced-precision GEMM framing,
+    arXiv:1410.0759 — narrow symmetric range so dequantize is ONE fused
+    multiply). ``scale`` broadcasts against ``x`` (per-output-channel:
+    shape (1, ..., C)). The serving tier's weight-only int8 path rides
+    this pair (serving/quantize.py; the ONNX Quantize/DequantizeLinear
+    importer rules compose the same math from primitives)."""
+    x = jnp.asarray(x, jnp.float32)
+    s = jnp.where(jnp.asarray(scale, jnp.float32) == 0, 1.0,
+                  jnp.asarray(scale, jnp.float32))
+    q = jnp.clip(jnp.round(x / s), -127.0, 127.0)
+    return q.astype(jnp.int8)
+
+
+@op("dequantize_per_channel", "compression")
+def dequantize_per_channel(q, scale):
+    """Inverse of :func:`quantize_per_channel`: ``q * scale`` in fp32 —
+    the in-forward dequantize the int8 serving executables run (one
+    multiply per weight, fusable into the consuming GEMM)."""
+    return q.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+
+
+def dequantize_np(q, scale) -> np.ndarray:
+    """Host-side twin of :func:`dequantize_per_channel` — THE one
+    symmetric per-channel dequant expression shared by the serializer's
+    int8-archive restore and the serving stash validation, so the scheme
+    can never drift between how archives restore and how serving
+    dequantizes."""
+    return np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+
+
+def channel_scale(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    """Host-side per-channel scale: ``amax(|x|) / 127`` reduced over every
+    axis EXCEPT ``axis``, keepdims (broadcasts straight back against x).
+    Zero channels get scale 1 so dequantize is exact zero."""
+    x = np.asarray(x, np.float32)
+    axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    s = np.amax(np.abs(x), axis=axes, keepdims=True) / 127.0
+    return np.where(s == 0, 1.0, s).astype(np.float32)
+
+
 # ----------------------------------------------------------- host packers
 
 

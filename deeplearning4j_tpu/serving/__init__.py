@@ -3,11 +3,12 @@
 The "millions of users" half of the north star: continuous/dynamic
 batching with deadline-aware priority queues (``scheduler``), multi-model
 multi-tenant routing with per-model admission control (``router``),
-KV-cache autoregressive decode for the transformer stack (``generate``),
-and an HTTP model server with queue-depth-driven load shedding and
-SIGTERM graceful drain (``server``) — all riding the r8 compile-once
-substrate (bucketing + AOT warmup), so steady-state serving performs
-ZERO XLA compiles.
+paged-KV-cache autoregressive decode with speculative decoding and
+weight-only int8 for the transformer stack (``generate``/``paged``/
+``quantize``), and an HTTP model server with queue-depth-driven load
+shedding and SIGTERM graceful drain (``server``) — all riding the r8
+compile-once substrate (bucketing + AOT warmup), so steady-state serving
+performs ZERO XLA compiles.
 
     from deeplearning4j_tpu.serving import (ModelRouter, ModelServer,
                                             ServingModel)
@@ -20,6 +21,9 @@ ZERO XLA compiles.
 
 from deeplearning4j_tpu.serving.generate import Generator
 from deeplearning4j_tpu.serving.model import ServingModel
+from deeplearning4j_tpu.serving.paged import BlockPool, PoolExhaustedError
+from deeplearning4j_tpu.serving.quantize import (INT8_LOGIT_TOL,
+                                                 QuantizedParams)
 from deeplearning4j_tpu.serving.resilience import (BrownoutController,
                                                    BrownoutShedError,
                                                    CircuitBreaker,
@@ -43,6 +47,7 @@ from deeplearning4j_tpu.serving.server import ModelServer
 
 __all__ = [
     "BatchScheduler",
+    "BlockPool",
     "BrownoutController",
     "BrownoutShedError",
     "CircuitBreaker",
@@ -50,9 +55,12 @@ __all__ = [
     "DeadlineExceededError",
     "FlightRecorder",
     "Generator",
+    "INT8_LOGIT_TOL",
     "ModelLoadError",
     "ModelRouter",
     "ModelServer",
+    "PoolExhaustedError",
+    "QuantizedParams",
     "QueueFullError",
     "ReloadRejectedError",
     "SchedulerDrainingError",

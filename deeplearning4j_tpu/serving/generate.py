@@ -1,81 +1,124 @@
-"""KV-cache autoregressive generation for the nlp/transformer stack.
+"""Planet-scale decode path: paged KV cache + speculative decoding + int8.
 
-The decode tier of the model server (docs/SERVING.md): a decoder-only LM
-built from the native transformer layers (``BertEmbeddingLayer`` →
-``TransformerEncoderBlock(causal=True)``× N → ``RnnOutputLayer``, e.g.
-``zoo.bert.Bert(causal=True, task="mlm")``) is served with TWO compiled
-programs instead of one quadratic recompute per token:
+The decode tier of the model server (docs/SERVING.md#paged-kv--speculative-
+decode): a decoder-only LM built from the native transformer layers
+(``BertEmbeddingLayer`` → ``TransformerEncoderBlock(causal=True)`` × N →
+``RnnOutputLayer``, e.g. ``zoo.bert.Bert(causal=True, task="mlm")``) served
+by compile-once executables:
 
-- **prefill**: one causal forward over the whole prompt, capturing every
-  position's K/V into per-layer caches (``TransformerEncoderBlock.prefill``).
-  Prompt lengths round up to the bucketing policy's ``seq_buckets`` — the
-  decode-shape extension of ``data/bucketing.py``, so arbitrary prompt
-  lengths reuse a small fixed set of prefill executables.
-- **decode_step**: one token per call — embed at the row's position
-  (``BertEmbeddingLayer.embed_step``), attend the single query over the
-  cache (``TransformerEncoderBlock.decode_step``), project logits. One
-  executable per batch bucket, every generated token reuses it.
+- **prefill** — one causal forward over the whole prompt. Prompt lengths
+  round up to ``seq_buckets``; the prompt's K/V scatter into the paged
+  block pool through each stream's page table (serving/paged.py).
+- **decode_step** — one token per call over the page table: gather the
+  stream's K/V rows out of the slot-flat pool, attend ``k_pos <=
+  position``, scatter the new token's K/V at its slot. The page table is
+  DATA, not shape, so ONE executable (per batch bucket) serves every mix
+  of context lengths with zero steady-state recompiles — and the pool is
+  shared, so memory scales with actual tokens, not ``streams ×
+  max_length`` (the ``concurrent_streams_per_device`` headline).
+- **verify** — the speculative-decoding window: a small DRAFT net
+  (``Bert(causal=True)`` tiny, loaded per-model via the router) proposes
+  ``spec_tokens`` greedy tokens one cheap step at a time; the TARGET
+  verifies the whole window in ONE batched step through the paged cache
+  and emits every leading token the draft got right plus one
+  correction/bonus token from its own logits. Greedy speculative output
+  is therefore TOKEN-IDENTICAL to greedy non-speculative output by
+  construction — every emitted token is the target's own argmax —
+  proven in tests/test_paged_decode.py including a draft that is always
+  wrong (k rejections per round, still identical, just slower).
+  Rejected tails roll back page-table state exactly: positions are host
+  bookkeeping, and the stale K/V rows of rejected slots are provably
+  overwritten before any read (serving/paged.py module doc).
+  ``temperature > 0`` falls back to the plain per-token sampling loop —
+  verify-consistent by construction (same program, same key stream as
+  the non-speculative path).
 
-Exactness contract (tests/test_serving.py): the cached K/V are computed by
-the same ``_qkv`` projections as the full forward and written with
-identity-preserving updates, so **greedy decode through the cache equals
-greedy full-recompute decode token-for-token**. ``generate_full_recompute``
-runs the O(T²) path for that proof (and as a reference implementation).
+Admission: a batch whose streams cannot all get blocks sheds with
+:class:`~deeplearning4j_tpu.serving.resilience.PoolExhaustedError`
+(HTTP 429 + Retry-After, flight-recorder cause ``pool_exhausted``)
+BEFORE any device work; blocks free on completion/eos (the decode loop
+exits early once every live row emitted eos) and on shed.
 
-Both programs are plain ``jax.jit`` functions with trace markers, so the
-CompileWatcher (and the ``serving.recompiles_total`` counter) sees every
-signature they ever trace — steady-state serving shows 0.
+Weight-only int8 (serving/quantize.py): ``quantize="int8"`` stores
+resident int8 weights + per-channel scales and dequantizes inside these
+same executables; the fp32 path is bit-unchanged.
+
+Exactness contracts (tests/test_paged_decode.py + tests/test_serving.py):
+greedy decode through the paged cache == greedy decode through the
+contiguous r13 cache == greedy O(T²) full recompute, token-for-token.
+``generate_full_recompute`` remains the oracle. All programs are plain
+``jax.jit`` with trace markers, so the CompileWatcher (and
+``serving.recompiles_total``) sees every signature they ever trace.
 """
 
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+from deeplearning4j_tpu.serving.paged import (BlockPool, PoolExhaustedError,
+                                              default_pool_blocks)
+from deeplearning4j_tpu.serving.quantize import maybe_quantize
 from deeplearning4j_tpu.util import telemetry as tm
 from deeplearning4j_tpu.util.compile_watcher import note_trace
 
 
+def _decoder_parts(net, what: str):
+    """Validate and split a decoder-only MLN into (emb, blocks, head)."""
+    from deeplearning4j_tpu.nn.transformer import (BertEmbeddingLayer,
+                                                   TransformerEncoderBlock)
+
+    layers = net.layers
+    if not layers or not isinstance(layers[0], BertEmbeddingLayer):
+        raise ValueError(f"{what} needs a BertEmbeddingLayer input "
+                         "(e.g. zoo.bert.Bert(causal=True, task='mlm'))")
+    blocks = layers[1:-1]
+    if not blocks or not all(isinstance(b, TransformerEncoderBlock)
+                             for b in blocks):
+        raise ValueError(f"{what} needs TransformerEncoderBlock middle "
+                         "layers")
+    if not all(b.causal for b in blocks):
+        raise ValueError(f"{what} needs causal=True blocks — a "
+                         "bidirectional encoder cannot decode "
+                         "autoregressively")
+    if not hasattr(layers[-1], "_logits"):
+        raise ValueError(f"{what} needs a per-token logits head "
+                         "(RnnOutputLayer, task='mlm')")
+    return layers[0], list(blocks), layers[-1]
+
+
 class Generator:
-    """Compile-once prefill/decode serving head over a decoder-only
-    MultiLayerNetwork.
+    """Compile-once decode serving head over a decoder-only
+    MultiLayerNetwork (module doc).
 
     ``batch_buckets`` / ``prefill_buckets`` default to the model conf's
     bucketing knobs (ONE policy source of truth with training and the
     classify tier); ``max_length`` defaults to the embedding layer's
     ``max_position`` and bounds prompt + generated tokens.
-    """
+
+    Decode engine knobs: ``paged`` (default True — the r13 contiguous
+    cache remains as ``paged=False``, the identity oracle), ``block_size``
+    / ``pool_blocks`` (pool geometry; default pool holds the largest
+    batch bucket at full context, so admission only bites when sized
+    down deliberately), ``draft_net`` + ``spec_tokens`` (speculative
+    decoding — the draft runs its own small contiguous cache), and
+    ``quantize`` ("int8" weight-only serving)."""
 
     def __init__(self, net, *, max_length: Optional[int] = None,
-                 batch_buckets=None, prefill_buckets=None):
-        from deeplearning4j_tpu.nn.transformer import (BertEmbeddingLayer,
-                                                       TransformerEncoderBlock)
-
-        layers = net.layers
-        if not layers or not isinstance(layers[0], BertEmbeddingLayer):
-            raise ValueError("Generator needs a BertEmbeddingLayer input "
-                             "(e.g. zoo.bert.Bert(causal=True, task='mlm'))")
-        blocks = layers[1:-1]
-        if not blocks or not all(isinstance(b, TransformerEncoderBlock)
-                                 for b in blocks):
-            raise ValueError("Generator needs TransformerEncoderBlock middle "
-                             "layers")
-        if not all(b.causal for b in blocks):
-            raise ValueError("Generator needs causal=True blocks — a "
-                             "bidirectional encoder cannot decode "
-                             "autoregressively")
-        if not hasattr(layers[-1], "_logits"):
-            raise ValueError("Generator needs a per-token logits head "
-                             "(RnnOutputLayer, task='mlm')")
+                 batch_buckets=None, prefill_buckets=None,
+                 paged: bool = True, block_size: int = 16,
+                 pool_blocks: Optional[int] = None,
+                 draft_net=None, spec_tokens: int = 4,
+                 quantize: Optional[str] = None,
+                 model_id: str = ""):
+        self.emb, self.blocks, self.head = _decoder_parts(net, "Generator")
         self.net = net
-        self.emb = layers[0]
-        self.blocks = list(blocks)
-        self.head = layers[-1]
+        self.model_id = str(model_id)
         self.max_length = int(max_length or self.emb.max_position)
         conf_policy = BucketingPolicy.from_conf(getattr(net, "conf", None))
         if batch_buckets is None and conf_policy is not None:
@@ -85,16 +128,84 @@ class Generator:
         self.policy = BucketingPolicy(
             batch_buckets=batch_buckets or "pow2",
             seq_buckets=prefill_buckets or "pow2")
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        self._qp = maybe_quantize(net, quantize, model_id=self.model_id)
+        # contiguous programs: the paged=False engine, the full-recompute
+        # oracle's prefill, and the draft substrate
         self._prefill_jit = jax.jit(self._prefill)
         self._decode_jit = jax.jit(self._decode)
+        self.pool: Optional[BlockPool] = None
+        if self.paged:
+            # an AUTO-sized pool (pool_blocks=None) grows on demand
+            # (_admit) instead of shedding — the r13 contiguous engine
+            # never refused a batch for cache memory, and a dynamic
+            # ("pow2") bucket policy has no largest batch to size for.
+            # Admission control = the shed contract only applies when the
+            # operator PINNED a budget.
+            self._pool_auto = pool_blocks is None
+            if pool_blocks is None:
+                bb = self.policy.batch_buckets
+                pool_blocks = default_pool_blocks(
+                    bb if isinstance(bb, tuple) else (32,),
+                    self.max_length, self.block_size)
+            self.pool = BlockPool(self.blocks, block_size=self.block_size,
+                                  num_blocks=int(pool_blocks),
+                                  max_length=self.max_length,
+                                  model_id=self.model_id)
+            # pools are DONATED through the paged programs (the hot loop
+            # must not copy the whole pool per token) — every call site
+            # threads the returned pools back into self.pool.pools
+            self._prefill_paged_jit = jax.jit(self._prefill_paged,
+                                              donate_argnums=(1,))
+            self._decode_paged_jit = jax.jit(self._decode_paged,
+                                             donate_argnums=(1,))
+            self._verify_paged_jit = jax.jit(self._verify_paged,
+                                             donate_argnums=(1,))
+        # speculative decoding: the draft is a plain contiguous-cache
+        # generator over the (tiny) draft net — same bucket policy, so
+        # draft prefill shapes always match the target's prep
+        self.spec_tokens = int(spec_tokens)
+        self.draft: Optional[Generator] = None
+        if draft_net is not None:
+            if not self.paged:
+                raise ValueError("speculative decoding needs paged=True "
+                                 "(the verify window is a paged program)")
+            self.draft = Generator(
+                draft_net, max_length=self.max_length,
+                batch_buckets=self.policy.batch_buckets,
+                prefill_buckets=self.policy.seq_buckets,
+                paged=False, model_id=f"{self.model_id}/draft"
+                if self.model_id else "")
+            if self.draft.emb.max_position < self.max_length:
+                raise ValueError(
+                    f"draft net max_position {self.draft.emb.max_position} "
+                    f"< target max_length {self.max_length}")
+
+    # ----------------------------------------------------------- parameters
+    def _raw_params(self):
+        """What the traced programs take: the live fp32 tree (bit-unchanged
+        legacy path) or the resident (int8 leaves, scales) pair."""
+        if self._qp is None:
+            return self.net.params
+        return self._qp.args()
+
+    def _params_of(self, raw):
+        """Inside-jit: raw → the parameter tree the layers consume. For
+        int8 this IS the in-forward dequantize (serving/quantize.py)."""
+        if self._qp is None:
+            return raw
+        return self._qp.rebuild(raw)
 
     # ------------------------------------------------------ traced programs
-    def _prefill(self, params, tokens, lengths):
-        """tokens (B, T) int32, lengths (B,) int32 → (next-token logits
-        (B, V), caches). Padding rows/positions are masked out of every
-        attention read; the cache rows they write are overwritten by
-        generation before they are ever visible (nn/transformer.py)."""
+    def _prefill(self, raw, tokens, lengths):
+        """Contiguous-cache prefill: tokens (B, T) int32, lengths (B,)
+        int32 → (next-token logits (B, V), caches). Padding rows/positions
+        are masked out of every attention read; the cache rows they write
+        are overwritten by generation before they are ever visible
+        (nn/transformer.py)."""
         note_trace("serving.prefill", tokens, lengths)  # trace-time only
+        params = self._params_of(raw)
         b, t = tokens.shape
         x, _ = self.emb.apply(params[0], {}, tokens)
         pad_mask = (jnp.arange(t)[None, :]
@@ -108,10 +219,11 @@ class Generator:
         logits = self.head._logits(params[-1], h_last)
         return logits, caches
 
-    def _decode(self, params, caches, tokens, positions):
-        """One autoregressive step: tokens (B,) placed at per-row
-        ``positions`` (B,) → (next-token logits (B, V), caches)."""
+    def _decode(self, raw, caches, tokens, positions):
+        """One contiguous-cache autoregressive step: tokens (B,) placed at
+        per-row ``positions`` (B,) → (next-token logits (B, V), caches)."""
         note_trace("serving.decode_step", tokens, positions)
+        params = self._params_of(raw)
         x = self.emb.embed_step(params[0], tokens, positions)[:, None, :]
         new_caches = []
         for i, blk in enumerate(self.blocks):
@@ -119,6 +231,74 @@ class Generator:
             new_caches.append(cache)
         logits = self.head._logits(params[-1], x[:, 0])
         return logits, new_caches
+
+    def _slots_of(self, tables):
+        """Page tables (B, max_blocks) → per-position flat slot indices
+        (B, max_length). Sliced to EXACTLY max_length so the gathered
+        layout — and therefore every attention reduction — has the same
+        shape as the contiguous cache (the bit-level identity argument,
+        ops/attention.paged_kv_gather)."""
+        bs = self.block_size
+        s = tables[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+        return s.reshape(tables.shape[0], -1)[:, :self.max_length]
+
+    def _prefill_paged(self, raw, pools, tokens, lengths, tables):
+        """Paged prefill: same causal forward as ``_prefill`` (the prompt
+        attention runs over in-register K/V, so the logits are identical),
+        with every position's K/V scattered through the page table."""
+        note_trace("serving.prefill_paged", tokens, lengths)
+        params = self._params_of(raw)
+        b, t = tokens.shape
+        x, _ = self.emb.apply(params[0], {}, tokens)
+        pad_mask = (jnp.arange(t)[None, :]
+                    < lengths[:, None]).astype(x.dtype)
+        slots = self._slots_of(tables)[:, :t]
+        new_pools = []
+        for i, blk in enumerate(self.blocks):
+            x, pool = blk.prefill_paged(params[i + 1], x, pools[i], slots,
+                                        mask=pad_mask)
+            new_pools.append(pool)
+        h_last = x[jnp.arange(b), lengths - 1]
+        logits = self.head._logits(params[-1], h_last)
+        return logits, new_pools
+
+    def _decode_paged(self, raw, pools, tables, tokens, positions, limits):
+        """One paged autoregressive step (module doc). ``limits`` (B,) is
+        each stream's last valid position — a row that finished while its
+        batch keeps decoding redirects overrun writes to the trash block
+        instead of clobbering a live slot."""
+        note_trace("serving.decode_step_paged", tokens, positions)
+        params = self._params_of(raw)
+        x = self.emb.embed_step(params[0], tokens, positions)[:, None, :]
+        slots = self._slots_of(tables)
+        pos_w = positions[:, None]
+        new_pools = []
+        for i, blk in enumerate(self.blocks):
+            x, pool = blk.decode_window_paged(params[i + 1], x, pools[i],
+                                              slots, pos_w, limits=limits)
+            new_pools.append(pool)
+        logits = self.head._logits(params[-1], x[:, 0])
+        return logits, new_pools
+
+    def _verify_paged(self, raw, pools, tables, window, positions0, limits):
+        """Speculative verify: ``window`` (B, W) tokens at positions
+        ``positions0 + [0..W)`` → per-position next-token logits
+        (B, W, V) in ONE batched step. Window K/V are written first, each
+        query attends ``k_pos <= its position`` — exactly the sequential
+        decode-step semantics, batched over the window."""
+        note_trace("serving.verify_paged", window, positions0)
+        params = self._params_of(raw)
+        w = window.shape[1]
+        pos_w = positions0[:, None] + jnp.arange(w)[None, :]
+        x = self.emb.embed_window(params[0], window, pos_w)
+        slots = self._slots_of(tables)
+        new_pools = []
+        for i, blk in enumerate(self.blocks):
+            x, pool = blk.decode_window_paged(params[i + 1], x, pools[i],
+                                              slots, pos_w, limits=limits)
+            new_pools.append(pool)
+        logits = self.head._logits(params[-1], x)
+        return logits, new_pools
 
     # ------------------------------------------------------------- sampling
     @staticmethod
@@ -159,33 +339,283 @@ class Generator:
             lengths[i] = lens[i]
         return (jnp.asarray(tokens), jnp.asarray(lengths), b_real, lens)
 
+    @staticmethod
+    def _trim_row(row: List[int], max_new: int,
+                  eos_id: Optional[int]) -> List[int]:
+        row = row[:max_new]
+        if eos_id is not None and eos_id in row:
+            row = row[: row.index(eos_id) + 1]
+        return row
+
     def _trim(self, stacked, b_real: int, lens, max_new_tokens: int,
               eos_id: Optional[int]) -> List[List[int]]:
-        out = []
-        for i in range(b_real):
-            row = [int(v) for v in stacked[i][:max_new_tokens]]
-            if eos_id is not None and eos_id in row:
-                row = row[: row.index(eos_id) + 1]
-            out.append(row)
-        return out
+        return [self._trim_row([int(v) for v in stacked[i]],
+                               max_new_tokens, eos_id)
+                for i in range(b_real)]
 
-    # ------------------------------------------------------------ decoding
+    # ------------------------------------------------------------ admission
+    def _admit(self, lens, max_new: int, batch: int):
+        """Reserve every stream's blocks for the WHOLE generation —
+        all-or-nothing (PoolExhaustedError → the scheduler's 429 shed) —
+        and build the (B, max_blocks) page-table array. An AUTO-sized pool
+        (no operator budget) GROWS to fit instead of shedding: reserve
+        failed with nothing allocated and pool content never outlives a
+        batch, so swapping in a larger pool is safe mid-flight."""
+        counts = [self.pool.blocks_needed(l, max_new) for l in lens]
+        try:
+            tables_list = self.pool.reserve(counts)
+        except PoolExhaustedError:
+            if not self._pool_auto:
+                raise
+            # growth changes the pool shapes, so the NEXT paged calls
+            # trace once at the new size — a capacity event, not steady
+            # state (serving configs with finite buckets size the pool to
+            # their largest batch up front and never reach this branch;
+            # the 0-recompile contract is asserted there). Old buffers
+            # are dropped BEFORE the new allocation so device residency
+            # never doubles.
+            need = int(sum(counts))
+            grown = max(need, 2 * self.pool.num_blocks)
+            tm.counter("serving.kv_pool_grown_total", model=self.model_id)
+            tm.instant("serving.kv_pool_grown", model=self.model_id,
+                       blocks=grown)
+            old_peak = self.pool.peak_streams
+            self.pool.pools = None  # free before the bigger alloc
+            self.pool = BlockPool(self.blocks,
+                                  block_size=self.block_size,
+                                  num_blocks=grown,
+                                  max_length=self.max_length,
+                                  model_id=self.model_id)
+            self.pool.peak_streams = old_peak
+            tables_list = self.pool.reserve(counts)
+        tables = jnp.asarray(self.pool.table_array(tables_list, batch))
+        return tables_list, tables
+
+    # ------------------------------------------------------------- decoding
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 16, *, temperature: float = 0.0,
                  key=None, eos_id: Optional[int] = None,
-                 trace: bool = False) -> List[List[int]]:
-        """KV-cache decode: one prefill + ``max_new_tokens - 1`` decode
-        steps, all on warmed executables. ``temperature=0`` is greedy
-        (deterministic); otherwise categorical sampling from ``key``
-        (default PRNGKey(0) — pass a key for fresh randomness).
-        ``trace=True`` (a head-sampled serving batch) emits a prefill span
-        and one ``serving.generate.decode_token`` span per generated
-        position — the per-token ruler of
-        docs/OBSERVABILITY.md#request-tracing--slos."""
+                 trace: bool = False,
+                 stats: Optional[Dict] = None) -> List[List[int]]:
+        """Decode ``prompts``: one prefill + per-token decode steps (or
+        speculative verify windows when a draft net is attached and the
+        decode is greedy), all on warmed executables. ``temperature=0`` is
+        greedy (deterministic); otherwise categorical sampling from
+        ``key`` (default PRNGKey(0)) through the plain per-token loop.
+        ``trace=True`` (a head-sampled serving batch) emits prefill /
+        ``decode_token`` / ``verify`` spans — the per-token ruler of
+        docs/OBSERVABILITY.md#request-tracing--slos. ``stats`` (a dict,
+        filled in place) receives ``draft_accept_rate`` per row and the
+        batch ``spec_accept_rate`` when speculating."""
         if max_new_tokens < 1:
             return [[] for _ in prompts]
+        if not self.paged:
+            return self._generate_contiguous(
+                prompts, max_new_tokens, temperature=temperature, key=key,
+                eos_id=eos_id, trace=trace)
         tokens, lengths, b_real, lens = self._prep(prompts, max_new_tokens)
-        params = self.net.params
+        batch = int(tokens.shape[0])
+        tables_list, tables = self._admit(lens, max_new_tokens, batch)
+        try:
+            speculate = (self.draft is not None and self.spec_tokens > 0
+                         and not (temperature and temperature > 0.0))
+            if speculate:
+                return self._generate_speculative(
+                    tokens, lengths, tables, b_real, lens, max_new_tokens,
+                    eos_id=eos_id, trace=trace, stats=stats)
+            return self._generate_paged(
+                tokens, lengths, tables, b_real, lens, max_new_tokens,
+                temperature=temperature, key=key, eos_id=eos_id,
+                trace=trace)
+        except BaseException:
+            # a failure mid-decode may have consumed the donated pool
+            # buffers — rebuild them (pool CONTENT never outlives a batch;
+            # only the host allocator state matters, and release() below
+            # restores that)
+            self._reset_pools()
+            raise
+        finally:
+            # blocks free on completion, eos early-exit, and shed alike
+            self.pool.release(tables_list)
+
+    def _reset_pools(self):
+        self.pool.pools = [blk.init_pool(self.pool.num_slots)
+                           for blk in self.blocks]
+
+    def _generate_paged(self, tokens, lengths, tables, b_real, lens,
+                        max_new: int, *, temperature: float, key,
+                        eos_id: Optional[int], trace: bool):
+        """The plain per-token paged loop (greedy or sampled) — the same
+        sampling stream as the contiguous path, so paged==contiguous is
+        token-exact (greedy) / stream-exact (sampled)."""
+        raw = self._raw_params()
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        tele = tm.get_telemetry() if trace else None
+        batch = int(tokens.shape[0])
+        limits = jnp.asarray(np.asarray(
+            [l + max_new - 1 for l in lens]
+            + [0] * (batch - b_real), np.int32))
+
+        t_pf = time.time_ns() if tele else 0
+        logits, pools = self._prefill_paged_jit(raw, self.pool.pools,
+                                                tokens, lengths, tables)
+        self.pool.pools = pools
+        if tele:
+            tele.event_deferred("serving.generate.prefill", t_pf,
+                                time.time_ns(), batch=batch,
+                                seq=int(tokens.shape[1]), paged=True)
+        positions = lengths
+        steps = []
+        done = np.zeros(b_real, bool)
+        key, sub = jax.random.split(key)
+        cur = self._sample(logits, temperature, sub)
+        for i in range(max_new):
+            steps.append(cur)
+            if eos_id is not None:
+                done |= (np.asarray(cur)[:b_real] == eos_id)
+                if done.all():
+                    break  # every live stream finished: free blocks early
+            if i == max_new - 1:
+                break
+            t_dt = time.time_ns() if tele else 0
+            logits, pools = self._decode_paged_jit(
+                raw, self.pool.pools, tables, cur, positions, limits)
+            self.pool.pools = pools
+            if tele:
+                tele.event_deferred("serving.generate.decode_token", t_dt,
+                                    time.time_ns(), step=i + 1, batch=batch)
+            positions = positions + 1
+            key, sub = jax.random.split(key)
+            cur = self._sample(logits, temperature, sub)
+        stacked = np.stack([np.asarray(s) for s in steps], axis=1)
+        return self._trim(stacked, b_real, lens, max_new, eos_id)
+
+    def _generate_speculative(self, tokens, lengths, tables, b_real, lens,
+                              max_new: int, *, eos_id: Optional[int],
+                              trace: bool, stats: Optional[Dict]):
+        """Greedy speculative decode (module doc). Every emitted token is
+        the TARGET's argmax — the draft only decides how many the verify
+        window can commit at once."""
+        raw = self._raw_params()
+        draft = self.draft
+        draft_raw = draft._raw_params()
+        tele = tm.get_telemetry() if trace else None
+        batch = int(tokens.shape[0])
+        w = self.spec_tokens + 1  # window = last accepted + k proposals
+        limits_np = np.asarray([l + max_new - 1 for l in lens]
+                               + [0] * (batch - b_real), np.int32)
+        limits = jnp.asarray(limits_np)
+
+        t_pf = time.time_ns() if tele else 0
+        logits, pools = self._prefill_paged_jit(raw, self.pool.pools,
+                                                tokens, lengths, tables)
+        self.pool.pools = pools
+        _, dcaches = draft._prefill_jit(draft_raw, tokens, lengths)
+        if tele:
+            tele.event_deferred("serving.generate.prefill", t_pf,
+                                time.time_ns(), batch=batch,
+                                seq=int(tokens.shape[1]), paged=True,
+                                speculative=True)
+
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # token AT pos
+        pos_np = np.asarray(lengths)  # cur's position, per row
+        prev = tokens[jnp.arange(batch), jnp.asarray(pos_np) - 1]
+        emitted: List[List[int]] = [[] for _ in range(batch)]
+        done = np.zeros(b_real, bool)
+        accept_num = np.zeros(batch, np.int64)
+        accept_den = np.zeros(batch, np.int64)
+        host_cur = np.asarray(cur)
+        for i in range(b_real):
+            emitted[i].append(int(host_cur[i]))
+            if eos_id is not None and int(host_cur[i]) == eos_id:
+                done[i] = True
+
+        rounds = 0
+        while not done.all() and any(len(emitted[i]) < max_new
+                                     for i in range(b_real)
+                                     if not done[i]):
+            rounds += 1
+            positions = jnp.asarray(np.minimum(pos_np,
+                                               self.max_length - 1))
+            # draft proposal: repair the slot behind cur (idempotent — the
+            # K/V write is a pure function of (token, position), and after
+            # a fully-accepted window the draft never saw that token),
+            # then chain spec_tokens greedy draft steps
+            _, dcaches = draft._decode_jit(
+                draft_raw, dcaches, prev,
+                jnp.maximum(positions - 1, 0))
+            window_cols = [cur]
+            dcur = cur
+            for j in range(self.spec_tokens):
+                dlogits, dcaches = draft._decode_jit(
+                    draft_raw, dcaches, dcur,
+                    jnp.minimum(positions + j,
+                                self.max_length - 1))
+                dcur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                window_cols.append(dcur)
+            window = jnp.stack(window_cols, axis=1)  # (B, w)
+            live = int((~done).sum())
+            t_vf = time.time_ns() if tele else 0
+            glogits, pools = self._verify_paged_jit(
+                raw, self.pool.pools, tables, window, positions, limits)
+            self.pool.pools = pools
+            g = np.asarray(jnp.argmax(glogits, axis=-1))  # (B, w) host
+            win = np.asarray(window)
+            # accept the longest prefix the draft got right: window[j] is
+            # committed iff it equals the target's own next token g[j-1]
+            match = win[:, 1:] == g[:, :-1]               # (B, w-1)
+            m = 1 + np.cumprod(match, axis=1).sum(axis=1)  # (B,) in [1, w]
+            accepted_total = 0
+            for i in range(b_real):
+                if done[i]:
+                    continue
+                mi = int(m[i])
+                accept_num[i] += mi - 1
+                accept_den[i] += w - 1
+                accepted_total += mi - 1
+                for t_new in g[i, :mi]:
+                    emitted[i].append(int(t_new))
+                    if eos_id is not None and int(t_new) == eos_id:
+                        done[i] = True
+                        break
+                if len(emitted[i]) >= max_new:
+                    done[i] = True
+            if tele:
+                tele.event_deferred(
+                    "serving.generate.verify", t_vf, time.time_ns(),
+                    batch=batch, window=w, round=rounds,
+                    accepted=accepted_total, proposed=live * (w - 1))
+            # commit: cur' = g[m-1] at pos+m; prev' = the token at pos+m-1.
+            # Rejected positions [pos+m, pos+w) keep reservation; their
+            # stale K/V are overwritten before any read (paged.py doc).
+            rows = np.arange(batch)
+            new_cur = g[rows, np.minimum(m, w) - 1]
+            new_prev = np.where(m >= 2, g[rows, np.maximum(m - 2, 0)],
+                                np.asarray(cur))
+            cur = jnp.asarray(new_cur.astype(np.int32))
+            prev = jnp.asarray(new_prev.astype(np.int32))
+            pos_np = pos_np + m
+        if stats is not None:
+            rates = [
+                (float(accept_num[i] / accept_den[i])
+                 if accept_den[i] else None)
+                for i in range(b_real)]
+            stats["draft_accept_rate"] = rates
+            real = [r for r in rates if r is not None]
+            stats["spec_accept_rate"] = (sum(real) / len(real)
+                                         if real else None)
+            stats["spec_rounds"] = rounds
+        return [self._trim_row(emitted[i], max_new, eos_id)
+                for i in range(b_real)]
+
+    def _generate_contiguous(self, prompts, max_new_tokens: int, *,
+                             temperature: float, key,
+                             eos_id: Optional[int], trace: bool):
+        """The r13 contiguous-cache engine (``paged=False``) — kept
+        verbatim as the paged path's token-identity oracle."""
+        tokens, lengths, b_real, lens = self._prep(prompts, max_new_tokens)
+        raw = self._raw_params()
         if key is None:
             key = jax.random.PRNGKey(0)
         # deferred span emission (no registry lock in the decode loop —
@@ -194,7 +624,7 @@ class Generator:
         batch = int(tokens.shape[0])
 
         t_pf = time.time_ns() if tele else 0
-        logits, caches = self._prefill_jit(params, tokens, lengths)
+        logits, caches = self._prefill_jit(raw, tokens, lengths)
         if tele:
             tele.event_deferred("serving.generate.prefill", t_pf,
                                 time.time_ns(), batch=batch,
@@ -208,7 +638,7 @@ class Generator:
             if i == max_new_tokens - 1:
                 break
             t_dt = time.time_ns() if tele else 0
-            logits, caches = self._decode_jit(params, caches, cur,
+            logits, caches = self._decode_jit(raw, caches, cur,
                                               positions)
             if tele:
                 tele.event_deferred("serving.generate.decode_token", t_dt,
@@ -226,18 +656,19 @@ class Generator:
                                 ) -> List[List[int]]:
         """O(T²) reference decode: re-prefill the whole grown sequence for
         every token. Exactly the same sampling stream as ``generate`` —
-        the KV-cache path must reproduce it token-for-token (greedy) —
-        kept as the verification oracle, not a serving path."""
+        the KV-cache paths (paged AND contiguous) must reproduce it
+        token-for-token (greedy) — kept as the verification oracle, not a
+        serving path."""
         if max_new_tokens < 1:
             return [[] for _ in prompts]
         grown = [list(p) for p in prompts]
-        params = self.net.params
+        raw = self._raw_params()
         if key is None:
             key = jax.random.PRNGKey(0)
         steps = []
         for i in range(max_new_tokens):
             tokens, lengths, b_real, _ = self._prep(grown, 1)
-            logits, _ = self._prefill_jit(params, tokens, lengths)
+            logits, _ = self._prefill_jit(raw, tokens, lengths)
             key, sub = jax.random.split(key)
             cur = self._sample(logits, temperature, sub)
             steps.append(cur)
@@ -255,20 +686,32 @@ class Generator:
         (docs/SERVING.md#resilience): one tiny prompt through the prefill
         executable; True iff every logit is finite. Runs at an
         already-warmed (smallest-bucket) signature, so on a warmed
-        generator it never traces."""
+        generator it never traces. The paged probe uses an all-trash page
+        table — zero blocks reserved, the prompt attention never reads the
+        pool."""
         b = int(self.policy.bucket_batch(1))
         t = self._prefill_len(1)
         tokens = jnp.ones((b, t), jnp.int32)
         lengths = jnp.ones((b,), jnp.int32)
-        logits, _ = self._prefill_jit(self.net.params, tokens, lengths)
+        raw = self._raw_params()
+        if self.paged:
+            tables = jnp.zeros((b, self.pool.max_blocks_per_stream),
+                               jnp.int32)
+            logits, pools = self._prefill_paged_jit(
+                raw, self.pool.pools, tokens, lengths, tables)
+            self.pool.pools = pools
+        else:
+            logits, _ = self._prefill_jit(raw, tokens, lengths)
         return bool(np.isfinite(np.asarray(logits)).all())
 
     # -------------------------------------------------------------- warmup
     def warmup(self, batch_sizes=None, prompt_lengths=None) -> int:
-        """Pre-trace every (batch bucket × prefill bucket) prefill and every
-        batch-bucket decode step, so steady-state serving never compiles
-        (docs/SERVING.md). Defaults to the explicit bucket lists of the
-        policy. Returns the number of signatures primed."""
+        """Pre-trace every (batch bucket × prefill bucket) prefill, every
+        batch-bucket decode step, and — when speculating — every
+        batch-bucket verify window and the draft's own programs, so
+        steady-state serving never compiles (docs/SERVING.md). Defaults to
+        the explicit bucket lists of the policy. Returns the number of
+        signatures primed."""
         if batch_sizes is None:
             if not isinstance(self.policy.batch_buckets, tuple):
                 raise ValueError("warmup() without batch_sizes needs "
@@ -286,20 +729,47 @@ class Generator:
                 prompt_lengths = tuple(
                     2 ** i for i in range(self.max_length.bit_length())
                 ) + (self.max_length,)
-        params = self.net.params
+        raw = self._raw_params()
         primed = 0
         for b in batch_sizes:
             b = int(b)
             caches = None
+            if self.paged:
+                tables = jnp.zeros((b, self.pool.max_blocks_per_stream),
+                                   jnp.int32)
             for t in sorted({min(int(t), self.max_length)
                              for t in prompt_lengths}):
                 tokens = jnp.zeros((b, t), jnp.int32)
                 lengths = jnp.ones((b,), jnp.int32)
-                _, caches = self._prefill_jit(params, tokens, lengths)
+                if self.paged:
+                    _, pools = self._prefill_paged_jit(
+                        raw, self.pool.pools, tokens, lengths, tables)
+                    self.pool.pools = pools
+                else:
+                    _, caches = self._prefill_jit(raw, tokens, lengths)
                 primed += 1
-            if caches is not None:
-                cur = jnp.zeros((b,), jnp.int32)
-                pos = jnp.ones((b,), jnp.int32)
-                self._decode_jit(params, caches, cur, pos)
+            cur = jnp.zeros((b,), jnp.int32)
+            pos = jnp.ones((b,), jnp.int32)
+            if self.paged:
+                limits = jnp.full((b,), self.max_length - 1, jnp.int32)
+                _, pools = self._decode_paged_jit(
+                    raw, self.pool.pools, tables, cur, pos, limits)
+                self.pool.pools = pools
                 primed += 1
+                if self.draft is not None and self.spec_tokens > 0:
+                    window = jnp.zeros((b, self.spec_tokens + 1), jnp.int32)
+                    _, pools = self._verify_paged_jit(
+                        raw, self.pool.pools, tables, window, pos, limits)
+                    self.pool.pools = pools
+                    primed += 1
+            elif caches is not None:
+                self._decode_jit(raw, caches, cur, pos)
+                primed += 1
+        if self.draft is not None:
+            primed += self.draft.warmup(batch_sizes=batch_sizes,
+                                        prompt_lengths=prompt_lengths)
         return primed
+
+    # ---------------------------------------------------------------- stats
+    def pool_stats(self) -> Optional[dict]:
+        return self.pool.stats() if self.pool is not None else None

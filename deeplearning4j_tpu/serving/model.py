@@ -16,11 +16,17 @@ Two kinds:
   ``net.output`` path (or ``ParallelInference.output`` when ``use_mesh``),
   then split back per request. Row independence makes the batched result
   bit-identical to per-request results.
-- ``kind="generate"``: KV-cache autoregressive decode
-  (serving/generate.py). Requests are token prompts; coalesced prompts
-  decode as one batch, per-request ``max_new_tokens`` honored by trimming
-  (rows are attention-independent, so batching never changes a row's
-  tokens).
+- ``kind="generate"``: paged-KV-cache autoregressive decode
+  (serving/generate.py — paged block pool, optional speculative decoding
+  via ``draft_net``/``spec_tokens``, optional ``quantize="int8"``).
+  Requests are token prompts; coalesced prompts decode as one batch,
+  per-request ``max_new_tokens`` honored by trimming (rows are
+  attention-independent, so batching never changes a row's tokens). A
+  batch the block pool cannot hold sheds ``PoolExhaustedError`` (429).
+
+``quantize="int8"`` on either kind serves resident int8 weights +
+per-channel scales with the dequantize inside the forward
+(serving/quantize.py); the fp32 path is bit-unchanged.
 
 ``execute`` counts the XLA traces it causes via the CompileWatcher — the
 scheduler publishes them as ``serving.recompiles_total``, the steady-state-
@@ -50,7 +56,11 @@ class ServingModel:
                  bucketing=None, use_mesh: bool = False,
                  export_dir: Optional[str] = None,
                  max_length: Optional[int] = None,
-                 prefill_buckets=None):
+                 prefill_buckets=None,
+                 paged: bool = True, block_size: int = 16,
+                 pool_blocks: Optional[int] = None,
+                 draft_net=None, spec_tokens: int = 4,
+                 quantize: Optional[str] = None):
         if kind not in ("classify", "generate"):
             raise ValueError(f"unknown serving kind {kind!r}")
         self.net = net
@@ -59,6 +69,15 @@ class ServingModel:
         self.export_dir = export_dir
         self._max_length = max_length
         self._use_mesh = bool(use_mesh)
+        # decode-engine knobs (docs/SERVING.md#paged-kv--speculative-decode)
+        self._paged = bool(paged)
+        self._block_size = int(block_size)
+        self._pool_blocks = pool_blocks
+        self._draft_net = draft_net
+        self._spec_tokens = int(spec_tokens)
+        self.quantize = quantize
+        self._qp = None       # classify-kind int8 residents
+        self._qforward = None
         #: rolling-reload version surface (docs/SERVING.md#resilience):
         #: starts at 1, bumps on every successful swap_from()
         self.version = 1
@@ -88,9 +107,34 @@ class ServingModel:
                 net, max_length=max_length,
                 batch_buckets=self.policy.batch_buckets,
                 prefill_buckets=(prefill_buckets
-                                 or self.policy.seq_buckets))
+                                 or self.policy.seq_buckets),
+                paged=self._paged, block_size=self._block_size,
+                pool_blocks=self._pool_blocks,
+                draft_net=self._draft_net, spec_tokens=self._spec_tokens,
+                quantize=quantize, model_id=self.model_id)
             self.policy = self.generator.policy
-        elif use_mesh:
+            self._qp = self.generator._qp
+        elif quantize is not None:
+            if use_mesh:
+                raise ValueError("quantize + use_mesh is not supported — "
+                                 "the mesh path shards fp32 params")
+            from deeplearning4j_tpu.serving.quantize import maybe_quantize
+            from deeplearning4j_tpu.util.compile_watcher import note_trace
+
+            self._qp = maybe_quantize(net, quantize,
+                                      model_id=self.model_id)
+            fwd, qp = net.make_forward_fn(), self._qp
+
+            def _qfwd(raw, states, x):
+                # the int8 classify executable: dequantize-in-forward over
+                # the resident (int8, scales) leaves (serving/quantize.py)
+                note_trace("serving.classify_int8", x)
+                return fwd(qp.rebuild(raw), states, x)
+
+            import jax
+
+            self._qforward = jax.jit(_qfwd)
+        if use_mesh and kind != "generate":
             from deeplearning4j_tpu.parallel.wrapper import ParallelInference
 
             # the SAME policy object the scheduler plans with — one bucket
@@ -119,6 +163,18 @@ class ServingModel:
         generate. Returns the number of signatures primed."""
         if self.kind == "generate":
             primed = self.generator.warmup()
+        elif self._qforward is not None:
+            conf = getattr(self.net, "conf", None)
+            shape = tuple(getattr(conf, "input_shape", None) or ())
+            if not shape:
+                raise ValueError(
+                    f"{self.model_id}: warmup() needs conf.input_shape")
+            raw = self._qp.args()
+            primed = 0
+            for b in self.policy.batch_buckets:
+                self._qforward(raw, self.net.states,
+                               np.zeros((int(b),) + shape, np.float32))
+                primed += 1
         elif self.inference is not None:
             primed = self.inference.warmup(
                 batch_sizes=self.policy.batch_buckets)
@@ -232,8 +288,14 @@ class ServingModel:
             if _trace:
                 self._emit("serving.exec.pad", t0, rows=n, padded=padded)
             t1 = time.time_ns() if _trace else 0
-            chunks = [np.asarray(self.net.output(chunk))[:take]
-                      for chunk, take in padded_chunks]
+            if self._qforward is not None:
+                raw = self._qp.args()
+                chunks = [np.asarray(self._qforward(
+                    raw, self.net.states, chunk))[:take]
+                          for chunk, take in padded_chunks]
+            else:
+                chunks = [np.asarray(self.net.output(chunk))[:take]
+                          for chunk, take in padded_chunks]
             out = np.concatenate(chunks, axis=0)
             if _trace:
                 self._emit("serving.exec.device", t1, rows=n,
@@ -253,7 +315,8 @@ class ServingModel:
         tokens = self.generator.generate(
             prompts, max_new_tokens=max_new,
             temperature=float(opts.get("temperature", 0.0)),
-            eos_id=opts.get("eos_id"), trace=_trace)
+            eos_id=opts.get("eos_id"), trace=_trace,
+            stats=_stats)  # speculation: draft_accept_rate per rider
         if _stats is not None:
             # decode wall (incl. prefill) — the scheduler turns this into
             # per-request serving.decode_tokens_per_sec observations
@@ -273,7 +336,13 @@ class ServingModel:
                             bucketing=self.policy,
                             use_mesh=self._use_mesh,
                             export_dir=self.export_dir,
-                            max_length=self._max_length)
+                            max_length=self._max_length,
+                            paged=self._paged,
+                            block_size=self._block_size,
+                            pool_blocks=self._pool_blocks,
+                            draft_net=self._draft_net,
+                            spec_tokens=self._spec_tokens,
+                            quantize=self.quantize)
 
     def structure_matches(self, net) -> bool:
         """Whether ``net``'s parameter tree is swap-compatible with the
@@ -329,6 +398,12 @@ class ServingModel:
             self.net = shadow.net
             self.generator = shadow.generator
             self.inference = shadow.inference
+            # int8 residents swap WITH the net: the classify executable
+            # branches on _qforward (whose closure holds the quantized
+            # leaves) — leaving the old pair here would silently keep
+            # serving the PRE-reload weights while version advances
+            self._qp = shadow._qp
+            self._qforward = shadow._qforward
             self.policy = shadow.policy
             self.warmed = shadow.warmed
             self.version += 1
@@ -337,7 +412,7 @@ class ServingModel:
         return self.version
 
     def describe(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "buckets": self.policy.to_spec(),
             "coalesce_limit": self.coalesce_limit(),
@@ -349,3 +424,21 @@ class ServingModel:
             "params": int(self.net.num_params())
             if hasattr(self.net, "num_params") else None,
         }
+        if self.quantize:
+            out["quantize"] = self.quantize
+            if self._qp is not None:
+                out["weight_bytes_resident"] = self._qp.resident_bytes()
+                out["weight_bytes_fp32"] = self._qp.fp32_bytes()
+        if self.generator is not None:
+            pool = self.generator.pool_stats()
+            if pool is not None:
+                out["kv_pool"] = pool
+            if self.generator.draft is not None:
+                out["speculative"] = {
+                    "spec_tokens": self.generator.spec_tokens,
+                    "draft_params": int(
+                        self.generator.draft.net.num_params())
+                    if hasattr(self.generator.draft.net, "num_params")
+                    else None,
+                }
+        return out

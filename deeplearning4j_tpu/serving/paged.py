@@ -1,0 +1,189 @@
+"""Paged/block KV cache for the decode tier (docs/SERVING.md).
+
+The contiguous r13 cache allocated ``(B, H, max_length, Dh)`` per layer for
+every decode batch — every stream paid ``max_length`` positions of device
+memory no matter how short its context, and the ceiling on concurrent
+streams per device was ``pool_bytes / (max_length * per_token_bytes)``.
+This module replaces that with the vLLM-style paged layout:
+
+- **One slot-flat pool per layer** — ``(S, H, Dh)`` with
+  ``S = (num_blocks + 1) * block_size`` token slots. Block 0 is the
+  RESERVED TRASH BLOCK: every position outside a stream's reservation
+  (bucket padding, padded batch rows) scatters there and every read is
+  position-masked before the softmax, so trash content is never visible.
+- **A page table per stream** — the host-side list of physical block ids
+  backing logical positions ``[0, ceil((len + max_new) / block_size) *
+  block_size)``. The decode executable takes the table as data
+  ``(B, max_blocks)`` and expands it to per-position slot indices in-jit,
+  so ONE executable (per batch bucket) serves every mix of context
+  lengths with zero recompiles — context length is a value, not a shape.
+- **All-or-nothing admission** — :meth:`BlockPool.reserve` either hands a
+  batch every block its streams need for the WHOLE generation (prompt +
+  ``max_new_tokens``, so a stream can never run out mid-decode) or raises
+  :class:`PoolExhaustedError` with nothing allocated — the scheduler
+  sheds the batch 429 + Retry-After (the r13 shed contract, new cause
+  ``pool_exhausted``) instead of OOMing. Blocks free on completion/eos
+  (the decode loop exits early once every live row has emitted eos) and
+  on shed.
+
+Rollback semantics (speculative decoding, serving/generate.py): rejected
+window positions keep their reservation — rolling back is pure position
+bookkeeping on the host — and their stale K/V rows are PROVABLY
+overwritten before any read: the next window write covers ``[pos + m,
+pos + m + w)`` ⊇ the rejected ``[pos + m, pos + w)`` (``m ≥ 1``), and
+every attention read in between is masked to ``k_pos <= position``.
+
+Gauges: ``serving.kv_pool_blocks_total`` / ``_free``,
+``serving.concurrent_streams`` (+ per-pool high-water in :meth:`stats`),
+the inputs to the ``concurrent_streams_per_device`` bench metric.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.resilience import PoolExhaustedError
+from deeplearning4j_tpu.util import telemetry as tm
+
+__all__ = ["BlockPool", "PoolExhaustedError"]
+
+
+class BlockPool:
+    """Host-side allocator + device-side slot-flat K/V pools (module doc).
+
+    ``num_blocks`` usable blocks of ``block_size`` token slots each; the
+    device tensors carry one extra (trash) block at index 0. Device state
+    lives in ``self.pools`` — one ``{"k": (S,H,Dh), "v": (S,H,Dh)}`` per
+    transformer layer, created by the blocks' ``init_pool`` and donated
+    through the decode executables (the generator threads the returned
+    pools back)."""
+
+    def __init__(self, blocks, *, block_size: int, num_blocks: int,
+                 max_length: int, model_id: str = "",
+                 dtype=None):
+        import jax.numpy as jnp
+
+        if block_size < 1 or num_blocks < 1:
+            raise ValueError("block_size and num_blocks must be >= 1")
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_length = int(max_length)
+        self.model_id = str(model_id)
+        #: page-table width: enough blocks to map every logical position
+        self.max_blocks_per_stream = math.ceil(self.max_length
+                                               / self.block_size)
+        self.num_slots = (self.num_blocks + 1) * self.block_size
+        self.pools = [blk.init_pool(self.num_slots,
+                                    dtype or jnp.float32)
+                      for blk in blocks]
+        self._lock = threading.Lock()
+        # block 0 is the trash block — never handed out
+        self._free: List[int] = list(range(1, self.num_blocks + 1))
+        self._streams = 0
+        self.peak_streams = 0
+        self._gauges()
+
+    # ---------------------------------------------------------- accounting
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        """Blocks one stream needs for its WHOLE generation."""
+        return math.ceil((prompt_len + max_new) / self.block_size)
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def bytes_per_token(self) -> int:
+        """Device bytes one token slot costs across every layer (K + V).
+        Pure shape arithmetic — this runs on every stats poll, and
+        slicing (``p["k"][0]``) would dispatch an eager device gather per
+        layer just to read sizes."""
+        return sum(int(p["k"].nbytes // p["k"].shape[0]
+                       + p["v"].nbytes // p["v"].shape[0])
+                   for p in self.pools)
+
+    def pool_bytes(self) -> int:
+        """Total device bytes of the usable pool (trash block excluded)."""
+        return self.num_blocks * self.block_size * self.bytes_per_token()
+
+    def contiguous_stream_ceiling(self) -> int:
+        """How many streams the SAME bytes hold under the r13 contiguous
+        layout (every stream pays ``max_length`` slots) — the baseline the
+        ``concurrent_streams_per_device`` gate must beat."""
+        return (self.num_blocks * self.block_size) // self.max_length
+
+    def _gauges(self):
+        tm.gauge("serving.kv_pool_blocks_total", self.num_blocks,
+                 model=self.model_id)
+        tm.gauge("serving.kv_pool_blocks_free", len(self._free),
+                 model=self.model_id)
+        tm.gauge("serving.concurrent_streams", self._streams,
+                 model=self.model_id)
+
+    # ----------------------------------------------------------- admission
+    def reserve(self, counts: Sequence[int]) -> List[List[int]]:
+        """All-or-nothing: allocate ``counts[i]`` blocks for stream i, or
+        raise :class:`PoolExhaustedError` having allocated NOTHING."""
+        need = int(sum(counts))
+        with self._lock:
+            if need > len(self._free):
+                tm.counter("serving.pool_exhausted_total",
+                           model=self.model_id)
+                raise PoolExhaustedError(
+                    f"{self.model_id or 'paged-kv'}: batch needs {need} "
+                    f"KV blocks, pool has {len(self._free)} free "
+                    f"(of {self.num_blocks})")
+            out = []
+            for c in counts:
+                out.append([self._free.pop() for _ in range(int(c))])
+            self._streams += len(counts)
+            self.peak_streams = max(self.peak_streams, self._streams)
+            self._gauges()
+            return out
+
+    def release(self, tables: Sequence[Sequence[int]]):
+        """Return every stream's blocks to the free list (eos / batch done
+        / shed rollback)."""
+        with self._lock:
+            for t in tables:
+                self._free.extend(int(b) for b in t)
+            self._streams = max(0, self._streams - len(list(tables)))
+            self._gauges()
+
+    # ------------------------------------------------------------ programs
+    def table_array(self, tables: Sequence[Sequence[int]],
+                    batch: int) -> np.ndarray:
+        """Page tables as the decode executable's (B, max_blocks) int32
+        input — unallocated entries (and padded batch rows) point at the
+        trash block (0)."""
+        out = np.zeros((batch, self.max_blocks_per_stream), np.int32)
+        for i, t in enumerate(tables):
+            out[i, :len(t)] = np.asarray(t, np.int32)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "block_size": self.block_size,
+                "blocks_total": self.num_blocks,
+                "blocks_free": len(self._free),
+                "streams": self._streams,
+                "peak_streams": self.peak_streams,
+                "pool_bytes": self.pool_bytes(),
+                "contiguous_stream_ceiling":
+                    self.contiguous_stream_ceiling(),
+            }
+
+
+def default_pool_blocks(batch_buckets, max_length: int,
+                        block_size: int) -> int:
+    """Default pool size: the largest decode batch bucket at full
+    ``max_length`` context — the paged pool then NEVER sheds a batch the
+    contiguous layout could have served (admission only bites when the
+    operator deliberately sizes the pool below that, trading worst-case
+    headroom for more concurrent typical-length streams)."""
+    top = max(int(b) for b in batch_buckets) if batch_buckets else 32
+    return top * math.ceil(max_length / block_size)

@@ -1,0 +1,187 @@
+"""Weight-only int8 serving (docs/SERVING.md#paged-kv--speculative-decode).
+
+The serving twin of the r14 master-weight machinery, inverted: training
+keeps fp32 masters and computes low-precision; serving keeps the fp32
+archive as the master and holds RESIDENT int8 weights + per-channel fp32
+scales on the device, dequantizing INSIDE the forward (one multiply per
+weight, fusable into the consuming GEMM — the cuDNN reduced-precision
+framing, arXiv:1410.0759). Riding the registered
+``quantize_per_channel`` / ``dequantize_per_channel`` ops
+(ops/compression.py).
+
+What quantizes: floating leaves with ``ndim >= 2`` and at least
+``min_size`` elements (weight matrices, embedding tables). Biases,
+LayerNorm vectors and scalars pass through untouched — they are a
+rounding error of the byte budget and disproportionately sensitive.
+
+Contracts (tests/test_paged_decode.py):
+
+- resident bytes (int8 + scales) ≥ 3.5× below the fp32 equivalent,
+  gauge-asserted (``serving.weight_bytes{kind=resident|fp32_equiv}``);
+- classify/decode outputs within the pinned tolerance of the fp32 path
+  (:data:`INT8_LOGIT_TOL` on logits for the test-sized zoo nets);
+- the fp32 path is bit-unchanged — quantization is strictly opt-in
+  (``quantize=None`` leaves every program and every buffer exactly as
+  before).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.ops.compression import (channel_scale,
+                                                dequantize_np,
+                                                dequantize_per_channel,
+                                                quantize_per_channel)
+from deeplearning4j_tpu.util import telemetry as tm
+
+#: pinned |logit| tolerance for int8-vs-fp32 on the test-sized zoo nets —
+#: per-channel symmetric int8 on BERT-tiny/LeNet-scale weights lands well
+#: inside this; a regression past it means the quantizer broke, not noise
+INT8_LOGIT_TOL = 0.15
+
+__all__ = ["QuantizedParams", "INT8_LOGIT_TOL"]
+
+
+class QuantizedParams:
+    """A parameter tree quantized for serving (module doc).
+
+    Holds the tree structure plus two parallel leaf lists: ``qleaves``
+    (int8 for quantized leaves, the original array for pass-through) and
+    ``scales`` (fp32 per-channel scale with keepdims broadcast shape, or
+    ``None`` for pass-through). The pair ``(qleaves, scales)`` is what
+    the serving executables take as their parameter argument —
+    :meth:`rebuild` runs inside the jit and dequantizes back to the tree
+    the layers expect."""
+
+    def __init__(self, treedef, qleaves: List, scales: List):
+        self.treedef = treedef
+        self.qleaves = list(qleaves)
+        self.scales = list(scales)
+
+    # --------------------------------------------------------------- build
+    @classmethod
+    def from_params(cls, params, *, min_size: int = 256) -> "QuantizedParams":
+        """Quantize a live parameter tree (host-side, numpy math)."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        qleaves, scales = [], []
+        for leaf in leaves:
+            a = np.asarray(leaf)
+            if (np.issubdtype(a.dtype, np.floating) and a.ndim >= 2
+                    and a.size >= min_size):
+                s = channel_scale(a)
+                qleaves.append(np.asarray(quantize_per_channel(a, s)))
+                scales.append(s)
+            else:
+                qleaves.append(a)
+                scales.append(None)
+        return cls(treedef, qleaves, scales)
+
+    @classmethod
+    def from_stored(cls, treedef, qleaves, scales) -> "QuantizedParams":
+        """Rehydrate the EXACT stored quantization from an int8 archive
+        (util/model_serializer.py) — bit-identical round trip, no
+        re-quantization drift."""
+        return cls(treedef, qleaves, scales)
+
+    # ------------------------------------------------------------ programs
+    def args(self) -> Tuple[List, List]:
+        """The (qleaves, scales) pair the jitted programs take. ``None``
+        scale entries are pytree structure (static), so the
+        quantized-vs-passthrough pattern is baked into the trace."""
+        return (self.qleaves, self.scales)
+
+    def device_put(self):
+        """Move the resident weights to device once (serving boot)."""
+        self.qleaves = [jax.device_put(q) for q in self.qleaves]
+        self.scales = [None if s is None else jax.device_put(s)
+                       for s in self.scales]
+        return self
+
+    def rebuild(self, raw):
+        """(qleaves, scales) → the dequantized parameter tree. Runs INSIDE
+        the serving jits — the dequantize is part of the forward."""
+        qleaves, scales = raw
+        leaves = [q if s is None else dequantize_per_channel(q, s)
+                  for q, s in zip(qleaves, scales)]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    # ----------------------------------------------------------- accounting
+    def resident_bytes(self) -> int:
+        """Device bytes the serving executables hold (int8 + scales).
+        Reads ``.nbytes`` directly — jax and numpy arrays both carry it —
+        because this runs on every /v1/models / status poll and an
+        ``np.asarray`` here would device→host copy the whole weight set
+        just to read sizes."""
+        total = 0
+        for q, s in zip(self.qleaves, self.scales):
+            total += int(q.nbytes)
+            if s is not None:
+                total += int(s.nbytes)
+        return total
+
+    def fp32_bytes(self) -> int:
+        """What the same tree costs resident in fp32."""
+        return sum(int(np.prod(np.shape(q)) * 4) for q in self.qleaves)
+
+    def quantized_fraction(self) -> float:
+        n = sum(1 for s in self.scales if s is not None)
+        return n / max(1, len(self.scales))
+
+    def publish_gauges(self, model_id: str):
+        """The acceptance-criterion surface: resident vs fp32-equivalent
+        weight bytes on /metrics."""
+        tm.gauge("serving.weight_bytes", self.resident_bytes(),
+                 model=model_id, kind="resident")
+        tm.gauge("serving.weight_bytes", self.fp32_bytes(),
+                 model=model_id, kind="fp32_equiv")
+        tm.gauge("serving.weight_quantized_fraction",
+                 self.quantized_fraction(), model=model_id)
+
+
+def _stash_matches(stored, params) -> bool:
+    """Whether a restore-time ``net._int8_archive`` stash still describes
+    the live ``params``. The restore set ``params`` to the stash's exact
+    dequantization, so the check is plain equality per leaf — anything
+    (fine-tuning, transfer copy-back, a hand edit) that wrote the params
+    since makes the stash STALE, and adopting it would silently serve the
+    outdated archived weights. A stale stash falls through to fresh
+    quantization of the live params."""
+    treedef, qleaves, scales = stored
+    live = jax.tree_util.tree_leaves(params)
+    if (jax.tree_util.tree_structure(params) != treedef
+            or len(live) != len(qleaves)):
+        return False
+    # per-LEAF dequant + compare: peak extra host memory is one fp32
+    # leaf, not the model, and this runs on the (cold) load/reload path
+    for p, q, s in zip(live, qleaves, scales):
+        deq = q if s is None else dequantize_np(q, s)
+        if not np.array_equal(np.asarray(p), deq):
+            return False
+    return True
+
+
+def maybe_quantize(net, quantize: Optional[str], model_id: str = ""
+                   ) -> Optional[QuantizedParams]:
+    """The one entry point the serving tier calls: ``None`` → fp32 path
+    bit-unchanged (returns None, nothing is touched); ``"int8"`` → a
+    device-resident :class:`QuantizedParams`, reusing the archive's stored
+    quantization verbatim when the net was restored from an int8 archive
+    (``net._int8_archive``, util/model_serializer.py)."""
+    if quantize is None:
+        return None
+    if quantize != "int8":
+        raise ValueError(f"unknown quantize mode {quantize!r} "
+                         "(supported: None, 'int8')")
+    stored = getattr(net, "_int8_archive", None)
+    if stored is not None and _stash_matches(stored, net.params):
+        qp = QuantizedParams.from_stored(*stored)
+    else:
+        qp = QuantizedParams.from_params(net.params)
+    qp.device_put()
+    if model_id:
+        qp.publish_gauges(model_id)
+    return qp

@@ -64,6 +64,10 @@ class ShedError(RuntimeError):
 
     http_status = 429
     retry_after_s = 1.0
+    #: shed-counter/flight-recorder cause when the shed is raised from the
+    #: EXECUTE path (model.execute inside a batch cycle) rather than at
+    #: submit time — subclasses with an execute-time path override it
+    shed_reason = "shed"
 
 
 class QueueFullError(ShedError):
@@ -72,6 +76,17 @@ class QueueFullError(ShedError):
 
 class DeadlineExceededError(ShedError):
     """The request's queueing deadline expired before execution started."""
+
+
+class PoolExhaustedError(ShedError):
+    """The model's paged KV block pool cannot hold this batch's streams
+    (serving/paged.py): decode admission sheds with 429 + Retry-After
+    instead of OOMing the device. Raised BEFORE any device work — the
+    reserved blocks are rolled back, nothing leaks. Its flight-recorder
+    cause and per-lane shed counter are first-class (``pool_exhausted``),
+    the r13 shed contract with a new cause."""
+
+    shed_reason = "pool_exhausted"
 
 
 class SchedulerDrainingError(ShedError):

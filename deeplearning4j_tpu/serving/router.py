@@ -99,14 +99,34 @@ class ModelRouter:
                 f"({type(e).__name__}: {e})") from e
 
     def load(self, model_id: str, path: str, *, kind: str = "classify",
+             quantize: Optional[str] = None,
+             draft_path: Optional[str] = None,
              **model_kw) -> BatchScheduler:
         """Restore a ModelSerializer archive and register it. ``model_kw``
         passes through to :class:`ServingModel` (bucketing, export_dir,
-        use_mesh, …). A corrupt/truncated archive raises
+        use_mesh, paged/pool knobs, …). A corrupt/truncated archive raises
         :class:`ModelLoadError` WITHOUT registering anything — the
-        registry never holds a partially-loaded model."""
+        registry never holds a partially-loaded model.
+
+        ``quantize="int8"`` serves weight-only int8: an int8 archive's
+        stored quantization is adopted verbatim (bit-identical round
+        trip); an fp32 archive is quantized at load
+        (serving/quantize.py). ``draft_path`` loads a small draft net
+        from its own archive and turns on speculative decoding for
+        ``kind="generate"`` (serving/generate.py)."""
         net = self._restore_archive(path, f"load {model_id!r}")
-        model = ServingModel(net, model_id, kind=kind, **model_kw)
+        if draft_path is not None:
+            model_kw["draft_net"] = self._restore_archive(
+                draft_path, f"load {model_id!r} draft")
+        model = ServingModel(net, model_id, kind=kind, quantize=quantize,
+                             **model_kw)
+        if quantize:
+            try:
+                archive_bytes = os.path.getsize(path)
+                tm.gauge("serving.archive_bytes", archive_bytes,
+                         model=model_id, quantize=str(quantize))
+            except OSError:
+                pass
         return self.register(model)
 
     # ------------------------------------------------------ rolling reload

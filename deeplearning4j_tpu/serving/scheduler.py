@@ -63,6 +63,7 @@ from deeplearning4j_tpu.serving.resilience import (BrownoutShedError,
                                                    CircuitBreaker,
                                                    CircuitOpenError,
                                                    DeadlineExceededError,
+                                                   PoolExhaustedError,
                                                    QueueFullError,
                                                    SchedulerDrainingError,
                                                    SchedulerStoppedError,
@@ -177,8 +178,9 @@ class FlightRecorder:
 # re-exported here so every pre-existing `from ...scheduler import ShedError`
 # import path keeps working
 __all_errors__ = (ShedError, QueueFullError, DeadlineExceededError,
-                  SchedulerDrainingError, SchedulerStoppedError,
-                  CircuitOpenError, BrownoutShedError, WorkerCrashedError)
+                  PoolExhaustedError, SchedulerDrainingError,
+                  SchedulerStoppedError, CircuitOpenError,
+                  BrownoutShedError, WorkerCrashedError)
 
 
 @dataclasses.dataclass
@@ -308,7 +310,8 @@ class BatchScheduler:
     def _flight_record(self, req: _Request, status: str, *,
                        cause: Optional[str] = None, end_ns: Optional[int] = None,
                        bucket: Optional[int] = None, traced: bool = False,
-                       tokens_per_sec: Optional[float] = None) -> dict:
+                       tokens_per_sec: Optional[float] = None,
+                       draft_accept_rate: Optional[float] = None) -> dict:
         end_ns = end_ns or time.time_ns()
         rec = {
             "id": req.request_id,
@@ -328,13 +331,18 @@ class BatchScheduler:
         }
         if tokens_per_sec is not None:
             rec["tokens_per_sec"] = round(tokens_per_sec, 3)
+        if draft_accept_rate is not None:
+            # speculative decoding (serving/generate.py): the fraction of
+            # draft proposals the target verified for THIS request
+            rec["draft_accept_rate"] = round(draft_accept_rate, 4)
         self.flight.record(rec)
         return rec
 
     def _stage_spans(self, req: _Request, outcome: str,
                      bucket: Optional[int] = None,
                      tokens_per_sec: Optional[float] = None,
-                     end_ns: Optional[int] = None):
+                     end_ns: Optional[int] = None,
+                     draft_accept_rate: Optional[float] = None):
         """Stage ONE sampled request for span export: a flat tuple append
         (no dicts, no registry lock — the hot-path finding behind
         :func:`collect_deferred_spans`). Thread identity is captured here
@@ -347,7 +355,7 @@ class BatchScheduler:
             (req.request_id, req.lane, req.rows, req.t_submit_ns,
              req.t_open_ns, req.t_exec0_ns, req.t_exec1_ns, outcome,
              bucket, tokens_per_sec, end_ns or time.time_ns(),
-             th.ident, th.name))
+             th.ident, th.name, draft_accept_rate))
 
     def _materialize_spans(self) -> List[dict]:
         """Staged tuples -> Chrome phase events (queue_wait / batch_fill /
@@ -360,7 +368,7 @@ class BatchScheduler:
         pid = os.getpid()
         out: List[dict] = []
         for (rid, lane, rows, t_submit, t_open, t_exec0, t_exec1, outcome,
-             bucket, tps, end_ns, tid, tname) in staged:
+             bucket, tps, end_ns, tid, tname, accept) in staged:
             base = {"request_id": rid, "model": self.model_id,
                     "lane": lane, "outcome": outcome}
             if not outcome.startswith("shed"):
@@ -384,6 +392,10 @@ class BatchScheduler:
                     args["bucket"] = bucket
                 if tps is not None:
                     args["tokens_per_sec"] = round(tps, 3)
+                if accept is not None:
+                    # the per-request speculation ruler (ISSUE 15): how
+                    # much of the draft's work the target verified
+                    args["draft_accept_rate"] = round(accept, 4)
                 out.append(ev("serving.request.compute", t_exec0,
                               t_exec1, args))
         return out
@@ -702,6 +714,20 @@ class BatchScheduler:
                 results, stats = self.model.execute(
                     [r.payload for r in batch], _trace=trace_batch,
                     _step=seq, **batch[0].opts)
+            except ShedError as e:
+                # an EXECUTE-time shed (paged-pool exhaustion): a
+                # first-class 429 with its own cause, NOT a server error —
+                # the riders' futures carry the ShedError (the HTTP layer
+                # answers 429 + Retry-After), the per-lane shed counters
+                # and flight-recorder cause record it, and the breaker
+                # never hears about it (the model is healthy; the pool is
+                # full — r13 shed contract, new cause)
+                err_ns = time.time_ns()
+                reason = getattr(e, "shed_reason", "shed")
+                for req in batch:
+                    req.t_exec1_ns = err_ns
+                    self._shed(req, e, reason)
+                return
             except Exception as e:  # a bad request fails its batch, never
                 err_ns = time.time_ns()  # the worker (ParallelInference
                 for req in batch:        # contract)
@@ -742,8 +768,9 @@ class BatchScheduler:
             padded = stats.get("padded_rows")
             decode_s = stats.get("decode_seconds")
             decode_toks = stats.get("decode_tokens")
+            accept_rates = stats.get("draft_accept_rate")  # per rider, or None
             lane_done: collections.Counter = collections.Counter()
-            for req, res in zip(batch, results):
+            for ridx, (req, res) in enumerate(zip(batch, results)):
                 req.t_exec1_ns = exec1_ns
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_result(res)
@@ -768,15 +795,20 @@ class BatchScheduler:
                     if tps is not None:
                         tm.observe("serving.decode_tokens_per_sec", tps,
                                    model=self.model_id, lane=req.lane)
+                rate = (accept_rates[ridx]
+                        if accept_rates and ridx < len(accept_rates)
+                        else None)
                 keep = tracing and (req.sampled
                                     or lat * 1e3 > SLOW_REQUEST_MS)
                 self._flight_record(req, "ok", end_ns=exec1_ns,
                                     bucket=padded, traced=keep,
-                                    tokens_per_sec=tps)
+                                    tokens_per_sec=tps,
+                                    draft_accept_rate=rate)
                 if keep:
                     self._stage_spans(
                         req, "ok" if req.sampled else "slow",
-                        bucket=padded, tokens_per_sec=tps, end_ns=exec1_ns)
+                        bucket=padded, tokens_per_sec=tps, end_ns=exec1_ns,
+                        draft_accept_rate=rate)
         # one counter bump per lane per batch, not per request — registry
         # lock acquisitions on the worker are GIL time stolen from other
         # models' workers (the mixed-bench finding; see _LatencyWindow.add)
@@ -786,6 +818,11 @@ class BatchScheduler:
         tm.counter("serving.batches_total", model=self.model_id)
         tm.counter("serving.recompiles_total", stats.get("recompiles", 0),
                    model=self.model_id)
+        if stats.get("spec_accept_rate") is not None:
+            # batch-mean draft acceptance — the /metrics companion of the
+            # per-request flight-recorder field (ISSUE 15 satellite)
+            tm.gauge("serving.spec_accept_rate",
+                     float(stats["spec_accept_rate"]), model=self.model_id)
         if padded:
             tm.observe("serving.batch_occupancy",
                        stats["real_rows"] / padded,

@@ -32,6 +32,7 @@ _STATE = "state.npz"
 _UPDATER = "updaterState.npz"
 _META = "meta.json"
 _NORMALIZER = "normalizer.json"
+_SCALES = "quantScales.npz"  # int8 archives: per-channel scales
 
 
 def _leaves(tree) -> list:
@@ -81,7 +82,17 @@ class ModelSerializer:
     # ------------------------------------------------------------------ save
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True,
-                    normalizer=None) -> None:
+                    normalizer=None, quantize: str = None) -> None:
+        """``quantize="int8"`` writes a weight-only int8 SERVING archive
+        (docs/SERVING.md#paged-kv--speculative-decode): weight matrices/
+        embedding tables as int8 + per-channel fp32 scales (archive bytes
+        ~4× below fp32 — the dominant .npz members shrink 4×), updater
+        state never included (a quantized archive is a deployment
+        artifact, not a training checkpoint). ``restore_*`` dequantizes
+        back to an fp32 net AND stashes the stored int8 leaves on
+        ``net._int8_archive`` so ``ModelRouter.load(quantize="int8")``
+        serves the archive's exact quantization — bit-identical round
+        trip (serving/quantize.py)."""
         from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -91,6 +102,8 @@ class ModelSerializer:
             mtype = "ComputationGraph"
         else:
             raise TypeError(f"cannot serialize {type(model).__name__}")
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
 
         meta = {
             "type": mtype,
@@ -98,14 +111,29 @@ class ModelSerializer:
             "epoch": int(model.epoch),
             "rng_key": np.asarray(model._rng_key).tolist(),
             "params_structure": _fingerprint(model.params),
-            "has_updater_state": bool(save_updater),
+            "has_updater_state": bool(save_updater) and quantize is None,
             "format_version": 1,
         }
-        entries = [(_CONFIG, model.conf.to_json()),
-                   (_COEFF, _savez(_leaves(model.params))),
-                   (_STATE, _savez(_leaves(model.states)))]
-        if save_updater:
-            entries.append((_UPDATER, _savez(_leaves(model.opt_states))))
+        if quantize == "int8":
+            from deeplearning4j_tpu.serving.quantize import QuantizedParams
+
+            qp = QuantizedParams.from_params(model.params)
+            meta["quantize"] = "int8"
+            meta["fp32_bytes"] = qp.fp32_bytes()
+            # None scales (pass-through leaves) ride as size-0 arrays —
+            # npz members must be arrays; restore maps size-0 back to None
+            scales = [s if s is not None else np.zeros(0, np.float32)
+                      for s in qp.scales]
+            entries = [(_CONFIG, model.conf.to_json()),
+                       (_COEFF, _savez(qp.qleaves)),
+                       (_SCALES, _savez(scales)),
+                       (_STATE, _savez(_leaves(model.states)))]
+        else:
+            entries = [(_CONFIG, model.conf.to_json()),
+                       (_COEFF, _savez(_leaves(model.params))),
+                       (_STATE, _savez(_leaves(model.states)))]
+            if save_updater:
+                entries.append((_UPDATER, _savez(_leaves(model.opt_states))))
         entries.append((_META, json.dumps(meta)))
         if normalizer is not None:
             entries.append((_NORMALIZER, json.dumps(normalizer.to_dict())))
@@ -210,7 +238,29 @@ class ModelSerializer:
                     "checkpoint param structure does not match the model built "
                     "from its configuration (corrupt or hand-edited archive)"
                 )
-            net.params = _refill(net.params, _loadz(zf.read(_COEFF)))
+            if meta.get("quantize") == "int8":
+                # int8 serving archive: dequantize back to an fp32 net
+                # (the generic restore contract holds everywhere), and
+                # stash the STORED quantization so a quantize="int8"
+                # serving load adopts it verbatim — no re-quantization
+                # drift, bit-identical round trip (serving/quantize.py)
+                qleaves = _loadz(zf.read(_COEFF))
+                scales = [None if s.size == 0 else s
+                          for s in _loadz(zf.read(_SCALES))]
+                if len(qleaves) != len(scales):
+                    raise ValueError(
+                        "int8 archive scale count does not match its "
+                        "coefficient count (corrupt archive)")
+                from deeplearning4j_tpu.ops.compression import dequantize_np
+
+                deq = [q if s is None else dequantize_np(q, s)
+                       for q, s in zip(qleaves, scales)]
+                net.params = _refill(net.params, deq)
+                net._int8_archive = (
+                    jax.tree_util.tree_structure(net.params),
+                    qleaves, scales)
+            else:
+                net.params = _refill(net.params, _loadz(zf.read(_COEFF)))
             net.states = _refill(net.states, _loadz(zf.read(_STATE)))
             if load_updater and meta.get("has_updater_state") and _UPDATER in zf.namelist():
                 net.opt_states = _refill(net.opt_states, _loadz(zf.read(_UPDATER)))
@@ -237,7 +287,8 @@ class ModelSerializer:
         with zipfile.ZipFile(path, "r") as zf:
             meta = json.loads(zf.read(_META))
         return {k: meta[k] for k in
-                ("type", "iteration", "epoch", "format_version")
+                ("type", "iteration", "epoch", "format_version",
+                 "quantize", "fp32_bytes")
                 if k in meta}
 
     @staticmethod

@@ -70,6 +70,20 @@ class Bert(ZooModel):
         kw.setdefault("n_heads", 2)
         return cls(**kw)
 
+    @classmethod
+    def draft(cls, **kw):
+        """Draft-model size (1L/64H, causal, no dropout) for speculative
+        decoding (serving/generate.py): a few-percent-of-target net that
+        proposes tokens the target verifies in one batched window. Share
+        the target's ``vocab_size``/``max_length`` when constructing."""
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("n_layers", 1)
+        kw.setdefault("n_heads", 1)
+        kw.setdefault("hidden_dropout", 0.0)
+        kw.setdefault("causal", True)
+        kw.setdefault("task", "mlm")
+        return cls(**kw)
+
     def conf(self):
         lb = self._builder().list()
         lb.layer(BertEmbeddingLayer(

@@ -247,6 +247,10 @@ OVERRIDES = {
     "threshold_encode_exact": lambda f: f(XN, 0.1),
     "onebit_encode": lambda f: f(XN),
     "pow2_floor": lambda f: f(0.3),
+    # weight-only int8 serving pair (ISSUE 15, serving/quantize.py)
+    "quantize_per_channel": lambda f: f(XN, jnp.full((1, 6), 0.01)),
+    "dequantize_per_channel": lambda f: f(
+        jnp.asarray(XN * 100, jnp.int8), jnp.full((1, 6), 0.01)),
     "bitmap_encode": lambda f: f(XN, 0.1),
     "bitmap_decode": lambda f: None,  # needs encode output; covered in test_distributed
     "lstm_layer": lambda f: f(jnp.ones((3, 2, 4)), jnp.ones((1, 8, 4)) * 0.1,

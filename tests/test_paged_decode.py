@@ -1,0 +1,441 @@
+"""Paged KV cache + speculative decoding + int8 serving (ISSUE 15).
+
+The acceptance contracts: paged-cache greedy decode TOKEN-IDENTICAL to the
+contiguous r13 cache (and the O(T²) recompute oracle) for ragged prompts
+crossing page boundaries; speculative greedy TOKEN-IDENTICAL to
+non-speculative greedy — including a draft that is always wrong (k
+rejections per round); eos mid-speculation-window; temperature>0 falling
+back to verify-consistent sampling; pool exhaustion as a first-class 429
+shed with blocks freed and reused; int8 round-trip through ModelSerializer
+archives within the pinned tolerance with the fp32 path bit-unchanged;
+ONE decode executable serving mixed context lengths with 0 steady-state
+recompiles."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (BatchScheduler, Generator,
+                                        INT8_LOGIT_TOL, ModelRouter,
+                                        PoolExhaustedError, ServingModel)
+from deeplearning4j_tpu.util.compile_watcher import get_watcher
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+from deeplearning4j_tpu.zoo.bert import Bert
+
+VOCAB = 43
+MAXLEN = 32
+BUCKETS = dict(batch_buckets=(1, 2, 4), prefill_buckets=(8, 16))
+
+#: ragged prompts whose contexts CROSS page boundaries at block_size=4
+#: (lengths 3/5/9 → 1/2/3 blocks before decoding even starts)
+RAGGED = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10, 11, 12, 13, 14, 15, 16, 17]]
+
+
+@pytest.fixture(scope="module")
+def target_net():
+    return Bert.tiny(causal=True, task="mlm", vocab_size=VOCAB,
+                     max_length=MAXLEN, hidden_dropout=0.0).init()
+
+
+@pytest.fixture(scope="module")
+def draft_net():
+    return Bert.draft(vocab_size=VOCAB, max_length=MAXLEN, seed=7).init()
+
+
+@pytest.fixture(scope="module")
+def gen_contiguous(target_net):
+    return Generator(target_net, paged=False, **BUCKETS)
+
+
+@pytest.fixture(scope="module")
+def gen_paged(target_net):
+    return Generator(target_net, paged=True, block_size=4, **BUCKETS)
+
+
+@pytest.fixture(scope="module")
+def gen_spec(target_net, draft_net):
+    return Generator(target_net, paged=True, block_size=4,
+                     draft_net=draft_net, spec_tokens=3, **BUCKETS)
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(gen_contiguous):
+    return gen_contiguous.generate(RAGGED, max_new_tokens=8)
+
+
+class TestPagedIdentity:
+    def test_paged_equals_contiguous_and_recompute(self, gen_paged,
+                                                   gen_contiguous,
+                                                   ref_tokens):
+        """The acceptance bit: paged greedy == contiguous greedy == O(T²)
+        recompute, token-for-token, on ragged page-boundary-crossing
+        prompts."""
+        paged = gen_paged.generate(RAGGED, max_new_tokens=8)
+        assert paged == ref_tokens
+        assert paged == gen_contiguous.generate_full_recompute(
+            RAGGED, max_new_tokens=8)
+        assert all(len(r) == 8 for r in paged)
+
+    def test_blocks_freed_after_batch(self, gen_paged):
+        pool = gen_paged.pool
+        assert pool.free_blocks() == pool.num_blocks
+        gen_paged.generate([[1, 2, 3, 4, 5]], max_new_tokens=4)
+        assert pool.free_blocks() == pool.num_blocks
+
+    def test_sampled_paged_equals_contiguous(self, gen_paged,
+                                             gen_contiguous):
+        """temperature>0: the paged loop consumes the same key stream, so
+        sampled output is identical too (stream-exact)."""
+        key = jax.random.PRNGKey(11)
+        a = gen_paged.generate(RAGGED, max_new_tokens=6, temperature=0.7,
+                               key=key)
+        b = gen_contiguous.generate(RAGGED, max_new_tokens=6,
+                                    temperature=0.7, key=key)
+        assert a == b
+
+    def test_one_executable_mixed_context_lengths(self, gen_paged):
+        """ONE decode executable serves mixed context lengths: after
+        warmup, batches at wildly different context lengths trace
+        NOTHING."""
+        gen_paged.warmup()
+        w = get_watcher()
+        with w.scope() as s:
+            gen_paged.generate([[1, 2]], max_new_tokens=4)
+            gen_paged.generate([[i % VOCAB for i in range(20)]],
+                               max_new_tokens=4)
+            gen_paged.generate(RAGGED, max_new_tokens=4)
+        assert s.traces == 0, f"steady-state decode traced {s.traces}x"
+
+    def test_eos_early_exit_frees_blocks_and_trims(self, gen_paged,
+                                                   gen_contiguous):
+        ref = gen_contiguous.generate([[1, 2, 3]], max_new_tokens=8)
+        eos = ref[0][2]  # third generated token
+        out = gen_paged.generate([[1, 2, 3]], max_new_tokens=8, eos_id=eos)
+        want = ref[0][:ref[0].index(eos) + 1]
+        assert out[0] == want
+        assert gen_paged.pool.free_blocks() == gen_paged.pool.num_blocks
+
+
+class TestSpeculative:
+    def test_spec_greedy_token_identical(self, gen_spec, ref_tokens):
+        stats = {}
+        out = gen_spec.generate(RAGGED, max_new_tokens=8, stats=stats)
+        assert out == ref_tokens
+        rates = stats["draft_accept_rate"]
+        assert len(rates) == len(RAGGED)
+        assert all(r is not None and 0.0 <= r <= 1.0 for r in rates)
+        assert stats["spec_rounds"] >= 1
+
+    def test_self_draft_accepts_everything(self, target_net, ref_tokens):
+        """draft == target: every proposal verifies, accept rate 1.0 and
+        far fewer rounds than tokens."""
+        gen = Generator(target_net, paged=True, block_size=4,
+                        draft_net=target_net, spec_tokens=3, **BUCKETS)
+        stats = {}
+        out = gen.generate(RAGGED, max_new_tokens=8, stats=stats)
+        assert out == ref_tokens
+        assert stats["spec_accept_rate"] == 1.0
+        # 1 prefill token + ceil(7 / 4) fully-accepted windows
+        assert stats["spec_rounds"] <= 3
+
+    def test_draft_always_wrong_still_identical(self, gen_spec,
+                                                ref_tokens):
+        """k rejections per round: a draft proposing (token+1) mod V —
+        essentially never the target's argmax — still yields the exact
+        greedy sequence, one token per round (the correction token is the
+        target's own logits)."""
+        draft = gen_spec.draft
+        orig = draft._decode_jit
+        try:
+            def wrong(raw, caches, tokens, positions):
+                return (jax.nn.one_hot((tokens + 1) % VOCAB, VOCAB),
+                        caches)
+
+            draft._decode_jit = wrong
+            stats = {}
+            out = gen_spec.generate(RAGGED, max_new_tokens=8, stats=stats)
+        finally:
+            draft._decode_jit = orig
+        assert out == ref_tokens
+        assert stats["spec_accept_rate"] <= 0.25  # wrong ~always
+
+    def test_eos_mid_speculation_window(self, gen_spec, gen_contiguous):
+        """eos landing INSIDE an accepted window trims exactly like the
+        non-speculative path."""
+        prompts = [RAGGED[0], RAGGED[1]]
+        ref = gen_contiguous.generate(prompts, max_new_tokens=8)
+        eos = ref[0][3]  # 4th token: mid-window at spec_tokens=3
+        out = gen_spec.generate(prompts, max_new_tokens=8, eos_id=eos)
+        want = [r[:r.index(eos) + 1] if eos in r else r for r in ref]
+        assert out == want
+        assert gen_spec.pool.free_blocks() == gen_spec.pool.num_blocks
+
+    def test_temperature_falls_back_to_plain_sampling(self, gen_spec,
+                                                      gen_contiguous):
+        """The verify-consistent sampling satellite: temperature>0 on a
+        speculating generator routes through the plain per-token loop —
+        identical streams to the non-speculative path."""
+        key = jax.random.PRNGKey(3)
+        a = gen_spec.generate(RAGGED, max_new_tokens=6, temperature=0.9,
+                              key=key)
+        b = gen_contiguous.generate(RAGGED, max_new_tokens=6,
+                                    temperature=0.9, key=key)
+        assert a == b
+
+
+class TestPoolExhaustion:
+    def test_exhaustion_sheds_and_blocks_reused(self, target_net):
+        """All-or-nothing admission: an over-pool batch sheds with nothing
+        allocated, and the freed pool serves the next batch (block
+        free/reuse after shed)."""
+        gen = Generator(target_net, paged=True, block_size=4,
+                        pool_blocks=4, batch_buckets=(1, 2, 4),
+                        prefill_buckets=(8,))
+        with pytest.raises(PoolExhaustedError):
+            gen.generate([[1] * 8, [2] * 8, [3] * 8], max_new_tokens=8)
+        assert gen.pool.free_blocks() == gen.pool.num_blocks
+        out = gen.generate([[1, 2, 3]], max_new_tokens=8)  # 3 blocks
+        assert len(out[0]) == 8
+        assert gen.pool.free_blocks() == gen.pool.num_blocks
+
+    def test_scheduler_first_class_shed(self, target_net):
+        """The r13 shed contract, new cause: PoolExhaustedError through
+        the scheduler is a shed (429 + Retry-After via ShedError), with
+        its own flight-recorder cause and per-lane counter — never an
+        error, never a breaker outcome."""
+        model = ServingModel(target_net, "small-pool", kind="generate",
+                             bucketing="batch=1,2;seq=8", block_size=4,
+                             pool_blocks=2)
+        model.warmup()
+        sched = BatchScheduler(model, max_wait_ms=1.0)
+        sched.start()
+        try:
+            fut = sched.submit(np.asarray([1] * 8, np.int32),
+                               max_new_tokens=20)  # needs 7 blocks > 2
+            with pytest.raises(PoolExhaustedError):
+                fut.result(timeout=30)
+            assert sched.counts["shed_pool_exhausted"] == 1
+            assert sched.counts["errors"] == 0
+            assert sched.lane_counts["interactive"][
+                "shed_pool_exhausted"] == 1
+            rec = sched.flight.dump(last=1)[0]
+            assert rec["status"] == "shed"
+            assert rec["cause"] == "pool_exhausted"
+            assert sched.breaker.state == "closed"
+            # pool freed: a fitting request decodes fine afterwards
+            fut2 = sched.submit(np.asarray([1, 2], np.int32),
+                                max_new_tokens=4)
+            assert len(fut2.result(timeout=30)) == 4
+        finally:
+            sched.shutdown()
+
+    def test_auto_pool_grows_instead_of_shedding(self, target_net):
+        """An AUTO-sized pool (no operator budget) must never refuse a
+        batch the contiguous engine would have served: exhaustion grows
+        the pool (review finding r20). A PINNED pool keeps the shed."""
+        gen = Generator(target_net, paged=True, block_size=4,
+                        batch_buckets=(1, 2, 4), prefill_buckets=(8,))
+        # shrink the auto pool under the batch's need, keeping auto mode
+        gen.pool = type(gen.pool)(gen.blocks, block_size=4, num_blocks=4,
+                                  max_length=gen.max_length)
+        assert gen._pool_auto
+        out = gen.generate([[1] * 8, [2] * 8, [3] * 8],
+                           max_new_tokens=8)  # needs 12 > 4 blocks
+        assert all(len(r) == 8 for r in out)
+        assert gen.pool.num_blocks >= 12
+        assert gen.pool.free_blocks() == gen.pool.num_blocks
+
+    def test_stream_accounting(self, target_net):
+        gen = Generator(target_net, paged=True, block_size=4,
+                        pool_blocks=24, batch_buckets=(1, 2, 4),
+                        prefill_buckets=(8,))
+        gen.generate(RAGGED, max_new_tokens=4)
+        st = gen.pool.stats()
+        assert st["peak_streams"] == 3
+        assert st["streams"] == 0
+        assert st["contiguous_stream_ceiling"] == (24 * 4) // MAXLEN
+
+
+class TestInt8Serving:
+    def test_resident_bytes_and_tolerance(self, target_net, gen_paged):
+        """Acceptance: resident int8 bytes ≥3.5× below fp32, prefill
+        logits within the pinned tolerance, decode runs end to end."""
+        gen = Generator(target_net, paged=True, block_size=4,
+                        quantize="int8", **BUCKETS)
+        qp = gen._qp
+        assert qp.fp32_bytes() / qp.resident_bytes() >= 3.5
+        tokens = jnp.asarray(np.asarray([RAGGED[1] + [0] * 3], np.int32))
+        lengths = jnp.asarray([5], jnp.int32)
+        tables = jnp.zeros((1, gen.pool.max_blocks_per_stream), jnp.int32)
+        ql, pools = gen._prefill_paged_jit(gen._raw_params(),
+                                           gen.pool.pools, tokens,
+                                           lengths, tables)
+        gen.pool.pools = pools
+        t2 = jnp.zeros((1, gen_paged.pool.max_blocks_per_stream),
+                       jnp.int32)
+        fl, fpools = gen_paged._prefill_paged_jit(
+            gen_paged._raw_params(), gen_paged.pool.pools, tokens,
+            lengths, t2)
+        gen_paged.pool.pools = fpools
+        assert float(jnp.max(jnp.abs(ql - fl))) <= INT8_LOGIT_TOL
+        out = gen.generate(RAGGED, max_new_tokens=6)
+        assert all(len(r) == 6 for r in out)
+
+    def test_fp32_path_bit_unchanged(self, target_net, gen_paged,
+                                     ref_tokens):
+        """Quantization is strictly opt-in: building an int8 generator
+        mutates nothing, and the fp32 generator's output is bit-unchanged
+        next to it."""
+        before = [np.asarray(x).copy()
+                  for x in jax.tree_util.tree_leaves(target_net.params)]
+        Generator(target_net, paged=True, block_size=4, quantize="int8",
+                  **BUCKETS)
+        after = jax.tree_util.tree_leaves(target_net.params)
+        assert all(np.array_equal(b, np.asarray(a))
+                   for b, a in zip(before, after))
+        assert gen_paged.generate(RAGGED, max_new_tokens=8) == ref_tokens
+
+    def test_archive_roundtrip(self, target_net, tmp_path):
+        """int8 round-trip through ModelSerializer: archive ~4× smaller,
+        the stored quantization adopted VERBATIM on load (bit-identical
+        to the pre-save quantized serving), and plain restore dequantizes
+        to a usable fp32 net."""
+        fp32 = str(tmp_path / "m.zip")
+        int8 = str(tmp_path / "m8.zip")
+        ModelSerializer.write_model(target_net, fp32, save_updater=False)
+        ModelSerializer.write_model(target_net, int8, quantize="int8")
+        assert os.path.getsize(fp32) / os.path.getsize(int8) >= 3.5
+        meta = ModelSerializer.peek_meta(int8)
+        assert meta["quantize"] == "int8"
+
+        mem = Generator(target_net, paged=True, block_size=4,
+                        quantize="int8", batch_buckets=(1, 2),
+                        prefill_buckets=(8,))
+        want = mem.generate(RAGGED[:2], max_new_tokens=6)
+
+        router = ModelRouter("int8-rt")
+        try:
+            router.load("q8", int8, kind="generate", quantize="int8",
+                        bucketing="batch=1,2;seq=8", block_size=4)
+            model, _ = router.get("q8")
+            model.warmup()
+            got, _ = model.execute(
+                [np.asarray(p, np.int32) for p in RAGGED[:2]],
+                max_new_tokens=6)
+            assert list(got) == want
+            # the archive's quantization was adopted, not recomputed
+            assert model.generator._qp is not None
+        finally:
+            router.shutdown()
+
+        # plain restore: a dequantized fp32 net, params within tolerance
+        net2 = ModelSerializer.restore_model(int8)
+        a = jax.tree_util.tree_leaves(target_net.params)
+        b = jax.tree_util.tree_leaves(net2.params)
+        for x, y in zip(a, b):
+            x, y = np.asarray(x), np.asarray(y)
+            if x.ndim >= 2 and x.size >= 256:
+                scale = np.abs(x).max() / 127.0
+                assert np.max(np.abs(x - y)) <= scale + 1e-6
+            else:
+                assert np.array_equal(x, y)
+
+    def test_stale_int8_stash_not_served(self, target_net, tmp_path):
+        """A net restored from an int8 archive and then MUTATED must not
+        serve the stale archived quantization (review finding r20): the
+        stash is validated against the live params and falls through to
+        fresh quantization."""
+        from deeplearning4j_tpu.serving.quantize import maybe_quantize
+
+        path = str(tmp_path / "m8.zip")
+        ModelSerializer.write_model(target_net, path, quantize="int8")
+        net = ModelSerializer.restore_model(path)
+        assert getattr(net, "_int8_archive", None) is not None
+        qp0 = maybe_quantize(net, "int8")  # untouched: stash adopted
+        assert np.array_equal(np.asarray(qp0.qleaves[0]),
+                              np.asarray(net._int8_archive[1][0]))
+        # mutate the live params — the stash is now stale
+        leaves = jax.tree_util.tree_leaves(net.params)
+        big = max(range(len(leaves)), key=lambda i: leaves[i].size)
+        mutated = [np.asarray(l).copy() for l in leaves]
+        mutated[big] = mutated[big] + 1.0
+        net.params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(net.params), mutated)
+        qp1 = maybe_quantize(net, "int8")
+        deq = np.asarray(qp1.qleaves[big], np.float32) * qp1.scales[big]
+        assert np.max(np.abs(deq - mutated[big])) <= float(
+            np.abs(mutated[big]).max() / 127.0) + 1e-6
+
+    def test_resident_bytes_no_host_copy(self, target_net):
+        """resident_bytes reads .nbytes without np.asarray — it runs on
+        every status poll (review finding r20)."""
+        from deeplearning4j_tpu.serving.quantize import QuantizedParams
+
+        qp = QuantizedParams.from_params(target_net.params).device_put()
+        assert qp.resident_bytes() > 0
+        assert qp.fp32_bytes() / qp.resident_bytes() >= 3.5
+
+    @staticmethod
+    def _dense_net(seed=0):
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3))
+                .batch_buckets((2, 4)).list()
+                .layer(DenseLayer(n_in=12, n_out=48, activation="relu"))
+                .layer(OutputLayer(n_in=48, n_out=5, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_int8_classify_within_tolerance(self):
+        """The classify leg: int8 ServingModel output within tolerance of
+        the fp32 forward; the fp32 ServingModel stays bit-exact."""
+        net = self._dense_net()
+        x = np.random.default_rng(0).normal(size=(3, 12)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+
+        q = ServingModel(net, "q-clf", quantize="int8")
+        q.warmup()
+        got, _ = q.execute([x])
+        assert np.max(np.abs(np.asarray(got[0]) - ref)) <= INT8_LOGIT_TOL
+
+        f = ServingModel(net, "f-clf")
+        f.warmup()
+        got32, _ = f.execute([x])
+        assert np.array_equal(np.asarray(got32[0]), ref)
+
+    def test_int8_classify_reload_serves_new_weights(self, tmp_path):
+        """Rolling reload of an int8 classify model must swap the
+        quantized residents WITH the net (review finding r20): the
+        post-reload output tracks the NEW weights, not the old int8
+        closure."""
+        net_a = self._dense_net(seed=0)
+        net_b = self._dense_net(seed=9)  # same topology, new weights
+        path = str(tmp_path / "b.zip")
+        ModelSerializer.write_model(net_b, path, save_updater=False)
+        x = np.random.default_rng(1).normal(size=(3, 12)).astype(np.float32)
+
+        router = ModelRouter("int8-reload")
+        try:
+            router.register(ServingModel(net_a, "clf", quantize="int8"),
+                            start=False)
+            model, _sched = router.get("clf")
+            model.warmup()
+            before, _ = model.execute([x])
+            version = router.reload("clf", path)
+            assert version == 2
+            after, _ = model.execute([x])
+            ref_b = np.asarray(net_b.output(x))
+            assert np.max(np.abs(np.asarray(after[0]) - ref_b)) \
+                <= INT8_LOGIT_TOL
+            assert not np.array_equal(np.asarray(before[0]),
+                                      np.asarray(after[0]))
+        finally:
+            router.shutdown()
