@@ -103,7 +103,7 @@ echo "== step: Resilience smoke (reload storm + fault recoveries + brownout) =="
 # exhaustion -> batch-lane brownout while interactive serves, clean drain.
 JAX_PLATFORMS=cpu python benchmarks/resilience_smoke.py
 
-echo "== step: Decode smoke (paged KV + speculative + int8 over HTTP) =="
+echo "== step: Decode smoke (paged KV + speculative + int8 + prefix cache over HTTP) =="
 # ISSUE 15: the planet-scale decode path on real HTTP — mixed-length
 # paged+speculative traffic TOKEN-IDENTICAL to the non-speculative greedy
 # reference with 0 steady-state recompiles, pool exhaustion -> first-class
@@ -111,6 +111,10 @@ echo "== step: Decode smoke (paged KV + speculative + int8 over HTTP) =="
 # shed, paged concurrent streams beating the contiguous-cache ceiling,
 # int8 serving alongside fp32 (resident + archive bytes >= 3.5x below
 # fp32, gauge-asserted), spec_accept_rate/draft_accept_rate surfaces.
+# Plus the ISSUE 16 legs: prefix-heavy traffic (shared system prompt)
+# token-identical cold AND warm with hit_rate > 0, 0 recompiles and the
+# 429 contract intact under prefix sharing; long-prompt chunked-prefill
+# burst with bounded interactive latency.
 JAX_PLATFORMS=cpu python benchmarks/decode_smoke.py
 
 echo "== step: Kernel-engine equivalence (Pallas interpret, fused optimizer) =="

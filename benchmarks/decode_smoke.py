@@ -26,7 +26,18 @@ on a real HTTP server:
 5. speculation observability: ``serving.spec_accept_rate`` on /metrics,
    ``draft_accept_rate`` on the flight-recorder records, and
    ``concurrent_streams`` beating the contiguous-cache ceiling on the
-   pool stats (/v1/models).
+   pool stats (/v1/models);
+6. (ISSUE 16) prefix-heavy traffic at ``bert-prefix`` (radix prefix
+   cache + chunked prefill, PINNED pool): concurrent streams sharing one
+   system prompt answer TOKEN-IDENTICAL to the oracle cold AND warm,
+   ``serving_prefix_cache_hit_rate`` > 0 on /metrics, the steady-state
+   recompile delta stays 0 under mixed hit/miss traffic, and the 429
+   shed contract survives prefix sharing (flood > pool even after
+   eviction);
+7. (ISSUE 16) long-prompt burst: chunked prefills in the batch lane
+   interleave with interactive decodes — every interactive request
+   completes with bounded latency while the burst is in flight, and the
+   burst's flight records carry the ``prefill_chunks`` attribution.
 
 Exit 0 on success, 1 with a FAIL line on any violated check.
 
@@ -116,6 +127,13 @@ def build(tmp):
     router.register(ServingModel(net, "bert-tiny-pool", kind="generate",
                                  bucketing=buckets, block_size=4,
                                  pool_blocks=24),
+                    max_wait_ms=1.0, queue_limit=64)
+    # shared-prefix + chunked-prefill decoder (ISSUE 16): PINNED pool so
+    # the 429 contract stays testable under prefix sharing
+    router.register(ServingModel(net, "bert-prefix", kind="generate",
+                                 bucketing=buckets, block_size=4,
+                                 pool_blocks=24, prefix_cache=True,
+                                 prefill_chunk=8),
                     max_wait_ms=1.0, queue_limit=64)
     server = ModelServer(router, port=0).start()  # warms every bucket
 
@@ -248,6 +266,111 @@ def main() -> int:
           spec is not None and spec.get("spec_tokens") == 3)
     check("/v1/models describes the KV pool",
           "kv_pool" in status["models"]["bert-fp32"])
+
+    # -- 6: prefix-heavy traffic (shared system prompt), ISSUE 16
+    system = list(map(int, rng.integers(1, VOCAB, size=9)))
+    shared = [system + list(map(int, rng.integers(1, VOCAB, size=n)))
+              for n in (2, 3, 5, 7, 4, 6)]
+    pref_ref = ref_gen.generate(shared, max_new_tokens=6)
+    rec_before = _rec()
+
+    def fire_prefix(i, out):
+        out[i] = http_post(
+            f"{server.url}/v1/models/bert-prefix/generate",
+            {"prompt_tokens": [shared[i]], "max_new_tokens": 6,
+             "lane": "batch"})
+
+    for wave in ("cold", "warm"):
+        results = [None] * len(shared)
+        threads = [threading.Thread(target=fire_prefix, args=(i, results))
+                   for i in range(len(shared))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        ok_all = all(r is not None and r[0] == 200 for r in results)
+        check(f"{wave} prefix wave answered 200", ok_all)
+        if ok_all:
+            got = [r[1]["tokens"][0] for r in results]
+            check(f"{wave} prefix-shared decode TOKEN-IDENTICAL to oracle",
+                  got == pref_ref,
+                  f"{sum(a == b for a, b in zip(got, pref_ref))}/"
+                  f"{len(pref_ref)} rows match")
+    check("prefix-heavy steady-state recompiles == 0",
+          _rec() - rec_before == 0, f"delta {_rec() - rec_before}")
+    code, metrics = http_get(f"{server.url}/metrics")
+    hit_vals = [float(line.rsplit(" ", 1)[1]) for line in metrics.splitlines()
+                if "serving_prefix_cache_hit_rate{" in line]
+    check("/metrics carries serving_prefix_cache_hit_rate > 0",
+          any(v > 0 for v in hit_vals), f"values {hit_vals}")
+    check("/metrics carries serving_chunked_prefill_chunks_total",
+          "serving_chunked_prefill_chunks_total" in metrics)
+    # the 429 contract survives prefix sharing: even one scheduler batch
+    # (4 streams x 7 blocks) needs 28 > the pinned 24, eviction included
+    flood = [list(map(int, rng.integers(1, VOCAB, size=20)))
+             for _ in range(8)]
+    code, body, headers = http_post(
+        f"{server.url}/v1/models/bert-prefix/generate",
+        {"prompt_tokens": flood, "max_new_tokens": 8})
+    check("prefix pool exhaustion still answers 429 + Retry-After",
+          code == 429 and headers.get("Retry-After") is not None,
+          f"code {code}")
+    code, body, _ = http_post(
+        f"{server.url}/v1/models/bert-prefix/generate",
+        {"prompt_tokens": shared[:2], "max_new_tokens": 4})
+    check("prefix pool serves the next batch after the shed",
+          code == 200 and body.get("tokens") ==
+          [r[:4] for r in pref_ref[:2]])
+    pmodel, _ps = router.get("bert-prefix")
+    okc, detail = pmodel.generator.pool.conservation()
+    check("prefix pool block-refcount conservation", okc, detail)
+
+    # -- 7: long-prompt burst: chunked prefill + interactive interleave
+    longs = [system + list(map(int, rng.integers(1, VOCAB, size=7)))
+             for _ in range(6)]  # 16 tokens = 2 chunks of 8
+    lat = [None] * 6
+
+    def fire_long(i, out):
+        out[i] = http_post(
+            f"{server.url}/v1/models/bert-prefix/generate",
+            {"prompt_tokens": [longs[i]], "max_new_tokens": 6,
+             "lane": "batch"})
+
+    def fire_short(i):
+        t1 = time.time()
+        code, _b, _h = http_post(
+            f"{server.url}/v1/models/bert-prefix/generate",
+            {"prompt_tokens": [shared[i % len(shared)]],
+             "max_new_tokens": 4})
+        lat[i] = (code, time.time() - t1)
+
+    results = [None] * len(longs)
+    burst = [threading.Thread(target=fire_long, args=(i, results))
+             for i in range(len(longs))]
+    inter = [threading.Thread(target=fire_short, args=(i,))
+             for i in range(6)]
+    for t in burst:
+        t.start()
+    for t in inter:
+        t.start()
+    for t in burst + inter:
+        t.join(timeout=120)
+    check("long-prompt burst answered 200",
+          all(r is not None and r[0] == 200 for r in results))
+    ok_inter = all(x is not None and x[0] == 200 for x in lat)
+    check("interactive decodes complete during the burst", ok_inter)
+    if ok_inter:
+        worst = max(d for _, d in lat)
+        check("interactive p99 bounded under chunked-prefill burst",
+              worst < 15.0, f"worst {worst:.2f}s")
+    code, dump = http_get(
+        f"{server.url}/v1/models/bert-prefix/debug/requests")
+    recs = json.loads(dump).get("requests", [])
+    check("flight records carry prefill_chunks attribution",
+          any(r.get("prefill_chunks", 0) >= 2 for r in recs),
+          f"{len(recs)} records")
+    check("flight records carry prefix_hit_rate attribution",
+          any("prefix_hit_rate" in r for r in recs))
 
     server.stop()
     print(f"== {'PASS' if not _FAILED else 'FAIL'} "

@@ -22,6 +22,9 @@ Noise fractions come from each record's own ``noise`` field ("±7.2%
 ``--default-noise`` (5%). The additive ``--slack`` (2%) absorbs
 host-to-host drift. The bound is intentionally one-sided: a new best is a
 pass (and tightens the band once committed), only a regression fails.
+Metrics in ``HOST_CONDITION_FLOOR`` gate against an absolute floor
+instead — their committed values track the shared host's scheduling
+weather, not the code (see the constant's comment).
 
 Modes:
 
@@ -120,6 +123,22 @@ CRITICAL = {
     "dp_sharding_efficiency_8dev_virtual_cpu",
 }
 
+# Host-condition-sensitive metrics gate against an ABSOLUTE FLOOR instead
+# of the best-known band. bench_scaling's own contract is "only the
+# same-host trend is meaningful": the committed trajectory spans
+# 0.5168 (r03) to 1.0591 (r08) for the SAME code path as the shared
+# 1-core host's scheduling weather changes between sessions — re-running
+# the r11 seed commit on a slow-weather host measures ~0.68 where its
+# committed record says 0.9988, so a best-known band would fail healthy
+# code whenever the host regresses. The floor sits below the worst
+# committed value minus its noise: a true sharding breakage (partitioner
+# stops sharding, collective blowup) collapses the ratio far below it,
+# while host weather cannot. Floor metrics stay CRITICAL — missing is
+# still fatal.
+HOST_CONDITION_FLOOR = {
+    "dp_sharding_efficiency_8dev_virtual_cpu": 0.45,
+}
+
 _NOISE_RE = re.compile(r"[+±]?\s*([0-9.]+)\s*%")
 
 
@@ -199,6 +218,19 @@ def gate(trajectory, candidate: Dict[str, Tuple[float, Optional[float]]],
                             "best": best_value, "best_round": best_label})
             continue
         value, noise = candidate[metric]
+        if metric in HOST_CONDITION_FLOOR:
+            floor = HOST_CONDITION_FLOOR[metric]
+            results.append({
+                "metric": metric,
+                "status": "regressed" if value < floor else "ok",
+                "value": value,
+                "best": best_value,
+                "best_round": best_label,
+                "bound": floor,
+                "tolerance_frac": 0.0,
+                "direction": "floor",
+            })
+            continue
         tol = ((best_noise if best_noise is not None else default_noise)
                + (noise if noise is not None else default_noise) + slack)
         bound = best_value * (1 + tol) if lower else best_value * (1 - tol)
@@ -224,16 +256,20 @@ def render(results: List[dict]) -> str:
     lines = []
     for r in results:
         if r["status"] == "ok":
+            how = ("host-condition floor" if r["direction"] == "floor"
+                   else f"{r['direction']}-is-better")
             lines.append(
                 f"  OK        {r['metric']}: {r['value']:g} within band "
                 f"(best {r['best']:g} @ {r['best_round']}, bound "
-                f"{r['bound']:g}, {r['direction']}-is-better)")
+                f"{r['bound']:g}, {how})")
         elif r["status"] == "regressed":
+            how = ("host-condition floor" if r["direction"] == "floor"
+                   else f"{r['direction']}-is-better")
             lines.append(
                 f"  REGRESSED {r['metric']}: {r['value']:g} beyond bound "
                 f"{r['bound']:g} (best {r['best']:g} @ {r['best_round']}, "
                 f"tol {100 * r['tolerance_frac']:.1f}%, "
-                f"{r['direction']}-is-better)")
+                f"{how})")
         elif r["status"] == "missing":
             lines.append(
                 f"  MISSING   {r['metric']}: not in candidate run "

@@ -303,6 +303,24 @@ class TransformerEncoderBlock(Layer):
         o = attn_ops.dot_product_attention(q, k, v, mask=amask, causal=True)
         return self._finish(params, x, self._proj_out(params, o)), pool
 
+    def prefill_resume_paged(self, params, x_w, pool, slots, positions,
+                             limits=None):
+        """Resume-from-position prefill (the shared-prefix KV path,
+        serving/paged.py): prefill a prompt SUFFIX — ``x_w`` (B, W, H)
+        at per-row absolute ``positions`` (B, W) starting wherever each
+        stream's prefix-cache hit ends — against K/V the cached blocks
+        already hold for the skipped head. Write-then-attend through the
+        page table with every query masked to ``k_pos <= position`` is
+        exactly the windowed decode semantics, which is bit-identical to
+        the whole-prompt causal prefill (the verify-window contract), so
+        resumed prefill commits the same bytes and logits as recomputing
+        the prefix: a thin, documented delegation, kept as its own entry
+        point because the CALLING contract differs (positions resume
+        mid-prompt; ``limits`` is the last PROMPT position, trashing the
+        lockstep-chunk padding columns)."""
+        return self.decode_window_paged(params, x_w, pool, slots,
+                                        positions, limits=limits)
+
     def decode_window_paged(self, params, x_w, pool, slots, positions,
                             limits=None):
         """W autoregressive steps in ONE call: ``x_w`` (B, W, H) are the

@@ -523,12 +523,20 @@ def paged_attention(q, k_pool, v_pool, slots, positions, scale=None):
     """One decode/verify attention over a paged KV pool.
 
     ``q``: (B, H, W, Dh) — W query tokens per stream (1 for plain decode,
-    the speculation window for verify). ``positions``: (B, W) int32 — the
-    logical position of each query token; key position ``p`` is attended
-    iff ``p <= positions[b, w]`` (the causal-over-cache rule, identical to
+    the speculation window for verify, a prompt chunk for resumed /
+    chunked prefill). ``positions``: (B, W) int32 — the logical position
+    of each query token; key position ``p`` is attended iff
+    ``p <= positions[b, w]`` (the causal-over-cache rule, identical to
     the contiguous ``decode_step``). Gathers via :func:`paged_kv_gather`
     and runs the exact :func:`dot_product_attention` — softmax inputs for
-    every unmasked position are bit-identical to the contiguous path."""
+    every unmasked position are bit-identical to the contiguous path.
+
+    Shared-prefix note (serving/paged.py): ``slots`` may map SEVERAL
+    streams' tables onto the same physical blocks (a refcounted prefix-
+    cache hit). The gather is read-only and position-masked per stream,
+    so sharing is invisible here — K/V rows at position ``p`` are a pure
+    function of the token prefix up to ``p``, which is exactly what made
+    the blocks shareable."""
     kk = paged_kv_gather(k_pool, slots)
     vv = paged_kv_gather(v_pool, slots)
     amask = (jnp.arange(kk.shape[2])[None, None, :]

@@ -3,9 +3,11 @@
 The "millions of users" half of the north star: continuous/dynamic
 batching with deadline-aware priority queues (``scheduler``), multi-model
 multi-tenant routing with per-model admission control (``router``),
-paged-KV-cache autoregressive decode with speculative decoding and
-weight-only int8 for the transformer stack (``generate``/``paged``/
-``quantize``), and an HTTP model server with queue-depth-driven load
+paged-KV-cache autoregressive decode with shared-prefix KV reuse
+(refcounted blocks + radix prefix cache + copy-on-write), chunked
+prefill, speculative decoding and weight-only int8 for the transformer
+stack (``generate``/``paged``/``quantize``), and an HTTP model server
+with queue-depth-driven load
 shedding and SIGTERM graceful drain (``server``) — all riding the r8
 compile-once substrate (bucketing + AOT warmup), so steady-state serving
 performs ZERO XLA compiles.
@@ -21,7 +23,8 @@ performs ZERO XLA compiles.
 
 from deeplearning4j_tpu.serving.generate import Generator
 from deeplearning4j_tpu.serving.model import ServingModel
-from deeplearning4j_tpu.serving.paged import BlockPool, PoolExhaustedError
+from deeplearning4j_tpu.serving.paged import (BlockPool, PoolExhaustedError,
+                                              PrefixCache)
 from deeplearning4j_tpu.serving.quantize import (INT8_LOGIT_TOL,
                                                  QuantizedParams)
 from deeplearning4j_tpu.serving.resilience import (BrownoutController,
@@ -60,6 +63,7 @@ __all__ = [
     "ModelRouter",
     "ModelServer",
     "PoolExhaustedError",
+    "PrefixCache",
     "QuantizedParams",
     "QueueFullError",
     "ReloadRejectedError",

@@ -53,6 +53,7 @@ contiguous r13 cache == greedy O(T²) full recompute, token-for-token.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -62,6 +63,7 @@ import numpy as np
 
 from deeplearning4j_tpu.data.bucketing import BucketingPolicy
 from deeplearning4j_tpu.serving.paged import (BlockPool, PoolExhaustedError,
+                                              PrefixCache,
                                               default_pool_blocks)
 from deeplearning4j_tpu.serving.quantize import maybe_quantize
 from deeplearning4j_tpu.util import telemetry as tm
@@ -113,6 +115,8 @@ class Generator:
                  batch_buckets=None, prefill_buckets=None,
                  paged: bool = True, block_size: int = 16,
                  pool_blocks: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None,
                  draft_net=None, spec_tokens: int = 4,
                  quantize: Optional[str] = None,
                  model_id: str = ""):
@@ -162,6 +166,31 @@ class Generator:
                                              donate_argnums=(1,))
             self._verify_paged_jit = jax.jit(self._verify_paged,
                                              donate_argnums=(1,))
+            self._prefill_window_jit = jax.jit(self._prefill_window_paged,
+                                               donate_argnums=(1,))
+            self._copy_block_jit = jax.jit(self._copy_block,
+                                           donate_argnums=(0,))
+        # prefix cache (ISSUE 16 tentpole): a radix trie over prompt
+        # prefixes → block chains, so N streams with a common head hold
+        # ONE physical copy and resume prefill past it. Off by default —
+        # the bit-path of prefix_cache=False is the r20 engine unchanged.
+        self.prefix_cache = bool(prefix_cache) and self.paged
+        self.cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool) if self.prefix_cache else None)
+        # chunked prefill: cap the window width so a long-prompt burst
+        # yields the device to queued decode batches between chunks
+        if prefill_chunk is not None and not self.paged:
+            raise ValueError("prefill_chunk needs paged=True (the chunk "
+                             "window is a paged program)")
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        #: nesting depth of generate() — > 1 while a chunk-yield runs a
+        #: nested decode batch; nested runs never grow/reset the pool
+        self._depth = 0
+        #: bumped whenever the device pool buffers are replaced (growth /
+        #: exception reset) — a chunk loop re-checks it after yielding
+        self._pool_epoch = 0
         # speculative decoding: the draft is a plain contiguous-cache
         # generator over the (tiny) draft net — same bucket policy, so
         # draft prefill shapes always match the target's prep
@@ -300,6 +329,53 @@ class Generator:
         logits = self.head._logits(params[-1], x)
         return logits, new_pools
 
+    def _prefill_window_paged(self, raw, pools, window, positions, tables,
+                              limits, last_idx):
+        """Resume-from-position prefill over one chunk window: ``window``
+        (B, W) prompt tokens at per-row absolute ``positions`` (B, W) —
+        each row starts at its own cache-resume point — write-then-attend
+        through the page table (``nn/transformer.py``
+        ``prefill_resume_paged``), exactly the verify-window semantics,
+        so chunked/resumed prefill is bit-identical to whole prefill.
+        ``limits`` (B,) = last prompt position (overrun/padding columns
+        scatter to trash); ``last_idx`` (B,) selects each row's final-
+        prompt-position column for the next-token logits (garbage for
+        rows whose prompt ends in another chunk — the host keeps only
+        the chunk where each row finishes). Everything but the (batch
+        bucket, W-bucket) shape is data: ONE executable per bucket pair,
+        zero steady-state recompiles across any hit/miss mix."""
+        note_trace("serving.prefill_window_paged", window, positions)
+        params = self._params_of(raw)
+        # clamp: lockstep chunking runs padding columns past the prompt
+        # (and past max_length for short rows) — limit-masked to trash on
+        # write, never read back, but the gathers need in-range indices
+        pos_w = jnp.minimum(positions, self.max_length - 1)
+        x = self.emb.embed_window(params[0], window, pos_w)
+        slots = self._slots_of(tables)
+        new_pools = []
+        for i, blk in enumerate(self.blocks):
+            x, pool = blk.prefill_resume_paged(params[i + 1], x, pools[i],
+                                               slots, pos_w, limits=limits)
+            new_pools.append(pool)
+        b = window.shape[0]
+        h_last = x[jnp.arange(b), last_idx]
+        logits = self.head._logits(params[-1], h_last)
+        return logits, new_pools
+
+    def _copy_block(self, pools, src, dst):
+        """Copy-on-write device copy: duplicate physical block ``src``'s
+        rows into ``dst`` across every layer's K and V pool (the COW
+        split of serving/paged.py — the table already points at ``dst``;
+        this fills it before the suffix prefill overwrites the one
+        recomputed row). Block ids are data: one executable ever."""
+        note_trace("serving.cow_copy", src, dst)
+        bs = self.block_size
+        rows_src = src * bs + jnp.arange(bs)
+        rows_dst = dst * bs + jnp.arange(bs)
+        return [{"k": p["k"].at[rows_dst].set(p["k"][rows_src]),
+                 "v": p["v"].at[rows_dst].set(p["v"][rows_src])}
+                for p in pools]
+
     # ------------------------------------------------------------- sampling
     @staticmethod
     def _sample(logits, temperature: float, key):
@@ -354,49 +430,140 @@ class Generator:
                 for i in range(b_real)]
 
     # ------------------------------------------------------------ admission
-    def _admit(self, lens, max_new: int, batch: int):
+    def _grow(self, need: int):
+        """Swap in a pool twice the size (or ``need`` blocks if larger).
+        Growth changes the pool shapes, so the NEXT paged calls trace once
+        at the new size — a capacity event, not steady state (serving
+        configs with finite buckets size the pool to their largest batch
+        up front and never reach this branch; the 0-recompile contract is
+        asserted there). Old buffers are dropped BEFORE the new
+        allocation so device residency never doubles — which also kills
+        every cached prefix byte, so the trie flushes first."""
+        grown = max(need, 2 * self.pool.num_blocks)
+        tm.counter("serving.kv_pool_grown_total", model=self.model_id)
+        tm.instant("serving.kv_pool_grown", model=self.model_id,
+                   blocks=grown)
+        if self.cache is not None:
+            self.cache.flush()
+        old_peak = self.pool.peak_streams
+        self.pool.pools = None  # free before the bigger alloc
+        self.pool = BlockPool(self.blocks,
+                              block_size=self.block_size,
+                              num_blocks=grown,
+                              max_length=self.max_length,
+                              model_id=self.model_id)
+        self.pool.peak_streams = old_peak
+        self._pool_epoch += 1
+        if self.cache is not None:
+            self.cache.rebind(self.pool)
+
+    def _admit(self, lens, max_new: int, batch: int, prompts=None):
         """Reserve every stream's blocks for the WHOLE generation —
         all-or-nothing (PoolExhaustedError → the scheduler's 429 shed) —
         and build the (B, max_blocks) page-table array. An AUTO-sized pool
         (no operator budget) GROWS to fit instead of shedding: reserve
         failed with nothing allocated and pool content never outlives a
-        batch, so swapping in a larger pool is safe mid-flight."""
+        batch — except prefix-cache content, which the grow path flushes
+        — so swapping in a larger pool is safe mid-flight. A NESTED batch
+        (running inside another batch's chunk-yield, ``_depth > 1``)
+        never grows: the outer prefill is mid-write into the current
+        buffers.
+
+        Returns ``(tables_list, tables, starts, cow, pending)``:
+        per-stream block lists, the device table array, each stream's
+        resume position (0 without a cache hit), COW ``(src, dst)`` block
+        copies to run before prefill, and the batch's pending trie nodes
+        to commit after it."""
+        if self.cache is not None and prompts is not None:
+            return self._admit_prefix(prompts, lens, max_new, batch)
         counts = [self.pool.blocks_needed(l, max_new) for l in lens]
         try:
             tables_list = self.pool.reserve(counts)
         except PoolExhaustedError:
-            if not self._pool_auto:
+            if not self._pool_auto or self._depth > 1:
                 raise
-            # growth changes the pool shapes, so the NEXT paged calls
-            # trace once at the new size — a capacity event, not steady
-            # state (serving configs with finite buckets size the pool to
-            # their largest batch up front and never reach this branch;
-            # the 0-recompile contract is asserted there). Old buffers
-            # are dropped BEFORE the new allocation so device residency
-            # never doubles.
-            need = int(sum(counts))
-            grown = max(need, 2 * self.pool.num_blocks)
-            tm.counter("serving.kv_pool_grown_total", model=self.model_id)
-            tm.instant("serving.kv_pool_grown", model=self.model_id,
-                       blocks=grown)
-            old_peak = self.pool.peak_streams
-            self.pool.pools = None  # free before the bigger alloc
-            self.pool = BlockPool(self.blocks,
-                                  block_size=self.block_size,
-                                  num_blocks=grown,
-                                  max_length=self.max_length,
-                                  model_id=self.model_id)
-            self.pool.peak_streams = old_peak
+            self._grow(int(sum(counts)))
             tables_list = self.pool.reserve(counts)
         tables = jnp.asarray(self.pool.table_array(tables_list, batch))
-        return tables_list, tables
+        return tables_list, tables, [0] * len(lens), [], []
+
+    def _admit_prefix(self, prompts, lens, max_new: int, batch: int):
+        """Prefix-aware admission: transactional match + reserve + COW +
+        trie insert (``_admit_prefix_once``), with a retry ladder on
+        exhaustion — evict cache-only blocks first, then (auto pools,
+        non-nested only) grow."""
+        worst = sum(self.pool.blocks_needed(l, max_new) for l in lens)
+        try:
+            return self._admit_prefix_once(prompts, lens, max_new, batch)
+        except PoolExhaustedError:
+            pass
+        # second chance: LRU-evict blocks only the trie still holds
+        self.cache.evict(worst)
+        try:
+            return self._admit_prefix_once(prompts, lens, max_new, batch)
+        except PoolExhaustedError:
+            if not self._pool_auto or self._depth > 1:
+                raise
+        self._grow(worst)
+        return self._admit_prefix_once(prompts, lens, max_new, batch)
+
+    def _admit_prefix_once(self, prompts, lens, max_new: int, batch: int):
+        """One admission attempt, all-or-nothing ACROSS THE BATCH: on
+        PoolExhaustedError every hold this attempt took — matched-prefix
+        increfs, fresh reservations, COW splits, pending trie inserts —
+        is rolled back before the raise, so the caller's retry ladder
+        (and the 429 shed) always starts from clean allocator state."""
+        pool, cache, bs = self.pool, self.cache, self.block_size
+        tables_list, starts, cow, pending = [], [], [], []
+        hit_tokens = 0
+        with pool._lock:
+            try:
+                for p, l in zip(prompts, lens):
+                    blocks, committed = cache.match(p)  # increfs matched
+                    need = pool.blocks_needed(l, max_new)
+                    try:
+                        # matched < need always (max_new >= 1): every
+                        # stream owns at least its generation blocks
+                        fresh = pool.reserve([need - len(blocks)])[0]
+                    except PoolExhaustedError:
+                        pool.decref(blocks)  # match-only holds so far
+                        raise
+                    table = list(blocks) + fresh
+                    # resume point: skip committed tokens, but always
+                    # recompute >= 1 prompt token for next-token logits
+                    start = min(committed, l - 1)
+                    if start < committed:
+                        # block-aligned full hit: the one recomputed
+                        # position l-1 lands INSIDE a shared cached
+                        # block — copy-on-write before the prefill
+                        bi = start // bs
+                        try:
+                            nb = pool.cow_split(table[bi])
+                        except PoolExhaustedError:
+                            pool.release([table])
+                            raise
+                        cow.append((table[bi], nb))
+                        table[bi] = nb
+                    pending.extend(cache.insert(p, table))
+                    tables_list.append(table)
+                    starts.append(start)
+                    hit_tokens += start
+            except PoolExhaustedError:
+                cache.rollback(pending)
+                pool.release(tables_list)
+                raise
+        tm.gauge("serving.prefix_cache_hit_rate",
+                 round(cache.hit_rate(), 4), model=self.model_id)
+        self._last_hit_tokens = hit_tokens
+        tables = jnp.asarray(pool.table_array(tables_list, batch))
+        return tables_list, tables, starts, cow, pending
 
     # ------------------------------------------------------------- decoding
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 16, *, temperature: float = 0.0,
                  key=None, eos_id: Optional[int] = None,
-                 trace: bool = False,
-                 stats: Optional[Dict] = None) -> List[List[int]]:
+                 trace: bool = False, stats: Optional[Dict] = None,
+                 yield_hook=None) -> List[List[int]]:
         """Decode ``prompts``: one prefill + per-token decode steps (or
         speculative verify windows when a draft net is attached and the
         decode is greedy), all on warmed executables. ``temperature=0`` is
@@ -406,7 +573,11 @@ class Generator:
         ``decode_token`` / ``verify`` spans — the per-token ruler of
         docs/OBSERVABILITY.md#request-tracing--slos. ``stats`` (a dict,
         filled in place) receives ``draft_accept_rate`` per row and the
-        batch ``spec_accept_rate`` when speculating."""
+        batch ``spec_accept_rate`` when speculating, plus
+        ``prefix_hit_rate`` / ``resumed_positions`` / ``prefill_chunks``
+        under the prefix cache / chunked prefill. ``yield_hook``
+        (scheduler-provided) is called between prefill chunks so queued
+        interactive decode batches can run mid-prefill."""
         if max_new_tokens < 1:
             return [[] for _ in prompts]
         if not self.paged:
@@ -415,36 +586,162 @@ class Generator:
                 eos_id=eos_id, trace=trace)
         tokens, lengths, b_real, lens = self._prep(prompts, max_new_tokens)
         batch = int(tokens.shape[0])
-        tables_list, tables = self._admit(lens, max_new_tokens, batch)
+        self._depth += 1
+        try:
+            # admission stays OUTSIDE the reset-on-failure block: a shed
+            # allocated nothing and must not trash live pool content
+            tables_list, tables, starts, cow, pending = self._admit(
+                lens, max_new_tokens, batch, prompts=prompts)
+        except BaseException:
+            self._depth -= 1
+            raise
+        if stats is not None and self.cache is not None:
+            stats["prefix_hit_rate"] = round(
+                sum(starts) / max(1, sum(lens)), 4)
+            stats["resumed_positions"] = list(starts)
         try:
             speculate = (self.draft is not None and self.spec_tokens > 0
                          and not (temperature and temperature > 0.0))
             if speculate:
                 return self._generate_speculative(
                     tokens, lengths, tables, b_real, lens, max_new_tokens,
-                    eos_id=eos_id, trace=trace, stats=stats)
+                    eos_id=eos_id, trace=trace, stats=stats,
+                    starts=starts, cow=cow, pending=pending,
+                    yield_hook=yield_hook)
             return self._generate_paged(
                 tokens, lengths, tables, b_real, lens, max_new_tokens,
                 temperature=temperature, key=key, eos_id=eos_id,
-                trace=trace)
+                trace=trace, stats=stats, starts=starts, cow=cow,
+                pending=pending, yield_hook=yield_hook)
         except BaseException:
             # a failure mid-decode may have consumed the donated pool
-            # buffers — rebuild them (pool CONTENT never outlives a batch;
-            # only the host allocator state matters, and release() below
+            # buffers — rebuild them (pool CONTENT never outlives a batch
+            # except cached prefixes, which _reset_pools flushes; only
+            # the host allocator state matters, and release() below
             # restores that)
+            if self.cache is not None:
+                self.cache.rollback(pending)
             self._reset_pools()
             raise
         finally:
+            self._depth -= 1
             # blocks free on completion, eos early-exit, and shed alike
             self.pool.release(tables_list)
 
     def _reset_pools(self):
+        if self.cache is not None:
+            # the buffers the cached blocks lived in are being replaced
+            self.cache.flush()
         self.pool.pools = [blk.init_pool(self.pool.num_slots)
                            for blk in self.blocks]
+        self._pool_epoch += 1
+
+    def _window_width(self, max_rem: int) -> int:
+        """Chunk-window width for ``max_rem`` remaining prompt tokens:
+        the operator's ``prefill_chunk`` when set, else one bucketed
+        window covering the whole remainder (suffix-only resume, no
+        interleaving) — either way a shape warmup() primed."""
+        if self.prefill_chunk is not None:
+            return min(self.prefill_chunk, self.max_length)
+        return self._prefill_len(max_rem)
+
+    def _run_prefill(self, raw, tokens, lengths, tables, b_real, lens,
+                     starts, cow, pending, tele, stats, yield_hook,
+                     speculative: bool = False):
+        """Dispatch the prompt phase: COW block copies, then either the
+        r20 whole-prompt prefill (bit-path unchanged — no cache hit, no
+        chunking) or the resume/chunk window loop, then commit this
+        batch's trie nodes. Returns next-token logits (B, V)."""
+        batch = int(tokens.shape[0])
+        t = int(tokens.shape[1])
+        for src, dst in cow:
+            pools = self._copy_block_jit(self.pool.pools,
+                                         jnp.asarray(src, jnp.int32),
+                                         jnp.asarray(dst, jnp.int32))
+            self.pool.pools = pools
+        t_pf = time.time_ns() if tele else 0
+        whole = (not any(starts)) and (self.prefill_chunk is None
+                                       or t <= self.prefill_chunk)
+        if whole:
+            logits, pools = self._prefill_paged_jit(
+                raw, self.pool.pools, tokens, lengths, tables)
+            self.pool.pools = pools
+            n_chunks = 1
+        else:
+            logits, n_chunks = self._prefill_windowed(
+                raw, tokens, lengths, tables, b_real, lens, starts,
+                yield_hook)
+        if tele:
+            tele.event_deferred(
+                "serving.generate.prefill", t_pf, time.time_ns(),
+                batch=batch, seq=t, paged=True, speculative=speculative,
+                prefix_hit=bool(any(starts)),
+                resumed=int(sum(starts)), chunks=n_chunks)
+        if stats is not None:
+            stats["prefill_chunks"] = n_chunks
+        if self.cache is not None and pending:
+            # the prefill that writes these blocks has been issued —
+            # program order guarantees any later read sees the writes
+            self.cache.commit(pending)
+        return logits
+
+    def _prefill_windowed(self, raw, tokens, lengths, tables, b_real,
+                          lens, starts, yield_hook):
+        """The resume/chunk window loop (ISSUE 16): every row computes
+        only its uncached suffix, ``W`` positions per chunk, through
+        ``_prefill_window_paged``. Lockstep chunking — chunk c covers
+        per-row absolute positions ``start_i + c*W + [0, W)`` — keeps
+        shapes fixed; rows pad with trash-masked columns once their
+        prompt is done. Between chunks ``yield_hook`` hands the device
+        to queued interactive batches (chunked prefill: a long-prompt
+        burst cannot spike decode p99); the pool-epoch check aborts if
+        a nested run reset the buffers under us."""
+        batch = int(tokens.shape[0])
+        t = int(tokens.shape[1])
+        tokens_np = np.asarray(tokens)
+        lengths_np = np.asarray(lengths)
+        starts_np = np.zeros((batch,), np.int32)
+        starts_np[:b_real] = np.asarray(starts, np.int32)
+        max_rem = max(int(l - s) for l, s in zip(lens, starts))
+        w = self._window_width(max_rem)
+        n_chunks = math.ceil(max_rem / w)
+        limits = jnp.asarray((lengths_np - 1).astype(np.int32))
+        final = np.zeros((batch,), object)
+        for c in range(n_chunks):
+            if c and yield_hook is not None:
+                epoch0 = self._pool_epoch
+                yield_hook()
+                if self._pool_epoch != epoch0:
+                    raise RuntimeError(
+                        "KV pool reset during chunked-prefill yield — "
+                        "aborting the outer batch")
+            base = starts_np + c * w
+            cols = base[:, None] + np.arange(w, dtype=np.int32)[None, :]
+            window = np.take_along_axis(
+                tokens_np, np.minimum(cols, t - 1), axis=1)
+            window = np.where(cols < lengths_np[:, None], window, 0)
+            li = lengths_np - 1 - base
+            in_chunk = (li >= 0) & (li < w)
+            last_idx = np.clip(li, 0, w - 1).astype(np.int32)
+            logits_c, pools = self._prefill_window_jit(
+                raw, self.pool.pools, jnp.asarray(window),
+                jnp.asarray(cols), tables, limits,
+                jnp.asarray(last_idx))
+            self.pool.pools = pools
+            if in_chunk.any():
+                # keep the device rows; host-gather only at the end
+                rows = logits_c
+                for i in np.nonzero(in_chunk)[0]:
+                    final[i] = rows[i]
+        tm.counter("serving.chunked_prefill_chunks_total", n_chunks,
+                   model=self.model_id)
+        logits = jnp.stack([final[i] for i in range(batch)])
+        return logits, n_chunks
 
     def _generate_paged(self, tokens, lengths, tables, b_real, lens,
                         max_new: int, *, temperature: float, key,
-                        eos_id: Optional[int], trace: bool):
+                        eos_id: Optional[int], trace: bool, stats=None,
+                        starts=(), cow=(), pending=(), yield_hook=None):
         """The plain per-token paged loop (greedy or sampled) — the same
         sampling stream as the contiguous path, so paged==contiguous is
         token-exact (greedy) / stream-exact (sampled)."""
@@ -457,14 +754,9 @@ class Generator:
             [l + max_new - 1 for l in lens]
             + [0] * (batch - b_real), np.int32))
 
-        t_pf = time.time_ns() if tele else 0
-        logits, pools = self._prefill_paged_jit(raw, self.pool.pools,
-                                                tokens, lengths, tables)
-        self.pool.pools = pools
-        if tele:
-            tele.event_deferred("serving.generate.prefill", t_pf,
-                                time.time_ns(), batch=batch,
-                                seq=int(tokens.shape[1]), paged=True)
+        logits = self._run_prefill(raw, tokens, lengths, tables, b_real,
+                                   lens, starts, cow, pending, tele,
+                                   stats, yield_hook)
         positions = lengths
         steps = []
         done = np.zeros(b_real, bool)
@@ -493,10 +785,14 @@ class Generator:
 
     def _generate_speculative(self, tokens, lengths, tables, b_real, lens,
                               max_new: int, *, eos_id: Optional[int],
-                              trace: bool, stats: Optional[Dict]):
+                              trace: bool, stats: Optional[Dict],
+                              starts=(), cow=(), pending=(),
+                              yield_hook=None):
         """Greedy speculative decode (module doc). Every emitted token is
         the TARGET's argmax — the draft only decides how many the verify
-        window can commit at once."""
+        window can commit at once. Prefix sharing applies to the TARGET's
+        paged prefill only; the draft keeps its own full contiguous
+        prefill (its cache is private, tiny, and never shared)."""
         raw = self._raw_params()
         draft = self.draft
         draft_raw = draft._raw_params()
@@ -507,16 +803,10 @@ class Generator:
                                + [0] * (batch - b_real), np.int32)
         limits = jnp.asarray(limits_np)
 
-        t_pf = time.time_ns() if tele else 0
-        logits, pools = self._prefill_paged_jit(raw, self.pool.pools,
-                                                tokens, lengths, tables)
-        self.pool.pools = pools
+        logits = self._run_prefill(raw, tokens, lengths, tables, b_real,
+                                   lens, starts, cow, pending, tele,
+                                   stats, yield_hook, speculative=True)
         _, dcaches = draft._prefill_jit(draft_raw, tokens, lengths)
-        if tele:
-            tele.event_deferred("serving.generate.prefill", t_pf,
-                                time.time_ns(), batch=batch,
-                                seq=int(tokens.shape[1]), paged=True,
-                                speculative=True)
 
         cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # token AT pos
         pos_np = np.asarray(lengths)  # cur's position, per row
@@ -688,13 +978,27 @@ class Generator:
         already-warmed (smallest-bucket) signature, so on a warmed
         generator it never traces. The paged probe uses an all-trash page
         table — zero blocks reserved, the prompt attention never reads the
-        pool."""
+        pool — and first audits block-refcount CONSERVATION (plus trie
+        consistency when the prefix cache is on), so a leak or
+        double-free shows up in steady state, not at the next OOM."""
         b = int(self.policy.bucket_batch(1))
         t = self._prefill_len(1)
         tokens = jnp.ones((b, t), jnp.int32)
         lengths = jnp.ones((b,), jnp.int32)
         raw = self._raw_params()
         if self.paged:
+            ok, detail = self.pool.conservation()
+            if ok and self.cache is not None:
+                # strict when idle: with no live streams the trie's holds
+                # are the only legitimate holds, so any other allocated
+                # block is a leaked stream ref
+                ok, detail = self.cache.check(
+                    strict_idle=(self.pool._streams == 0))
+            check = ("serving.kv_pool_conservation"
+                     + (f".{self.model_id}" if self.model_id else ""))
+            tm.set_health(check, ok, detail)
+            if not ok:
+                return False
             tables = jnp.zeros((b, self.pool.max_blocks_per_stream),
                                jnp.int32)
             logits, pools = self._prefill_paged_jit(
@@ -731,14 +1035,22 @@ class Generator:
                 ) + (self.max_length,)
         raw = self._raw_params()
         primed = 0
+        # resume/chunk windows trace per (batch bucket, width): width is
+        # the fixed chunk when configured, else the seq buckets (the
+        # suffix-only window goes through the same bucketing)
+        window = self.paged and (self.cache is not None
+                                 or self.prefill_chunk is not None)
+        if window and self.prefill_chunk is not None:
+            window_widths = (min(self.prefill_chunk, self.max_length),)
         for b in batch_sizes:
             b = int(b)
             caches = None
             if self.paged:
                 tables = jnp.zeros((b, self.pool.max_blocks_per_stream),
                                    jnp.int32)
-            for t in sorted({min(int(t), self.max_length)
-                             for t in prompt_lengths}):
+            widths = sorted({min(int(t), self.max_length)
+                             for t in prompt_lengths})
+            for t in widths:
                 tokens = jnp.zeros((b, t), jnp.int32)
                 lengths = jnp.ones((b,), jnp.int32)
                 if self.paged:
@@ -748,6 +1060,15 @@ class Generator:
                 else:
                     _, caches = self._prefill_jit(raw, tokens, lengths)
                 primed += 1
+            if window:
+                for t in (window_widths if self.prefill_chunk is not None
+                          else widths):
+                    zi = jnp.zeros((b, t), jnp.int32)
+                    z1 = jnp.zeros((b,), jnp.int32)
+                    _, pools = self._prefill_window_jit(
+                        raw, self.pool.pools, zi, zi, tables, z1, z1)
+                    self.pool.pools = pools
+                    primed += 1
             cur = jnp.zeros((b,), jnp.int32)
             pos = jnp.ones((b,), jnp.int32)
             if self.paged:
@@ -757,14 +1078,19 @@ class Generator:
                 self.pool.pools = pools
                 primed += 1
                 if self.draft is not None and self.spec_tokens > 0:
-                    window = jnp.zeros((b, self.spec_tokens + 1), jnp.int32)
+                    vwin = jnp.zeros((b, self.spec_tokens + 1), jnp.int32)
                     _, pools = self._verify_paged_jit(
-                        raw, self.pool.pools, tables, window, pos, limits)
+                        raw, self.pool.pools, tables, vwin, pos, limits)
                     self.pool.pools = pools
                     primed += 1
             elif caches is not None:
                 self._decode_jit(raw, caches, cur, pos)
                 primed += 1
+        if window:
+            # the COW copy program: block ids are data, one signature ever
+            z = jnp.asarray(0, jnp.int32)
+            self.pool.pools = self._copy_block_jit(self.pool.pools, z, z)
+            primed += 1
         if self.draft is not None:
             primed += self.draft.warmup(batch_sizes=batch_sizes,
                                         prompt_lengths=prompt_lengths)
@@ -772,4 +1098,11 @@ class Generator:
 
     # ---------------------------------------------------------------- stats
     def pool_stats(self) -> Optional[dict]:
-        return self.pool.stats() if self.pool is not None else None
+        if self.pool is None:
+            return None
+        s = self.pool.stats()
+        if self.cache is not None:
+            s["prefix_cache"] = self.cache.stats()
+        if self.prefill_chunk is not None:
+            s["prefill_chunk"] = self.prefill_chunk
+        return s

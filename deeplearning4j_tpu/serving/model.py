@@ -59,6 +59,8 @@ class ServingModel:
                  prefill_buckets=None,
                  paged: bool = True, block_size: int = 16,
                  pool_blocks: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None,
                  draft_net=None, spec_tokens: int = 4,
                  quantize: Optional[str] = None):
         if kind not in ("classify", "generate"):
@@ -73,6 +75,8 @@ class ServingModel:
         self._paged = bool(paged)
         self._block_size = int(block_size)
         self._pool_blocks = pool_blocks
+        self._prefix_cache = bool(prefix_cache)
+        self._prefill_chunk = prefill_chunk
         self._draft_net = draft_net
         self._spec_tokens = int(spec_tokens)
         self.quantize = quantize
@@ -84,8 +88,11 @@ class ServingModel:
         self.reload_time: Optional[float] = None
         # execute() holds this for each batch; a rolling reload's swap takes
         # it too, so the swap lands BETWEEN batch cycles — the in-flight
-        # batch finishes on the old weights, the next one runs the new
-        self._swap_lock = threading.Lock()
+        # batch finishes on the old weights, the next one runs the new.
+        # REENTRANT: a chunked prefill's yield hook re-enters execute()
+        # from the same worker thread to run queued decode batches
+        # between prompt chunks (serving/scheduler.py).
+        self._swap_lock = threading.RLock()
         if isinstance(bucketing, str):
             bucketing = BucketingPolicy.from_spec(bucketing)
         if bucketing is None:
@@ -110,6 +117,8 @@ class ServingModel:
                                  or self.policy.seq_buckets),
                 paged=self._paged, block_size=self._block_size,
                 pool_blocks=self._pool_blocks,
+                prefix_cache=self._prefix_cache,
+                prefill_chunk=self._prefill_chunk,
                 draft_net=self._draft_net, spec_tokens=self._spec_tokens,
                 quantize=quantize, model_id=self.model_id)
             self.policy = self.generator.policy
@@ -141,6 +150,14 @@ class ServingModel:
             # source of truth for warmup() and coalescing
             self.inference = ParallelInference(net, bucketing=self.policy)
         self.warmed = False
+
+    @property
+    def supports_chunked_prefill(self) -> bool:
+        """Whether this model's batches can yield mid-prefill — the
+        scheduler only wires its interleave hook into models that chunk
+        (one whole-prompt prefill has no yield points)."""
+        return (self.generator is not None
+                and self.generator.prefill_chunk is not None)
 
     # -------------------------------------------------------------- shapes
     def coalesce_limit(self) -> int:
@@ -197,7 +214,7 @@ class ServingModel:
 
     # ------------------------------------------------------------- execute
     def execute(self, payloads: List[Any], _trace: bool = False,
-                _step: Optional[int] = None, **opts
+                _step: Optional[int] = None, _yield=None, **opts
                 ) -> Tuple[List[Any], Dict[str, Any]]:
         """Run one coalesced batch; returns (per-payload results, stats).
         stats: real/padded row counts and the number of XLA traces this
@@ -233,7 +250,8 @@ class ServingModel:
             stats: Dict[str, Any] = {}
             if self.kind == "generate":
                 results, real, padded = self._execute_generate(
-                    payloads, _trace=_trace, _stats=stats, **opts)
+                    payloads, _trace=_trace, _stats=stats, _yield=_yield,
+                    **opts)
             else:
                 results, real, padded = self._execute_classify(
                     payloads, _trace=_trace, **opts)
@@ -307,7 +325,8 @@ class ServingModel:
             off += k
         return results, n, padded
 
-    def _execute_generate(self, payloads, _trace=False, _stats=None, **opts):
+    def _execute_generate(self, payloads, _trace=False, _stats=None,
+                          _yield=None, **opts):
         prompts = [list(np.asarray(p).ravel().astype(np.int64)) for p in
                    payloads]
         max_new = int(opts.get("max_new_tokens", 16))
@@ -316,7 +335,8 @@ class ServingModel:
             prompts, max_new_tokens=max_new,
             temperature=float(opts.get("temperature", 0.0)),
             eos_id=opts.get("eos_id"), trace=_trace,
-            stats=_stats)  # speculation: draft_accept_rate per rider
+            stats=_stats,  # speculation: draft_accept_rate per rider
+            yield_hook=_yield)  # chunked prefill: scheduler interleave
         if _stats is not None:
             # decode wall (incl. prefill) — the scheduler turns this into
             # per-request serving.decode_tokens_per_sec observations
@@ -340,6 +360,8 @@ class ServingModel:
                             paged=self._paged,
                             block_size=self._block_size,
                             pool_blocks=self._pool_blocks,
+                            prefix_cache=self._prefix_cache,
+                            prefill_chunk=self._prefill_chunk,
                             draft_net=self._draft_net,
                             spec_tokens=self._spec_tokens,
                             quantize=self.quantize)

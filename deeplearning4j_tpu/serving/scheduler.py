@@ -311,7 +311,10 @@ class BatchScheduler:
                        cause: Optional[str] = None, end_ns: Optional[int] = None,
                        bucket: Optional[int] = None, traced: bool = False,
                        tokens_per_sec: Optional[float] = None,
-                       draft_accept_rate: Optional[float] = None) -> dict:
+                       draft_accept_rate: Optional[float] = None,
+                       prefix_hit_rate: Optional[float] = None,
+                       resumed_position: Optional[int] = None,
+                       prefill_chunks: Optional[int] = None) -> dict:
         end_ns = end_ns or time.time_ns()
         rec = {
             "id": req.request_id,
@@ -335,6 +338,15 @@ class BatchScheduler:
             # speculative decoding (serving/generate.py): the fraction of
             # draft proposals the target verified for THIS request
             rec["draft_accept_rate"] = round(draft_accept_rate, 4)
+        if prefix_hit_rate is not None:
+            # prefix cache (ISSUE 16): the batch's hit rate, this
+            # request's resume point (0 = cold), and how many prompt
+            # chunks the prefill ran as
+            rec["prefix_hit_rate"] = round(prefix_hit_rate, 4)
+        if resumed_position is not None:
+            rec["resumed_position"] = int(resumed_position)
+        if prefill_chunks is not None:
+            rec["prefill_chunks"] = int(prefill_chunks)
         self.flight.record(rec)
         return rec
 
@@ -342,7 +354,10 @@ class BatchScheduler:
                      bucket: Optional[int] = None,
                      tokens_per_sec: Optional[float] = None,
                      end_ns: Optional[int] = None,
-                     draft_accept_rate: Optional[float] = None):
+                     draft_accept_rate: Optional[float] = None,
+                     prefix_hit_rate: Optional[float] = None,
+                     resumed_position: Optional[int] = None,
+                     prefill_chunks: Optional[int] = None):
         """Stage ONE sampled request for span export: a flat tuple append
         (no dicts, no registry lock — the hot-path finding behind
         :func:`collect_deferred_spans`). Thread identity is captured here
@@ -355,7 +370,8 @@ class BatchScheduler:
             (req.request_id, req.lane, req.rows, req.t_submit_ns,
              req.t_open_ns, req.t_exec0_ns, req.t_exec1_ns, outcome,
              bucket, tokens_per_sec, end_ns or time.time_ns(),
-             th.ident, th.name, draft_accept_rate))
+             th.ident, th.name, draft_accept_rate,
+             prefix_hit_rate, resumed_position, prefill_chunks))
 
     def _materialize_spans(self) -> List[dict]:
         """Staged tuples -> Chrome phase events (queue_wait / batch_fill /
@@ -368,7 +384,8 @@ class BatchScheduler:
         pid = os.getpid()
         out: List[dict] = []
         for (rid, lane, rows, t_submit, t_open, t_exec0, t_exec1, outcome,
-             bucket, tps, end_ns, tid, tname, accept) in staged:
+             bucket, tps, end_ns, tid, tname, accept,
+             hit_rate, resumed, chunks) in staged:
             base = {"request_id": rid, "model": self.model_id,
                     "lane": lane, "outcome": outcome}
             if not outcome.startswith("shed"):
@@ -396,6 +413,14 @@ class BatchScheduler:
                     # the per-request speculation ruler (ISSUE 15): how
                     # much of the draft's work the target verified
                     args["draft_accept_rate"] = round(accept, 4)
+                if hit_rate is not None:
+                    # prefix cache + chunked prefill (ISSUE 16): hit/miss
+                    # and resume point per request, chunk count per batch
+                    args["prefix_hit_rate"] = round(hit_rate, 4)
+                if resumed is not None:
+                    args["resumed_position"] = int(resumed)
+                if chunks is not None:
+                    args["prefill_chunks"] = int(chunks)
                 out.append(ev("serving.request.compute", t_exec0,
                               t_exec1, args))
         return out
@@ -689,6 +714,37 @@ class BatchScheduler:
                                  model=self.model_id, lane=_lane)
                     self._cv.notify_all()
 
+    def _drain_priority_once(self):
+        """Chunked-prefill yield hook (serving/generate.py): between an
+        outer batch's prompt chunks, run up to two queued PRIORITY-lane
+        batches so a long-prompt bulk burst cannot spike interactive
+        decode p99 — the whole point of chunking. Only wired into
+        non-priority batches (``_run_batch``), so the nesting depth is
+        exactly one: an interactive batch never yields. The outer batch
+        stays parked on ``_current_batch`` around each inner run so the
+        watchdog's loud-failure contract keeps covering it."""
+        ran = 0
+        while ran < 2:
+            with self._cv:
+                self._sweep_expired_locked(time.monotonic())
+                if not self._queues[self.lanes[0]]:
+                    break
+                inner = self._open_batch_locked()
+                if inner is None:
+                    break
+                self._fill_batch_locked(inner)
+                outer = self._current_batch
+                self._current_batch = inner
+            try:
+                self._run_batch(inner)
+            finally:
+                with self._cv:
+                    self._current_batch = outer
+            ran += 1
+        if ran:
+            tm.counter("serving.prefill_yield_preemptions_total", ran,
+                       model=self.model_id)
+
     def _run_batch(self, batch: List[_Request]):
         t0 = time.monotonic()
         self._batch_seq += 1
@@ -708,12 +764,20 @@ class BatchScheduler:
         exec0_ns = time.time_ns()
         for req in batch:
             req.t_exec0_ns = exec0_ns
+        # chunked prefill interleave: a NON-priority batch on a chunking
+        # model hands the device back between prompt chunks; an
+        # interactive batch never yields (depth stays 1, no starvation of
+        # the batch itself — at most 2 inner batches per chunk boundary)
+        extra = {}
+        if (batch[0].lane != self.lanes[0]
+                and getattr(self.model, "supports_chunked_prefill", False)):
+            extra["_yield"] = self._drain_priority_once
         with tm.span("serving.batch", model=self.model_id,
                      requests=len(batch), lane=batch[0].lane):
             try:
                 results, stats = self.model.execute(
                     [r.payload for r in batch], _trace=trace_batch,
-                    _step=seq, **batch[0].opts)
+                    _step=seq, **extra, **batch[0].opts)
             except ShedError as e:
                 # an EXECUTE-time shed (paged-pool exhaustion): a
                 # first-class 429 with its own cause, NOT a server error —
@@ -769,6 +833,9 @@ class BatchScheduler:
             decode_s = stats.get("decode_seconds")
             decode_toks = stats.get("decode_tokens")
             accept_rates = stats.get("draft_accept_rate")  # per rider, or None
+            hit_rate = stats.get("prefix_hit_rate")        # batch-level
+            resumed = stats.get("resumed_positions")       # per rider
+            chunks = stats.get("prefill_chunks")
             lane_done: collections.Counter = collections.Counter()
             for ridx, (req, res) in enumerate(zip(batch, results)):
                 req.t_exec1_ns = exec1_ns
@@ -798,17 +865,23 @@ class BatchScheduler:
                 rate = (accept_rates[ridx]
                         if accept_rates and ridx < len(accept_rates)
                         else None)
+                rpos = (resumed[ridx]
+                        if resumed and ridx < len(resumed) else None)
                 keep = tracing and (req.sampled
                                     or lat * 1e3 > SLOW_REQUEST_MS)
                 self._flight_record(req, "ok", end_ns=exec1_ns,
                                     bucket=padded, traced=keep,
                                     tokens_per_sec=tps,
-                                    draft_accept_rate=rate)
+                                    draft_accept_rate=rate,
+                                    prefix_hit_rate=hit_rate,
+                                    resumed_position=rpos,
+                                    prefill_chunks=chunks)
                 if keep:
                     self._stage_spans(
                         req, "ok" if req.sampled else "slow",
                         bucket=padded, tokens_per_sec=tps, end_ns=exec1_ns,
-                        draft_accept_rate=rate)
+                        draft_accept_rate=rate, prefix_hit_rate=hit_rate,
+                        resumed_position=rpos, prefill_chunks=chunks)
         # one counter bump per lane per batch, not per request — registry
         # lock acquisitions on the worker are GIL time stolen from other
         # models' workers (the mixed-bench finding; see _LatencyWindow.add)

@@ -23,6 +23,11 @@ Registered spaces:
   candidate, measured on a small conv net's jitted train step; the winner
   lands under the reserved ``conf-default`` signature consulted by the
   conf builders.
+- ``prefill_chunk`` — MEASURABLE (conf scope). Chunked-prefill window
+  width for the paged decode engine (serving/generate.py); equivalence
+  gate is generated-token identity (tolerance 0) so a chunk width that
+  perturbs decode output can never win; the latency trade it ranks is
+  decode-lane HOL blocking vs whole-prompt dispatch amortization.
 - ``xla_flags`` — DECLARED. Candidates from
   ``xla_tuning.XLA_FLAG_CANDIDATES``; flags are process-global and abort
   XLA when unknown, so measurement belongs to the subprocess harness
@@ -695,6 +700,125 @@ class PipeScheduleSpace(SearchSpace):
         return out
 
 
+# --------------------------------------------------- prefill chunk space
+class PrefillChunkSpace(SearchSpace):
+    """Chunked-prefill window width for the paged decode engine
+    (serving/generate.py ``prefill_chunk``, docs/SERVING.md#prefix-cache
+    --chunked-prefill): how long prompts are sliced into fixed windows
+    interleaved with decode batches. Small chunks bound decode-lane HOL
+    blocking (Sarathi-style stall control); the whole-prompt prefill
+    amortizes dispatch best. The equivalence gate is the serving
+    contract itself — **generated-token identity** (tolerance 0: the
+    chunked path must reproduce the whole-prompt path bit-for-bit), so a
+    chunk width that perturbs decode can never win. Context:
+    ``{"max_length", "prompt_len", "batch", "max_new"}``."""
+
+    name = "prefill_chunk"
+    op = "prefill_chunk"
+    scope = "conf"
+    tolerance = 0.0    # token IDs are integers: identity or rejection
+
+    def signature(self, ctx: dict) -> str:
+        return (f"maxlen={int(ctx.get('max_length', 64))}"
+                f",prompt={int(ctx.get('prompt_len', 24))}")
+
+    def dtype(self, ctx: dict) -> str:
+        return "int32"
+
+    def enumerate(self, ctx: dict) -> List[Candidate]:
+        max_length = int(ctx.get("max_length", 64))
+        out = [Candidate("chunk:whole", impl="conf",
+                         params={"prefill_chunk": None}, is_default=True)]
+        w = 4
+        while w < max_length:
+            out.append(Candidate(f"chunk:{w}", impl="conf",
+                                 params={"prefill_chunk": w}))
+            w *= 2
+        return out
+
+    def validate(self, cand: Candidate, ctx: dict) -> Tuple[bool, str]:
+        w = cand.params.get("prefill_chunk")
+        if w is None:
+            return True, ""
+        max_length = int(ctx.get("max_length", 64))
+        if not 1 <= int(w) <= max_length:
+            return False, f"chunk {w} outside [1, max_length={max_length}]"
+        return True, ""
+
+    def neighbors(self, cand: Candidate, ctx: dict) -> List[Candidate]:
+        if cand.params.get("prefill_chunk") is None:
+            return []
+        all_c = [c for c in self.enumerate(ctx)
+                 if c.params.get("prefill_chunk") is not None]
+        widths = [c.params.get("prefill_chunk") for c in all_c]
+        try:
+            i = widths.index(cand.params.get("prefill_chunk"))
+        except ValueError:
+            return []
+        return [all_c[j] for j in (i - 1, i + 1) if 0 <= j < len(all_c)]
+
+    def build(self, ctx: dict) -> MeasureCase:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.serving.generate import Generator
+        from deeplearning4j_tpu.zoo.bert import Bert
+
+        max_length = int(ctx.get("max_length", 64))
+        prompt_len = int(ctx.get("prompt_len", 24))
+        batch = int(ctx.get("batch", 2))
+        max_new = int(ctx.get("max_new", 8))
+        vocab = 61
+        net = Bert.tiny(causal=True, task="mlm", vocab_size=vocab,
+                        max_length=max_length, hidden_dropout=0.0).init()
+        rng = np.random.default_rng(11)
+        prompts = [[int(t) for t in rng.integers(1, vocab, prompt_len)]
+                   for _ in range(batch)]
+
+        gens: Dict[str, Generator] = {}
+
+        def gen_for(cand: Candidate) -> Generator:
+            if cand.label not in gens:
+                g = Generator(net, paged=True, block_size=4,
+                              batch_buckets=(batch,),
+                              prefill_buckets=(max_length,),
+                              prefill_chunk=cand.params.get("prefill_chunk"))
+                g.generate(prompts, max_new_tokens=max_new)  # warm the trace
+                gens[cand.label] = g
+            return gens[cand.label]
+
+        def outputs(cand: Candidate):
+            toks = gen_for(cand).generate(prompts, max_new_tokens=max_new)
+            # pad ragged eos-exits to a fixed shape for the pytree diff
+            arr = np.full((batch, max_new), -1, np.int32)
+            for i, row in enumerate(toks):
+                arr[i, :len(row)] = row
+            return (jnp.asarray(arr),)
+
+        def timer(cand: Candidate):
+            g = gen_for(cand)
+
+            def run_once():
+                g.generate(prompts, max_new_tokens=max_new)
+
+            return run_once
+
+        return MeasureCase(
+            reference=lambda: outputs(
+                Candidate("chunk:whole", impl="conf",
+                          params={"prefill_chunk": None})),
+            outputs=outputs, timer=timer, tolerance=self.tolerance)
+
+    def default_contexts(self) -> List[dict]:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return [{"max_length": 32, "prompt_len": 20, "batch": 2,
+                     "max_new": 4}]
+        return [{"max_length": 2048, "prompt_len": 1536, "batch": 8,
+                 "max_new": 32}]
+
+
 # ------------------------------------------------------- default wiring
 register_space(ConvTileSpace())
 register_space(LstmTileSpace())
@@ -703,3 +827,4 @@ register_space(XlaFlagsSpace())
 register_space(BucketSetSpace())
 register_space(CompressionHostsSpace())
 register_space(PipeScheduleSpace())
+register_space(PrefillChunkSpace())

@@ -89,6 +89,22 @@ class TestBands:
         ok = rg.gate(traj, {metric: (0.9, None), "tput": (100.0, None)})
         assert rg._passed(ok, strict=False)
 
+    def test_host_condition_metric_gates_against_floor(self):
+        # dp_sharding efficiency tracks the shared host's scheduling
+        # weather (committed trajectory spans 0.52-1.06 for the same
+        # code), so it gates on an absolute floor, not the best band —
+        # a value far below any committed record still passes as long
+        # as it clears the floor; a collapse below the floor fails.
+        metric = "dp_sharding_efficiency_8dev_virtual_cpu"
+        assert metric in rg.HOST_CONDITION_FLOOR
+        floor = rg.HOST_CONDITION_FLOOR[metric]
+        traj = [("r1", {metric: (1.05, 0.02)})]
+        above = rg.gate(traj, {metric: (floor + 0.05, 0.15)})[0]
+        assert above["status"] == "ok" and above["direction"] == "floor"
+        below = rg.gate(traj, {metric: (floor - 0.05, 0.01)})[0]
+        assert below["status"] == "regressed"
+        assert below["bound"] == pytest.approx(floor)
+
     def test_zero_memory_metric_is_lower_better(self):
         metric = "zero_optimizer_memory_bytes_per_device"
         assert metric in rg.LOWER_BETTER
