@@ -117,6 +117,18 @@ echo "== step: Decode smoke (paged KV + speculative + int8 + prefix cache over H
 # burst with bounded interactive latency.
 JAX_PLATFORMS=cpu python benchmarks/decode_smoke.py
 
+echo "== step: Fleet smoke (2-worker prefix-affinity routing over real processes) =="
+# ISSUE 18: the disaggregated serving fleet end-to-end — a FleetRouter over
+# 2 real worker processes: mixed classify+generate traffic all-200s and
+# token-identical to a single-process oracle loaded from the same archives,
+# 0 steady-state recompiles per worker, prefix-affinity routing decisions
+# and per-worker prefix_cache_hit_rate >= the single-process value scraped
+# from the fleet /metrics fan-in, one worker SIGKILLed mid-burst with zero
+# request loss after client retry + respawn back into the ring, and a
+# fleet-wide rolling reload under live traffic with zero shed and every
+# worker's version advancing.
+JAX_PLATFORMS=cpu python benchmarks/fleet_smoke.py
+
 echo "== step: Kernel-engine equivalence (Pallas interpret, fused optimizer) =="
 # ISSUE 9: the hot-path kernel suite with the dispatch knob FORCED to
 # pallas — off-TPU that is the Pallas interpreter, bit-faithful to the

@@ -1983,6 +1983,151 @@ def bench_prefix_decode(streams: int = 64, system_len: int = 56,
     return [headline, ttft]
 
 
+def bench_fleet(n_big: int = 4, window_s: float = 4.0, clients: int = 12):
+    """fleet_qps_scaling_efficiency (ISSUE 18 headline, HIGHER_BETTER,
+    gated) + fleet_routing_overhead_ms (LOWER_BETTER). A FleetRouter over
+    real worker processes serving a compute-weighted dense classifier
+    (128->1024->1024->8; each worker pinned single-threaded via
+    XLA_FLAGS=--xla_cpu_multi_thread_eigen=false + OMP_NUM_THREADS=1 so
+    worker count, not intra-op threading, is the parallelism axis).
+
+    Efficiency = QPS(N=4) / (min(N, host_cores) x QPS(N=1)) — normalized
+    by EFFECTIVE parallelism, the honest-CPU rule: on this 1-core
+    container 4 single-threaded workers cannot exceed one core's
+    throughput, so the raw N x QPS(1) denominator would measure the host,
+    not the fleet (the dp_sharding_efficiency precedent,
+    HOST_CONDITION_FLOOR in regression_gate.py). At saturation the metric
+    becomes the disaggregation tax: what routing + 4-way process
+    multiplexing retain of one worker's direct throughput. On a >=5-core
+    host the SAME expression measures true QPS scaling.
+
+    The overhead companion is p50(serial request through a 1-worker
+    fleet) - p50(same request direct to that worker): the per-hop cost of
+    the routing tier (rendezvous hash + header relay + pooled proxy
+    connection), in ms."""
+    import http.client
+    import threading
+
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving.fleet import FleetRouter, fleet_spec
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .batch_buckets((1, 2, 4, 8)).list()
+            .layer(DenseLayer(n_in=128, n_out=1024, activation="relu"))
+            .layer(DenseLayer(n_in=1024, n_out=1024, activation="relu"))
+            .layer(OutputLayer(n_in=1024, n_out=8, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(128)).build())
+    net = MultiLayerNetwork(conf).init()
+    tmp = tempfile.mkdtemp(prefix="dl4j_fleet_bench_")
+    clf_path = os.path.join(tmp, "clf.zip")
+    ModelSerializer.write_model(net, clf_path, save_updater=False)
+    spec = fleet_spec(
+        models=[{"id": "clf", "path": clf_path, "kind": "classify",
+                 "register": {"max_wait_ms": 2.0, "queue_limit": 512}}],
+        env={"JAX_PLATFORMS": "cpu", "OMP_NUM_THREADS": "1",
+             "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false"})
+    row = np.random.default_rng(0).normal(size=(1, 128)).tolist()
+    payload = json.dumps({"inputs": row}).encode()
+
+    def post_one(conn):
+        conn.request("POST", "/v1/models/clf/infer", body=payload,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        return r.status
+
+    def qps_window(fleet):
+        done = [0] * clients
+        t_end = time.perf_counter() + window_s
+
+        def client(i):
+            conn = http.client.HTTPConnection("127.0.0.1", fleet.port,
+                                              timeout=60)
+            try:
+                while time.perf_counter() < t_end:
+                    if post_one(conn) == 200:
+                        done[i] += 1
+            finally:
+                conn.close()
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return sum(done) / (time.perf_counter() - t0)
+
+    def p50_serial(port, n=80):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            lats = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                post_one(conn)
+                lats.append(time.perf_counter() - t0)
+        finally:
+            conn.close()
+        return sorted(lats)[n // 2]
+
+    def boot(n):
+        f = FleetRouter(spec, n_workers=n, health_interval_s=0.25,
+                        name=f"bench{n}").start()
+        p50_serial(f.port, n=16)  # settle conn pools + anything unwarmed
+        return f
+
+    f1 = boot(1)
+    q1, q1_noise = _med3(lambda: qps_window(f1))
+    w_port = f1.workers[0].port
+    direct_p50, _dn = _med3(lambda: p50_serial(w_port))
+    fleet_p50, fleet_noise = _med3(lambda: p50_serial(f1.port))
+    f1.stop()
+    f4 = boot(n_big)
+    q4, q4_noise = _med3(lambda: qps_window(f4))
+    f4.stop()
+
+    cores = os.cpu_count() or 1
+    denom = min(n_big, cores)
+    eff = q4 / (denom * q1)
+    overhead_ms = max(0.0, (fleet_p50 - direct_p50) * 1e3)
+    scaling = {
+        "metric": "fleet_qps_scaling_efficiency",
+        "model": (f"FleetRouter over {n_big} worker processes vs 1, dense "
+                  f"128->1024->1024->8 classifier, {clients} persistent "
+                  f"HTTP clients x {window_s:.0f}s windows; workers pinned "
+                  f"single-threaded (eigen+OMP=1) so worker count is the "
+                  f"only parallelism axis. QPS(N={n_big})={q4:.1f} "
+                  f"{q4_noise}, QPS(N=1)={q1:.1f} {q1_noise}; efficiency "
+                  f"normalized by EFFECTIVE parallelism min(N, host_cores"
+                  f"={cores})={denom} — on this 1-core host the metric is "
+                  f"the disaggregation tax at core saturation (honest-CPU "
+                  f"rule, the dp_sharding precedent); on >=5 cores the "
+                  f"same expression is true QPS scaling"),
+        "value": round(eff, 4),
+        "noise": q4_noise,
+        "unit": "fraction",
+        "vs_baseline": round(eff, 4),  # vs perfect scaling at 1.0
+    }
+    routing = {
+        "metric": "fleet_routing_overhead_ms",
+        "model": (f"p50 of a serial classify request through a 1-worker "
+                  f"fleet ({fleet_p50 * 1e3:.2f} ms) minus p50 direct to "
+                  f"the worker ({direct_p50 * 1e3:.2f} ms): rendezvous "
+                  f"hash + header relay + pooled proxy hop, this host"),
+        "value": round(overhead_ms, 3),
+        "noise": fleet_noise,
+        "unit": "ms",
+        "vs_baseline": round(overhead_ms, 3),
+    }
+    return [scaling, routing]
+
+
 def main():
     import jax
 
@@ -2114,6 +2259,14 @@ def main():
         extra.extend(bench_prefix_decode())
     except Exception as e:
         print(f"prefix decode bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        # ISSUE 18: disaggregated fleet — QPS scaling efficiency over N
+        # real worker processes (normalized by effective host parallelism,
+        # see bench_fleet) + the routing tier's per-hop p50 overhead
+        extra.extend(bench_fleet())
+    except Exception as e:
+        print(f"fleet bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     result["extra_metrics"] = extra
     print(json.dumps(result))

@@ -1,0 +1,413 @@
+"""Fleet smoke check (the ISSUE 18 CI leg, wired in ci.yml/ci_local.sh).
+
+End-to-end proof of the disaggregated-serving acceptance criteria against
+a REAL 2-worker fleet — spawned worker processes, real HTTP through the
+front tier (docs/SERVING.md#fleet):
+
+1. boot a :class:`FleetRouter` over 2 workers each serving a dense
+   classifier + a causal BERT-tiny prefix-cached decoder from the SAME
+   ModelSerializer archives a single-process oracle server loads; fire
+   mixed classify+generate traffic and assert every response is 200 and
+   token-identical (generate) / output-identical (classify) to the
+   oracle;
+2. assert 0 steady-state recompiles per worker (each worker's
+   ``xla_backend_compiles_total`` is flat across a warm burst) —
+   compile-once serving survives disaggregation;
+3. prefix affinity: shared-prefix generate streams concentrate on one
+   worker per prefix (``routing_decisions_total{reason="affinity"}``
+   dominates), and the warm per-worker ``prefix_cache_hit_rate`` is ≥
+   the single-process oracle's rate (affinity kept the radix caches as
+   warm as one process would) — both scraped from the fleet ``/metrics``
+   fan-in with ``worker`` labels;
+4. SIGKILL one worker mid-burst: every request completes after at most
+   one client retry (zero request loss), the dead worker respawns under
+   backoff and re-enters the ring;
+5. fleet-wide rolling reload under live traffic: zero non-200 during the
+   roll, every worker's version advances monotonically, post-reload
+   outputs match the NEW oracle.
+
+Exit 0 on success, 1 with a FAIL line on any violated check.
+
+    JAX_PLATFORMS=cpu python benchmarks/fleet_smoke.py
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILED = []
+
+
+def check(name: str, ok: bool, detail: str = ""):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def post(port, path, body, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        raw = json.dumps(body).encode()
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", path, body=raw, headers=hdrs)
+        r = conn.getresponse()
+        data = r.read()
+        return r.status, json.loads(data) if data else {}, dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+def post_retry(port, path, body, attempts=3, timeout=60):
+    """Client-side retry on transport errors and 5xx — the 'zero request
+    loss after retry' contract while a worker is being killed."""
+    last = None
+    for i in range(attempts):
+        try:
+            st, doc, hdrs = post(port, path, body, timeout=timeout)
+            if st == 200:
+                return st, doc, hdrs, i
+            last = (st, doc, hdrs)
+        except OSError as e:
+            last = (0, {"error": repr(e)}, {})
+        time.sleep(0.3 * (i + 1))
+    return last[0], last[1], last[2], attempts
+
+
+def get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read().decode()
+    finally:
+        conn.close()
+
+
+def scrape_series(text: str, name: str, **labels) -> float:
+    """Sum of every series `name{...}` whose labels include `labels`
+    (telemetry prefixes every exported metric with ``dl4j_``)."""
+    if not name.startswith("dl4j_"):
+        name = "dl4j_" + name
+    total, found = 0.0, False
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in ("{", " "):
+            continue  # a longer metric name sharing the prefix
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            total += float(line.rsplit(" ", 1)[1])
+            found = True
+    return total if found else float("nan")
+
+
+def build_archives(tmp):
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.bert import Bert
+
+    def dense(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3)).batch_buckets((1, 2, 4, 8)).list()
+                .layer(DenseLayer(n_in=12, n_out=32, activation="relu"))
+                .layer(OutputLayer(n_in=32, n_out=5, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        return MultiLayerNetwork(conf).init()
+
+    clf = dense(0)
+    clf_path = os.path.join(tmp, "clf.zip")
+    ModelSerializer.write_model(clf, clf_path, save_updater=False)
+    bert = Bert.tiny(causal=True, task="mlm", vocab_size=48,
+                     max_length=32, hidden_dropout=0.0).init()
+    gen_path = os.path.join(tmp, "gen.zip")
+    ModelSerializer.write_model(bert, gen_path, save_updater=False)
+    return clf_path, gen_path, dense
+
+
+GEN_KW = {"bucketing": {"batch_buckets": [1, 2, 4], "seq_buckets": [8]},
+          "prefix_cache": True, "block_size": 4}
+REG = {"max_wait_ms": 1.0, "queue_limit": 256}
+
+
+def build_oracle(clf_path, gen_path):
+    """The single-process oracle: the SAME archives behind one
+    ModelServer — the fleet must be indistinguishable from it."""
+    from deeplearning4j_tpu.data.bucketing import BucketingPolicy
+    from deeplearning4j_tpu.serving import ModelRouter, ModelServer
+
+    router = ModelRouter(name="fleet-oracle")
+    router.load("clf", clf_path, kind="classify")
+    from deeplearning4j_tpu.serving.model import ServingModel
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    gen_net = ModelSerializer.restore_model(gen_path, load_updater=False)
+    router.register(
+        ServingModel(gen_net, "gen", kind="generate",
+                     bucketing=BucketingPolicy(batch_buckets=(1, 2, 4),
+                                               seq_buckets=(8,)),
+                     prefix_cache=True, block_size=4), **REG)
+    return ModelServer(router, port=0).start(warmup=True)
+
+
+def prefix_prompts():
+    """4 shared-prefix groups × 6 requests: 8-token shared head (2 radix
+    blocks at block_size=4) + distinct 4-token tails."""
+    groups = []
+    for g in range(4):
+        head = [(7 * g + k) % 40 + 1 for k in range(8)]
+        groups.append([head + [(g + 11 * t + j) % 40 + 1 for j in range(4)]
+                       for t in range(6)])
+    return groups
+
+
+def main() -> int:
+    import tempfile
+
+    import numpy as np
+
+    t_start = time.time()
+    tmp = tempfile.mkdtemp(prefix="fleet_smoke_")
+    print("== fleet smoke: building archives + single-process oracle ==")
+    clf_path, gen_path, dense = build_archives(tmp)
+    oracle = build_oracle(clf_path, gen_path)
+
+    from deeplearning4j_tpu.serving.fleet import FleetRouter, fleet_spec
+
+    spec = fleet_spec(
+        models=[
+            {"id": "clf", "path": clf_path, "kind": "classify",
+             "register": dict(REG)},
+            {"id": "gen", "path": gen_path, "kind": "generate",
+             "register": dict(REG), "model_kw": dict(GEN_KW)},
+        ],
+        env={"JAX_PLATFORMS": "cpu"})
+    print("== booting 2-worker fleet ==")
+    fleet = FleetRouter(spec, n_workers=2, affinity_head=8,
+                        health_interval_s=0.2, name="smokefleet").start()
+    print(f"   fleet up at {fleet.url} "
+          f"({time.time() - t_start:.0f}s)")
+    try:
+        rng = np.random.RandomState(0)
+        xs = [rng.normal(size=(n, 12)).astype(np.float32)
+              for n in (1, 2, 4, 3)]
+        groups = prefix_prompts()
+
+        # ---- leg 1: mixed traffic, token-identical to the oracle ------
+        print("== leg 1: mixed classify+generate vs oracle ==")
+        statuses, mismatches = [], 0
+        lock = threading.Lock()
+
+        def one_classify(x):
+            try:
+                st_f, doc_f, _h = post(fleet.port, "/v1/models/clf/infer",
+                                       {"inputs": x.tolist()})
+                st_o, doc_o, _h = post(oracle.port, "/v1/models/clf/infer",
+                                       {"inputs": x.tolist()})
+            except OSError as e:
+                with lock:
+                    statuses.append((f"conn:{type(e).__name__}", 0))
+                return
+            with lock:
+                statuses.append((st_f, st_o))
+                if st_f == st_o == 200 and not np.allclose(
+                        doc_f["outputs"], doc_o["outputs"], atol=1e-6):
+                    nonlocal_mismatch()
+
+        def one_generate(p):
+            body = {"prompt_tokens": p, "max_new_tokens": 4}
+            try:
+                st_f, doc_f, _h = post(fleet.port,
+                                       "/v1/models/gen/generate", body)
+                st_o, doc_o, _h = post(oracle.port,
+                                       "/v1/models/gen/generate", body)
+            except OSError as e:
+                with lock:
+                    statuses.append((f"conn:{type(e).__name__}", 0))
+                return
+            with lock:
+                statuses.append((st_f, st_o))
+                if st_f == st_o == 200 and \
+                        doc_f["tokens"] != doc_o["tokens"]:
+                    nonlocal_mismatch()
+
+        def nonlocal_mismatch():
+            nonlocal mismatches
+            mismatches += 1
+
+        # classify concurrently (burst coverage); generate serially so the
+        # radix-cache fill order is deterministic on both fleet and oracle
+        threads = []
+        for rep in range(3):
+            for x in xs:
+                threads.append(threading.Thread(target=one_classify,
+                                                args=(x,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for grp in groups:
+            for p in grp:
+                one_generate(p)
+        n_req = len(threads) + sum(len(g) for g in groups)
+        bad = [s for s in statuses if s != (200, 200)]
+        all_200 = len(statuses) == n_req and not bad
+        check("mixed traffic all-200s", all_200,
+              f"{len(statuses)}/{n_req} pairs, non-200={bad[:5]}")
+        check("fleet token/output-identical to single-process oracle",
+              mismatches == 0, f"{mismatches} mismatches over {n_req}")
+
+        # ---- leg 2: zero steady-state recompiles per worker -----------
+        print("== leg 2: steady-state recompiles ==")
+        def worker_compiles():
+            out = {}
+            for w in fleet.workers:
+                st, text = get(w.port, "/metrics")
+                out[w.worker_id] = scrape_series(
+                    text, "xla_backend_compiles_total")
+            return out
+
+        def warm_burst():
+            for x in xs:
+                post(fleet.port, "/v1/models/clf/infer",
+                     {"inputs": x.tolist()})
+            for grp in groups:
+                post(fleet.port, "/v1/models/gen/generate",
+                     {"prompt_tokens": grp[0], "max_new_tokens": 4})
+
+        warm_burst()  # prime: every worker has now traced these shapes
+        before = worker_compiles()
+        warm_burst()  # steady state: the identical burst must not trace
+        after = worker_compiles()
+        deltas = {w: after[w] - before[w] for w in before}
+        check("0 steady-state recompiles per worker",
+              all(d == 0 for d in deltas.values()), f"deltas={deltas}")
+
+        # ---- leg 3: prefix affinity concentrates shared prefixes ------
+        print("== leg 3: prefix-affinity hit rate ==")
+        st, text = get(fleet.port, "/metrics")
+        aff = scrape_series(text, "serving_fleet_routing_decisions_total",
+                            reason="affinity")
+        check("affinity routing decisions scraped > 0", aff > 0,
+              f"affinity={aff:.0f}")
+        worker_rates = []
+        for w in fleet.workers:
+            r = scrape_series(text, "serving_prefix_cache_hit_rate",
+                              worker=w.worker_id, model="gen")
+            if r == r:  # not NaN: this worker served generate traffic
+                worker_rates.append((w.worker_id, r))
+        st_o, text_o = get(oracle.port, "/metrics")
+        oracle_rate = scrape_series(text_o, "serving_prefix_cache_hit_rate",
+                                    model="gen")
+        check("per-worker prefix hit rate scraped > 0",
+              bool(worker_rates) and all(r > 0 for _w, r in worker_rates),
+              f"workers={worker_rates}")
+        best = max((r for _w, r in worker_rates), default=0.0)
+        check("warm per-worker hit rate >= single-process oracle",
+              best >= oracle_rate - 1e-6,
+              f"best_worker={best:.3f} oracle={oracle_rate:.3f}")
+
+        # ---- leg 4: SIGKILL a worker mid-burst ------------------------
+        print("== leg 4: SIGKILL one worker mid-burst ==")
+        results = []
+
+        def burst_one(i):
+            x = xs[i % len(xs)]
+            st, _doc, _h, retries = post_retry(
+                fleet.port, "/v1/models/clf/infer",
+                {"inputs": x.tolist()})
+            with lock:
+                results.append((st, retries))
+
+        victim = fleet._ring()[0]
+        burst = [threading.Thread(target=burst_one, args=(i,))
+                 for i in range(24)]
+        for i, t in enumerate(burst):
+            t.start()
+            if i == 4:
+                os.kill(victim.pid, 9)  # SIGKILL mid-burst
+        for t in burst:
+            t.join()
+        lost = [st for st, _r in results if st != 200]
+        check("zero request loss after retry through the kill",
+              not lost, f"{len(results)} requests, failures={lost}")
+        deadline = time.time() + 120
+        while len(fleet._ring()) < 2 and time.time() < deadline:
+            time.sleep(0.25)
+        check("killed worker respawned and re-entered the ring",
+              len(fleet._ring()) == 2,
+              f"ring={sorted(w.worker_id for w in fleet._ring())} "
+              f"restarts={fleet.worker(victim.worker_id).restarts}")
+
+        # ---- leg 5: rolling reload under live traffic -----------------
+        print("== leg 5: rolling reload under load ==")
+        clf2 = dense(7)
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+        clf2_path = os.path.join(tmp, "clf2.zip")
+        ModelSerializer.write_model(clf2, clf2_path, save_updater=False)
+        stop_evt = threading.Event()
+        shed_during_roll = []
+
+        def load_traffic():
+            while not stop_evt.is_set():
+                try:
+                    st, _d, _h = post(fleet.port, "/v1/models/clf/infer",
+                                      {"inputs": xs[0].tolist()})
+                except OSError as e:
+                    st = f"conn:{type(e).__name__}"
+                if st != 200:
+                    shed_during_roll.append(st)
+
+        feeders = [threading.Thread(target=load_traffic) for _ in range(3)]
+        for t in feeders:
+            t.start()
+        time.sleep(0.3)
+        try:
+            st, doc, _h = post(fleet.port, "/v1/models/clf/reload",
+                               {"path": clf2_path}, timeout=300)
+        finally:
+            stop_evt.set()
+            for t in feeders:
+                t.join(timeout=30)
+        versions = doc.get("versions", {})
+        check("rolling reload returned 200 with every worker swapped",
+              st == 200 and sorted(versions) == ["w0", "w1"],
+              f"status={st} versions={versions}")
+        check("versions advanced monotonically",
+              all(v >= 2 for v in versions.values()), f"{versions}")
+        check("zero fleet-level shed during the roll",
+              not shed_during_roll, f"non-200s={shed_during_roll[:5]}")
+        x0 = xs[0]
+        st, doc, _h = post(fleet.port, "/v1/models/clf/infer",
+                           {"inputs": x0.tolist()})
+        oracle2 = np.asarray(clf2.output(x0))
+        check("post-reload outputs match the NEW oracle",
+              st == 200 and np.allclose(doc["outputs"], oracle2,
+                                        atol=1e-6))
+    finally:
+        fleet.stop()
+        oracle.stop()
+
+    print(f"== fleet smoke done in {time.time() - t_start:.0f}s ==")
+    if _FAILED:
+        print(f"FAIL: {len(_FAILED)} checks failed: {_FAILED}")
+        return 1
+    print("PASS: every fleet check held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

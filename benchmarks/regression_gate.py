@@ -104,6 +104,10 @@ LOWER_BETTER = {
     # committed config — schedule arithmetic, not wall-clock (CPU cannot
     # rank bubbles; the r6 honesty convention)
     "pipeline_bubble_fraction",
+    # disaggregated fleet (ISSUE 18): what the routing tier (rendezvous
+    # hash + header relay + pooled proxy hop) adds to a serial request's
+    # p50 over posting straight to the worker, in ms
+    "fleet_routing_overhead_ms",
 }
 
 # The decode-path metrics (ISSUE 15, BENCH_r11 headline) gate through the
@@ -121,6 +125,10 @@ LOWER_BETTER = {
 # regress unobserved (ISSUE 7 satellite).
 CRITICAL = {
     "dp_sharding_efficiency_8dev_virtual_cpu",
+    # the disaggregated fleet's contract (ISSUE 18): a run that silently
+    # stops reporting scaling efficiency would let the routing tier's
+    # throughput retention regress unobserved
+    "fleet_qps_scaling_efficiency",
 }
 
 # Host-condition-sensitive metrics gate against an ABSOLUTE FLOOR instead
@@ -137,6 +145,13 @@ CRITICAL = {
 # still fatal.
 HOST_CONDITION_FLOOR = {
     "dp_sharding_efficiency_8dev_virtual_cpu": 0.45,
+    # fleet QPS efficiency is normalized by min(N, host_cores) (bench.py
+    # bench_fleet, the honest-CPU rule) but still times real HTTP traffic
+    # on a shared host, so it floors at the ISSUE 18 acceptance bound
+    # rather than banding against the best-known 0.97: a routing-tier
+    # breakage (serialized proxying, thrashing respawns, lost keep-alive)
+    # collapses it far below 0.6, host weather does not
+    "fleet_qps_scaling_efficiency": 0.6,
 }
 
 _NOISE_RE = re.compile(r"[+±]?\s*([0-9.]+)\s*%")

@@ -46,7 +46,26 @@ def register_layer(cls):
 
 def layer_from_dict(d: dict) -> "Layer":
     d = dict(d)
-    cls = _LAYER_TYPES[d.pop("@layer")]
+    kind = d.pop("@layer")
+    cls = _LAYER_TYPES.get(kind)
+    if cls is None:
+        # a fresh process restoring an archive (fleet worker, bare
+        # `restore_model` script) has only the eagerly-imported layer
+        # modules registered; pull in the lazy ones and retry before
+        # declaring the type unknown
+        import importlib
+
+        for mod in ("recurrent", "objdetect", "moe"):
+            try:
+                importlib.import_module(f"deeplearning4j_tpu.nn.{mod}")
+            except ImportError:
+                pass
+        try:
+            cls = _LAYER_TYPES[kind]
+        except KeyError:
+            raise KeyError(
+                f"unknown layer type {kind!r}; registered: "
+                f"{sorted(_LAYER_TYPES)}") from None
     for k, v in list(d.items()):
         if isinstance(v, dict) and "@layer" in v:  # nested wrapper (Bidirectional)
             d[k] = layer_from_dict(v)

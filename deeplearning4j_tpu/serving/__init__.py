@@ -10,7 +10,11 @@ stack (``generate``/``paged``/``quantize``), and an HTTP model server
 with queue-depth-driven load
 shedding and SIGTERM graceful drain (``server``) — all riding the r8
 compile-once substrate (bucketing + AOT warmup), so steady-state serving
-performs ZERO XLA compiles.
+performs ZERO XLA compiles. Above it all sits the fleet (``fleet``/
+``fleet_worker``): a front-tier router spreading traffic over N worker
+``ModelServer`` processes with prefix-affinity rendezvous routing,
+health-aware ring membership, failover, supervision, and fleet-wide
+rolling reload (docs/SERVING.md#fleet).
 
     from deeplearning4j_tpu.serving import (ModelRouter, ModelServer,
                                             ServingModel)
@@ -21,6 +25,9 @@ performs ZERO XLA compiles.
     server = ModelServer(router, port=8080).start()        # warms buckets
 """
 
+from deeplearning4j_tpu.serving.fleet import (FleetRouter, FleetWorker,
+                                              affinity_key, fleet_spec,
+                                              rendezvous_pick)
 from deeplearning4j_tpu.serving.generate import Generator
 from deeplearning4j_tpu.serving.model import ServingModel
 from deeplearning4j_tpu.serving.paged import (BlockPool, PoolExhaustedError,
@@ -31,10 +38,12 @@ from deeplearning4j_tpu.serving.resilience import (BrownoutController,
                                                    BrownoutShedError,
                                                    CircuitBreaker,
                                                    CircuitOpenError,
+                                                   FleetUnavailableError,
                                                    ModelLoadError,
                                                    ReloadRejectedError,
                                                    SchedulerStoppedError,
-                                                   WorkerCrashedError)
+                                                   WorkerCrashedError,
+                                                   WorkerProxyError)
 from deeplearning4j_tpu.serving.router import (ModelRouter,
                                                UnknownModelError,
                                                current_status)
@@ -56,6 +65,9 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "DeadlineExceededError",
+    "FleetRouter",
+    "FleetUnavailableError",
+    "FleetWorker",
     "FlightRecorder",
     "Generator",
     "INT8_LOGIT_TOL",
@@ -73,7 +85,11 @@ __all__ = [
     "ShedError",
     "UnknownModelError",
     "WorkerCrashedError",
+    "WorkerProxyError",
+    "affinity_key",
     "current_status",
+    "fleet_spec",
     "new_request_id",
+    "rendezvous_pick",
     "trace_sample_rate",
 ]

@@ -1106,3 +1106,12 @@ class Generator:
         if self.prefill_chunk is not None:
             s["prefill_chunk"] = self.prefill_chunk
         return s
+
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Lifetime radix-cache token hit rate, or None when the prefix
+        cache is off. Surfaced top-level in ``ServingModel.describe()`` so
+        the fleet router (serving/fleet.py) reads it from ``/v1/models``
+        without digging through the pool stats tree."""
+        if self.cache is None:
+            return None
+        return round(self.cache.hit_rate(), 4)

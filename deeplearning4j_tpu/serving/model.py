@@ -455,6 +455,11 @@ class ServingModel:
             pool = self.generator.pool_stats()
             if pool is not None:
                 out["kv_pool"] = pool
+            hit = self.generator.prefix_hit_rate()
+            if hit is not None:
+                # top-level so the fleet router's /v1/models poll reads it
+                # without unpacking kv_pool (docs/SERVING.md#fleet)
+                out["prefix_hit_rate"] = hit
             if self.generator.draft is not None:
                 out["speculative"] = {
                     "spec_tokens": self.generator.spec_tokens,

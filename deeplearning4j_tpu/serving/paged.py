@@ -309,6 +309,11 @@ class BlockPool:
                 "pool_bytes": self.pool_bytes(),
                 "contiguous_stream_ceiling":
                     self.contiguous_stream_ceiling(),
+                # in-use fraction: the fleet front tier folds this into
+                # /v1/fleet so an operator sees which worker's pool a hot
+                # prefix is concentrating on (docs/SERVING.md#fleet)
+                "utilization": round(
+                    1.0 - len(self._free) / max(1, self.num_blocks), 4),
             }
 
 

@@ -119,6 +119,24 @@ class BrownoutShedError(ShedError):
     shed so the interactive promise survives."""
 
 
+class FleetUnavailableError(ShedError):
+    """The fleet's hash ring is empty — every worker is dead, unhealthy,
+    or draining (serving/fleet.py). The front tier answers 503 +
+    ``Retry-After``: workers respawn under :data:`FLEET_RESPAWN_POLICY`,
+    so the condition is expected to clear."""
+
+    http_status = 503
+    retry_after_s = 2.0
+
+
+class WorkerProxyError(RuntimeError):
+    """Every failover attempt to proxy a request hit a connection-level
+    failure (refused / reset / truncated response) — the fleet router's
+    502. HTTP-level errors from a worker (429/503/…) are NOT this: they
+    relay verbatim; only transport failures fail over and, exhausted,
+    become a 502."""
+
+
 class WorkerCrashedError(RuntimeError):
     """Set on the in-flight batch's futures when the scheduler worker loop
     dies — the HTTP 500 path (a crash is a server error, not a shed)."""
@@ -135,6 +153,24 @@ class ReloadRejectedError(RuntimeError):
     """A rolling reload was rejected before the swap — canary failure,
     warmup failure, or parameter-structure mismatch. The old weights keep
     serving; nothing about the live model changed."""
+
+
+# ------------------------------------------------------- fleet supervision
+
+#: backoff for respawning a dead fleet worker process (serving/fleet.py
+#: supervisor) — the scheduler-watchdog convention (WORKER_RESTART_POLICY)
+#: lifted to process scope: exponential + jitter so N workers dying at
+#: once (an OOM-killer sweep) do not respawn in lockstep, capped so a
+#: crash-looping worker settles at one attempt every few seconds while
+#: the rest of the ring keeps serving.
+def _fleet_respawn_policy():
+    from deeplearning4j_tpu.util.faults import RetryPolicy
+
+    return RetryPolicy(max_attempts=8, base_delay=0.2, multiplier=2.0,
+                       max_delay=5.0, jitter=0.25)
+
+
+FLEET_RESPAWN_POLICY = _fleet_respawn_policy()
 
 
 # --------------------------------------------------------- circuit breaker

@@ -12,6 +12,15 @@ no egress) over a :class:`~deeplearning4j_tpu.serving.router.ModelRouter`:
     GET  /metrics                  Prometheus text (ui_server collectors)
     GET  /healthz                  health JSON incl. serving + slo sections
     GET  /slo                      SLO evaluation JSON (util/slo.py)
+    GET  /admin/status             worker identity: pid, worker_id, drain
+    POST /admin/drain              begin graceful drain (idempotent, 200)
+
+Connections are persistent: the handler speaks HTTP/1.1 with explicit
+``Content-Length`` on every response, so a front tier (serving/fleet.py)
+keeps one pooled connection per worker instead of paying a TCP handshake
+per request. That is also why every POST path reads the full request body
+*before* answering — an unread body would desynchronize the keep-alive
+stream and corrupt the next request on the socket.
 
 Request scope: every POST honors an inbound ``X-Request-Id`` header (or
 mints one) and echoes it on the response — success AND error — so a caller
@@ -50,18 +59,31 @@ from deeplearning4j_tpu.serving.scheduler import ShedError
 from deeplearning4j_tpu.util import telemetry as tm
 
 
+class _ServingHTTPServer(ThreadingHTTPServer):
+    # a connection burst wider than the stdlib default accept backlog (5)
+    # must queue in the kernel, not get RST — admission control lives in
+    # the scheduler's queue_limit, never in the TCP accept queue
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class ModelServer:
     """HTTP model server over a router (see module doc)."""
 
     def __init__(self, router: ModelRouter, port: int = 0,
                  host: str = "127.0.0.1",
                  drain_signals=(signal.SIGTERM,),
-                 request_timeout_s: float = 60.0):
+                 request_timeout_s: float = 60.0,
+                 worker_id: Optional[str] = None):
         self.router = router
         self.host = host
         self.port = port
         self.drain_signals = tuple(drain_signals)
         self.request_timeout_s = float(request_timeout_s)
+        #: fleet identity (serving/fleet.py spawns workers with one);
+        #: surfaced on GET /admin/status so a supervisor can verify it is
+        #: talking to the process it thinks it is after a respawn
+        self.worker_id = worker_id
         self.drained = False
         self._draining = False
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -74,7 +96,7 @@ class ModelServer:
             self.router.warmup()
         server = self
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd = _ServingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]  # resolves port 0
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="model-server")
@@ -202,6 +224,12 @@ def _make_handler(server: ModelServer):
     from deeplearning4j_tpu.util.ui_server import UIServer
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so connections persist across requests: the fleet front
+        # tier (serving/fleet.py) pools one connection per worker. Every
+        # response sets Content-Length (see _send), which 1.1 requires for
+        # keep-alive framing.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):  # quiet
             pass
 
@@ -231,6 +259,19 @@ def _make_handler(server: ModelServer):
                 self._send(200 if ok else 503, body.encode())
             elif u.path == "/slo":
                 self._send(200, UIServer._slo_json().encode())
+            elif u.path == "/admin/status":
+                # worker identity for a fleet supervisor: cheap, never
+                # touches the scheduler (a wedged model must not hide
+                # the process's identity from its supervisor)
+                import os
+
+                self._send_json(200, {
+                    "pid": os.getpid(),
+                    "worker_id": server.worker_id,
+                    "draining": server.draining,
+                    "drained": server.drained,
+                    "models": server.router.model_ids(),
+                })
             elif u.path in ("/v1/models", "/v1/models/"):
                 self._send_json(200, server.router.status())
             elif len(parts) == 5 and parts[:2] == ["v1", "models"] \
@@ -253,7 +294,19 @@ def _make_handler(server: ModelServer):
                 self._send_json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            # read the body FIRST, on every path — an unread body would
+            # desynchronize the persistent (HTTP/1.1) connection and the
+            # next request on the socket would parse garbage
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b""
             parts = self.path.strip("/").split("/")
+            if parts == ["admin", "drain"]:
+                # admin verb for a front tier / orchestrator that cannot
+                # signal the process (adopted workers): same graceful
+                # drain as SIGTERM, idempotent
+                server.request_drain()
+                self._send_json(200, {"draining": True})
+                return
             # /v1/models/<id>/infer|generate|reload
             if len(parts) != 4 or parts[:2] != ["v1", "models"] \
                     or parts[3] not in ("infer", "generate", "reload"):
@@ -273,8 +326,7 @@ def _make_handler(server: ModelServer):
                     headers=[("Retry-After", "10")] + rid_hdr)
                 return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(n) or b"{}")
+                body = json.loads(raw or b"{}")
                 if verb == "infer":
                     resp = server._handle_infer(model_id, body,
                                                 request_id=rid)

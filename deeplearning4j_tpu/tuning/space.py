@@ -819,6 +819,52 @@ class PrefillChunkSpace(SearchSpace):
                  "max_new": 32}]
 
 
+# ------------------------------------------------- affinity head space
+class AffinityHeadSpace(SearchSpace):
+    """Prompt-head length the fleet router hashes for prefix-affinity
+    routing (serving/fleet.py ``affinity_head``, env
+    ``DL4J_TPU_AFFINITY_HEAD`` — docs/SERVING.md#fleet). The TVM framing
+    (arXiv:1802.04799): a routing policy's free parameter is a search
+    dimension, not a constant. The trade-off is real on both ends —
+    head:0 disables affinity (pure least-loaded: best load spread, every
+    worker cold-starts every prefix), a short head collapses distinct
+    system prompts onto one worker (hot-spot risk), a long head splits
+    requests that DO share a radix-cache prefix across workers (hit-rate
+    loss). Ranking candidates needs a live multi-worker fleet under a
+    representative shared-prefix traffic trace: the objective (fleet QPS
+    at a latency bound, or aggregate ``prefix_cache_hit_rate`` ×
+    load-stddev penalty) only exists at fleet scope, so the space is
+    declared, not measurable in this process."""
+
+    name = "affinity_head"
+    op = "affinity_head"
+    scope = "conf"
+    measurable = False
+    requires = ("a live multi-process fleet + representative shared-"
+                "prefix traffic trace (the objective — fleet QPS / "
+                "aggregate prefix hit rate vs load skew — only exists "
+                "at fleet scope)")
+
+    def signature(self, ctx: dict) -> str:
+        n = int(ctx.get("n_workers", 2))
+        return f"workers={n}"
+
+    def dtype(self, ctx: dict) -> str:
+        return "any"
+
+    def enumerate(self, ctx: dict) -> List[Candidate]:
+        from deeplearning4j_tpu.serving.fleet import DEFAULT_AFFINITY_HEAD
+
+        out = [Candidate("head:0", impl="conf",
+                         params={"affinity_head": 0})]  # no affinity
+        for head in (4, 8, 16, 32, 64):
+            out.append(Candidate(
+                f"head:{head}", impl="conf",
+                params={"affinity_head": head},
+                is_default=head == DEFAULT_AFFINITY_HEAD))
+        return out
+
+
 # ------------------------------------------------------- default wiring
 register_space(ConvTileSpace())
 register_space(LstmTileSpace())
@@ -828,3 +874,4 @@ register_space(BucketSetSpace())
 register_space(CompressionHostsSpace())
 register_space(PipeScheduleSpace())
 register_space(PrefillChunkSpace())
+register_space(AffinityHeadSpace())

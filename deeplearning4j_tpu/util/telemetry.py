@@ -661,6 +661,7 @@ def install_default_collectors() -> Telemetry:
         tele.register_collector(_collect_compile_cache)
         tele.register_collector(_collect_elastic)
         tele.register_collector(_collect_serving)
+        tele.register_collector(_collect_fleet)
         tele.register_collector(_collect_tuning)
         tele.register_collector(_collect_slo)
         _defaults_installed = True
@@ -744,6 +745,19 @@ def _collect_serving() -> list:
     import sys
 
     mod = sys.modules.get("deeplearning4j_tpu.serving.router")
+    if mod is None:
+        return []
+    return mod.collect_metrics()
+
+
+def _collect_fleet() -> list:
+    """Fleet-tier gauges (ring size, per-worker health/membership/
+    in-flight/restarts) at scrape time — import-guarded like serving, so
+    a process without a fleet front tier pays nothing
+    (docs/SERVING.md#fleet)."""
+    import sys
+
+    mod = sys.modules.get("deeplearning4j_tpu.serving.fleet")
     if mod is None:
         return []
     return mod.collect_metrics()

@@ -249,6 +249,18 @@ class UIServer:
         try:
             import sys
 
+            # fleet section (docs/SERVING.md#fleet): ring membership,
+            # per-worker health/in-flight/restarts, routing counters —
+            # same sys.modules guard, only the front-tier process pays
+            _fleet = sys.modules.get("deeplearning4j_tpu.serving.fleet")
+            status = _fleet.current_status() if _fleet else {}
+            if status:
+                body["fleet"] = status
+        except Exception:
+            pass
+        try:
+            import sys
+
             # autotuning section (docs/AUTOTUNE.md): database dir, entry
             # count, lookup/hit/measurement counters — same sys.modules
             # guard, so a liveness probe never imports the tuner
